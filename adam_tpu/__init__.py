@@ -1,0 +1,55 @@
+"""adam_tpu — a TPU-native genomics read-processing framework.
+
+A from-scratch re-design of the capabilities of ADAM (the Spark/Parquet
+genomics platform, see /root/reference) built idiomatically on JAX/XLA:
+
+* Reads, variants, genotypes, features and reference fragments are
+  struct-of-arrays **columnar batches** (padded + masked), not
+  record-per-object Avro — so every transform is a batched array program
+  that XLA can tile onto the MXU.
+* The per-partition hot loops of the reference (BQSR, indel realignment,
+  duplicate marking, Smith-Waterman, k-mer counting, flagstat) are JAX
+  kernels: scatter-add covariate histograms, wavefront DP, segment
+  reductions, packed-integer k-mer sort/unique.
+* Spark's shuffle/broadcast/aggregate roles are played by XLA collectives
+  (`psum`, `all_to_all`, `ppermute`) over a genome-sharded `jax.sharding.Mesh`.
+
+Package layout (mirrors the reference's layer map, SURVEY.md §1):
+
+* ``adam_tpu.formats``   — L0': schema constants + columnar batch types
+* ``adam_tpu.models``    — L3: genomic coordinates, dictionaries, tables
+* ``adam_tpu.io``        — L1/L2: SAM/BAM/FASTQ/FASTA/VCF/Parquet/2bit IO
+* ``adam_tpu.ops``       — L5: pure device kernels
+* ``adam_tpu.pipelines`` — L6: distributed read transforms
+* ``adam_tpu.parallel``  — L4: mesh, partitioners, collective shuffles
+* ``adam_tpu.api``       — L7: user-facing dataset classes + plugin API
+* ``adam_tpu.cli``       — L8: command line (transform, flagstat, ...)
+* ``adam_tpu.instrument``— L9: named-timer registry
+"""
+
+import os
+
+import jax
+
+# Genomic coordinates, flattened genome offsets and 2-bit packed k-mers all
+# need 64-bit integers (human genome ~3.1e9 bp > 2^31; k=21 k-mer = 42 bits),
+# so importing adam_tpu enables jax x64 process-wide. Device arrays stay
+# explicitly i32 wherever ranges allow, so unrelated JAX code keeps its
+# dtypes as long as it spells them out; set ADAM_TPU_NO_X64=1 to opt out
+# (k-mer packing and packed position keys then fall back to host numpy).
+if not os.environ.get("ADAM_TPU_NO_X64"):
+    jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from adam_tpu.formats.batch import ReadBatch  # noqa: E402,F401
+from adam_tpu.models.dictionaries import (  # noqa: E402,F401
+    SequenceDictionary,
+    SequenceRecord,
+    RecordGroupDictionary,
+    RecordGroup,
+)
+from adam_tpu.models.positions import (  # noqa: E402,F401
+    ReferencePosition,
+    ReferenceRegion,
+)

@@ -1,0 +1,4 @@
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import ReadBatch
+
+__all__ = ["schema", "ReadBatch"]

@@ -1,0 +1,294 @@
+"""Columnar read batches — the device-side data model.
+
+The reference's unit of data is one Avro ``AlignmentRecord`` object per read
+flowing through Spark RDDs.  Here the unit is a **batch**: a struct of
+padded, masked arrays ``[N, Lmax]`` that lives in HBM and is the argument
+to every kernel.  This is what makes ``vmap``/``shard_map`` work and keeps
+the MXU fed.
+
+Split of responsibilities:
+
+* :class:`ReadBatch` — pure JAX pytree of arrays.  Safe to pass through
+  ``jit``/``shard_map``; every transform is ``ReadBatch -> ReadBatch``.
+* :class:`ReadSidecar` — host-only variable-length columns (read names,
+  attribute strings, MD tags) kept out of the device path, carried
+  alongside by the API layer (:mod:`adam_tpu.api`).
+
+Field parity with the reference's AlignmentRecord (field list at
+projections/AlignmentRecordField.scala:29-31): sequence/qual -> ``bases``/
+``quals`` (integer-coded), the 12 boolean flag fields -> packed ``flags``,
+contig/start/end/mapq/cigar/mate* -> same-named columns, recordGroup* ->
+``read_group_idx`` into a :class:`RecordGroupDictionary`, readName/
+attributes/mdTag/origQual -> sidecar.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adam_tpu.formats import schema
+
+Array = Any  # jnp.ndarray or np.ndarray
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class ReadBatch:
+    """Struct-of-arrays batch of (up to) N reads, padded to [N, L] / [N, C].
+
+    Padding rows have ``valid == False``; padding lanes within a read have
+    ``bases == BASE_PAD`` and ``quals == QUAL_PAD``.
+    """
+
+    bases: Array          # u8[N, L]   base codes (schema.BASE_*)
+    quals: Array          # u8[N, L]   phred values, QUAL_PAD in padding
+    lengths: Array        # i32[N]     true read length
+    flags: Array          # i32[N]     packed SAM flags
+    contig_idx: Array     # i32[N]     index into SequenceDictionary, -1 unmapped
+    start: Array          # i64[N]     0-based inclusive, -1 if unmapped
+    end: Array            # i64[N]     0-based exclusive (start + ref span)
+    mapq: Array           # i32[N]     255 = unavailable
+    cigar_ops: Array      # u8[N, C]   schema.CIGAR_* codes, CIGAR_PAD pad
+    cigar_lens: Array     # i32[N, C]
+    cigar_n: Array        # i32[N]     number of real cigar ops
+    mate_contig_idx: Array  # i32[N]   -1 if mate unmapped/absent
+    mate_start: Array     # i64[N]
+    tlen: Array           # i32[N]    template length (SAM TLEN)
+    read_group_idx: Array  # i32[N]   index into RecordGroupDictionary, -1 none
+    valid: Array          # bool[N]   row mask
+
+    # ---------------------------------------------------------------- sizes
+    @property
+    def n_rows(self) -> int:
+        return int(self.bases.shape[0])
+
+    @property
+    def lmax(self) -> int:
+        return int(self.bases.shape[1])
+
+    @property
+    def cmax(self) -> int:
+        return int(self.cigar_ops.shape[1])
+
+    def n_valid(self) -> int:
+        return int(np.asarray(self.valid).sum())
+
+    # ------------------------------------------------------------ flag views
+    def flag_set(self, bit: int) -> Array:
+        return (self.flags & bit) != 0
+
+    @property
+    def is_mapped(self) -> Array:
+        return (self.flags & schema.FLAG_UNMAPPED) == 0
+
+    @property
+    def is_primary(self) -> Array:
+        return (self.flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0
+
+    # ------------------------------------------------------------- reshaping
+    def pad_rows(self, n: int) -> "ReadBatch":
+        """Pad to exactly ``n`` rows (valid=False padding)."""
+        cur = self.n_rows
+        if cur == n:
+            return self
+        if cur > n:
+            raise ValueError(f"cannot pad {cur} rows down to {n}")
+        extra = n - cur
+
+        def pad(x, fill):
+            pad_width = [(0, extra)] + [(0, 0)] * (x.ndim - 1)
+            return np.pad(np.asarray(x), pad_width, constant_values=fill)
+
+        return ReadBatch(
+            bases=pad(self.bases, schema.BASE_PAD),
+            quals=pad(self.quals, schema.QUAL_PAD),
+            lengths=pad(self.lengths, 0),
+            flags=pad(self.flags, schema.FLAG_UNMAPPED),
+            contig_idx=pad(self.contig_idx, -1),
+            start=pad(self.start, -1),
+            end=pad(self.end, -1),
+            mapq=pad(self.mapq, 255),
+            cigar_ops=pad(self.cigar_ops, schema.CIGAR_PAD),
+            cigar_lens=pad(self.cigar_lens, 0),
+            cigar_n=pad(self.cigar_n, 0),
+            mate_contig_idx=pad(self.mate_contig_idx, -1),
+            mate_start=pad(self.mate_start, -1),
+            tlen=pad(self.tlen, 0),
+            read_group_idx=pad(self.read_group_idx, -1),
+            valid=pad(self.valid, False),
+        )
+
+    def take(self, idx: Array) -> "ReadBatch":
+        """Row gather (device-friendly: same op on every column)."""
+        return jax.tree.map(lambda x: jnp.asarray(x)[idx], self)
+
+    def replace(self, **kw) -> "ReadBatch":
+        return dataclasses.replace(self, **kw)
+
+    def to_numpy(self) -> "ReadBatch":
+        return jax.tree.map(np.asarray, self)
+
+    def to_device(self) -> "ReadBatch":
+        return jax.tree.map(jnp.asarray, self)
+
+    # ----------------------------------------------------------- constructors
+    @staticmethod
+    def empty(n: int = 0, lmax: int = 0, cmax: int = 0) -> "ReadBatch":
+        return ReadBatch(
+            bases=np.full((n, lmax), schema.BASE_PAD, np.uint8),
+            quals=np.full((n, lmax), schema.QUAL_PAD, np.uint8),
+            lengths=np.zeros(n, np.int32),
+            flags=np.full(n, schema.FLAG_UNMAPPED, np.int32),
+            contig_idx=np.full(n, -1, np.int32),
+            start=np.full(n, -1, np.int64),
+            end=np.full(n, -1, np.int64),
+            mapq=np.full(n, 255, np.int32),
+            cigar_ops=np.full((n, cmax), schema.CIGAR_PAD, np.uint8),
+            cigar_lens=np.zeros((n, cmax), np.int32),
+            cigar_n=np.zeros(n, np.int32),
+            mate_contig_idx=np.full(n, -1, np.int32),
+            mate_start=np.full(n, -1, np.int64),
+            tlen=np.zeros(n, np.int32),
+            read_group_idx=np.full(n, -1, np.int32),
+            valid=np.zeros(n, bool),
+        )
+
+    @staticmethod
+    def concat(batches: Sequence["ReadBatch"]) -> "ReadBatch":
+        """Concatenate along rows, widening L/C to the max."""
+        batches = [b for b in batches if b.n_rows]
+        if not batches:
+            return ReadBatch.empty()
+        lmax = max(b.lmax for b in batches)
+        cmax = max(b.cmax for b in batches)
+        batches = [b.widen(lmax, cmax).to_numpy() for b in batches]
+        return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *batches)
+
+    def widen(self, lmax: int, cmax: int) -> "ReadBatch":
+        """Grow the per-read padding lanes to lmax/cmax."""
+        if lmax == self.lmax and cmax == self.cmax:
+            return self
+
+        def padlane(x, width, fill):
+            x = np.asarray(x)
+            if x.shape[1] == width:
+                return x
+            return np.pad(x, [(0, 0), (0, width - x.shape[1])], constant_values=fill)
+
+        return self.replace(
+            bases=padlane(self.bases, lmax, schema.BASE_PAD),
+            quals=padlane(self.quals, lmax, schema.QUAL_PAD),
+            cigar_ops=padlane(self.cigar_ops, cmax, schema.CIGAR_PAD),
+            cigar_lens=padlane(self.cigar_lens, cmax, 0),
+        )
+
+
+@dataclass
+class ReadSidecar:
+    """Host-side variable-length columns, parallel to ReadBatch rows."""
+
+    names: list = field(default_factory=list)       # read names
+    attrs: list = field(default_factory=list)       # raw SAM tag strings ("NM:i:0\tAS:i:75")
+    md: list = field(default_factory=list)          # MD tag string or None
+    orig_quals: list = field(default_factory=list)  # OQ or None
+
+    def take(self, idx) -> "ReadSidecar":
+        idx = np.asarray(idx)
+        return ReadSidecar(
+            names=[self.names[i] for i in idx],
+            attrs=[self.attrs[i] for i in idx],
+            md=[self.md[i] for i in idx],
+            orig_quals=[self.orig_quals[i] for i in idx],
+        )
+
+    @staticmethod
+    def concat(sides: Sequence["ReadSidecar"]) -> "ReadSidecar":
+        out = ReadSidecar()
+        for s in sides:
+            out.names += s.names
+            out.attrs += s.attrs
+            out.md += s.md
+            out.orig_quals += s.orig_quals
+        return out
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+
+def pack_reads(
+    records: Sequence[dict],
+    lmax: int | None = None,
+    cmax: int | None = None,
+    round_rows_to: int = 1,
+) -> tuple[ReadBatch, ReadSidecar]:
+    """Build a (ReadBatch, ReadSidecar) from parsed per-read dicts.
+
+    Each record dict carries: name, flags, contig_idx, start (0-based, -1
+    unmapped), mapq, cigar (string), seq (string), qual (phred string or
+    '*'), mate_contig_idx, mate_start, tlen, read_group_idx, attrs (raw tag
+    string), md (or None).
+    """
+    n = len(records)
+    if n == 0:
+        return ReadBatch.empty(), ReadSidecar()
+    if lmax is None:
+        lmax = max((len(r["seq"]) if r["seq"] not in ("*", None) else 0) for r in records)
+        lmax = max(lmax, 1)
+    if cmax is None:
+        cmax = 1
+        for r in records:
+            c = r.get("cigar") or "*"
+            cmax = max(cmax, sum(1 for ch in c if not ch.isdigit()))
+    nrows = _round_up(n, round_rows_to)
+
+    b = ReadBatch.empty(nrows, lmax, cmax)
+    b = jax.tree.map(np.array, b)  # writable copies
+    side = ReadSidecar()
+
+    for i, r in enumerate(records):
+        seq = r["seq"] if r["seq"] not in ("*", None) else ""
+        qual = r.get("qual")
+        L = len(seq)
+        if L:
+            b.bases[i, :L] = schema.encode_bases(seq)
+        if qual and qual != "*":
+            b.quals[i, : len(qual)] = schema.encode_quals(qual)
+        elif L:
+            b.quals[i, :L] = 0
+        b.lengths[i] = L
+        b.flags[i] = r["flags"]
+        b.contig_idx[i] = r.get("contig_idx", -1)
+        start = r.get("start", -1)
+        b.start[i] = start
+        b.mapq[i] = r.get("mapq", 255)
+        cig = r.get("cigar") or "*"
+        ops, lens, ncig = schema.encode_cigar(cig, cmax)
+        b.cigar_ops[i] = ops
+        b.cigar_lens[i] = lens
+        b.cigar_n[i] = ncig
+        _, rlen = schema.cigar_str_stats(cig) if cig != "*" else (0, 0)
+        # end = start for mapped reads whose CIGAR consumes no reference
+        # (e.g. fully soft-clipped); -1 is reserved for unplaced reads.
+        b.end[i] = start + rlen if start >= 0 else -1
+        b.mate_contig_idx[i] = r.get("mate_contig_idx", -1)
+        b.mate_start[i] = r.get("mate_start", -1)
+        b.tlen[i] = r.get("tlen", 0)
+        b.read_group_idx[i] = r.get("read_group_idx", -1)
+        b.valid[i] = True
+
+        side.names.append(r.get("name", ""))
+        side.attrs.append(r.get("attrs", ""))
+        side.md.append(r.get("md"))
+        side.orig_quals.append(r.get("orig_qual"))
+
+    return b, side

@@ -1,0 +1,16 @@
+from adam_tpu.models.positions import ReferencePosition, ReferenceRegion
+from adam_tpu.models.dictionaries import (
+    SequenceDictionary,
+    SequenceRecord,
+    RecordGroupDictionary,
+    RecordGroup,
+)
+
+__all__ = [
+    "ReferencePosition",
+    "ReferenceRegion",
+    "SequenceDictionary",
+    "SequenceRecord",
+    "RecordGroupDictionary",
+    "RecordGroup",
+]

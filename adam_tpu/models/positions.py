@@ -1,0 +1,168 @@
+"""Genomic coordinate system.
+
+Host-side value types with the semantics of the reference's
+``models/ReferencePosition.scala:86`` and ``models/ReferenceRegion.scala:125``
+(overlaps / merge / hull / intersection at :143-229), plus the integer
+encodings used on device:
+
+* a position on device is ``(contig_idx: i32, pos: i64)`` — contig *index*
+  into a :class:`~adam_tpu.models.dictionaries.SequenceDictionary` rather
+  than a name string;
+* a total order over positions is the packed key
+  ``(contig_idx + 1) << POS_BITS | pos`` (unmapped = contig -1 sorts with
+  key 0 prefix handled by the sort pipeline), giving single-key radix/lex
+  sorts on device.
+
+All coordinates are 0-based, end-exclusive (same convention as the
+reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import total_ordering
+
+import numpy as np
+
+# 2^40 bp per contig is comfortably above any real contig length; leaves
+# 23 bits for contig index inside a signed i64 key.
+POS_BITS = 40
+POS_MASK = (1 << POS_BITS) - 1
+
+
+def pack_position_key(contig_idx, pos):
+    """(contig_idx, pos) -> sortable i64 key. Works on numpy or jnp arrays.
+
+    Unmapped (contig_idx < 0) packs to key < 2^POS_BITS so mapped reads sort
+    after all-unmapped only if caller wants that; the sort pipeline instead
+    sends unmapped to the end explicitly (semantics of
+    AlignmentRecordRDDFunctions.scala:249-256, where unmapped reads sort
+    last keyed by name).
+    """
+    if hasattr(contig_idx, "astype"):  # numpy path (jnp arrays handled below)
+        c = contig_idx.astype(np.int64) + 1
+        p = pos.astype(np.int64)
+    else:
+        import jax.numpy as jnp
+
+        if isinstance(contig_idx, jnp.ndarray) or isinstance(pos, jnp.ndarray):
+            c = jnp.asarray(contig_idx, jnp.int64) + 1
+            p = jnp.asarray(pos, jnp.int64)
+        else:
+            c = np.int64(contig_idx) + 1
+            p = np.int64(pos)
+    return (c << POS_BITS) | (p & POS_MASK)
+
+
+def unpack_position_key(key):
+    return (key >> POS_BITS) - 1, key & POS_MASK
+
+
+@total_ordering
+@dataclass(frozen=True)
+class ReferencePosition:
+    """A point on a contig (reference name form, host side)."""
+
+    referenceName: str
+    pos: int
+
+    def __lt__(self, other: "ReferencePosition"):
+        return (self.referenceName, self.pos) < (other.referenceName, other.pos)
+
+
+@total_ordering
+@dataclass(frozen=True)
+class ReferenceRegion:
+    """Half-open interval [start, end) on a contig.
+
+    Semantics match models/ReferenceRegion.scala: ``merge`` requires
+    overlap-or-adjacency, ``hull`` does not; ``distance`` is defined only on
+    the same contig (1 for adjacent, matching :188-196).
+    """
+
+    referenceName: str
+    start: int
+    end: int
+
+    def __post_init__(self):
+        if self.start < 0 or self.end < self.start:
+            raise ValueError(f"malformed region {self}")
+
+    @property
+    def width(self) -> int:
+        return self.end - self.start
+
+    def contains_point(self, p: ReferencePosition) -> bool:
+        return (
+            self.referenceName == p.referenceName
+            and self.start <= p.pos < self.end
+        )
+
+    def contains(self, other: "ReferenceRegion") -> bool:
+        return (
+            self.referenceName == other.referenceName
+            and self.start <= other.start
+            and self.end >= other.end
+        )
+
+    def overlaps(self, other: "ReferenceRegion") -> bool:
+        return (
+            self.referenceName == other.referenceName
+            and self.end > other.start
+            and other.end > self.start
+        )
+
+    def is_adjacent(self, other: "ReferenceRegion") -> bool:
+        return self.distance(other) == 1
+
+    def distance(self, other: "ReferenceRegion"):
+        """Distance in bp; 0 if overlapping, 1 if adjacent, None cross-contig."""
+        if self.referenceName != other.referenceName:
+            return None
+        if self.overlaps(other):
+            return 0
+        if other.start >= self.end:
+            return other.start - self.end + 1
+        return self.start - other.end + 1
+
+    def merge(self, other: "ReferenceRegion") -> "ReferenceRegion":
+        if not (self.overlaps(other) or self.is_adjacent(other)):
+            raise ValueError(f"cannot merge non-adjacent {self} and {other}")
+        return self.hull(other)
+
+    def hull(self, other: "ReferenceRegion") -> "ReferenceRegion":
+        if self.referenceName != other.referenceName:
+            raise ValueError("hull requires same contig")
+        return ReferenceRegion(
+            self.referenceName,
+            min(self.start, other.start),
+            max(self.end, other.end),
+        )
+
+    def intersection(self, other: "ReferenceRegion") -> "ReferenceRegion":
+        if not self.overlaps(other):
+            raise ValueError(f"regions {self} and {other} do not overlap")
+        return ReferenceRegion(
+            self.referenceName,
+            max(self.start, other.start),
+            min(self.end, other.end),
+        )
+
+    def pad(self, by: int, max_end: int | None = None) -> "ReferenceRegion":
+        end = self.end + by if max_end is None else min(self.end + by, max_end)
+        return ReferenceRegion(self.referenceName, max(0, self.start - by), end)
+
+    def __lt__(self, other: "ReferenceRegion"):
+        return (self.referenceName, self.start, self.end) < (
+            other.referenceName,
+            other.start,
+            other.end,
+        )
+
+
+def regions_from_arrays(names, starts, ends):
+    """Vector -> list[ReferenceRegion] helper for host post-processing."""
+    return [
+        ReferenceRegion(n, int(s), int(e))
+        for n, s, e in zip(names, np.asarray(starts), np.asarray(ends))
+    ]

@@ -1,0 +1,91 @@
+import numpy as np
+import pytest
+
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import ReadBatch, pack_reads
+
+
+def test_base_encode_decode_roundtrip():
+    s = "ACGTNacgtn"
+    codes = schema.encode_bases(s)
+    assert list(codes) == [0, 1, 2, 3, 4, 0, 1, 2, 3, 4]
+    assert schema.decode_bases(codes) == "ACGTNACGTN"
+
+
+def test_complement():
+    codes = schema.encode_bases("ACGTN")
+    comp = schema.BASE_COMPLEMENT[codes]
+    assert schema.decode_bases(comp) == "TGCAN"
+
+
+def test_qual_roundtrip():
+    q = "!I5"
+    phred = schema.encode_quals(q)
+    assert list(phred) == [0, 40, 20]
+    assert schema.decode_quals(phred) == q
+
+
+def test_cigar_encode_decode():
+    ops, lens, n = schema.encode_cigar("10M2I5D3S", 8)
+    assert n == 4
+    assert list(ops[:4]) == [schema.CIGAR_M, schema.CIGAR_I, schema.CIGAR_D, schema.CIGAR_S]
+    assert list(lens[:4]) == [10, 2, 5, 3]
+    assert schema.decode_cigar(ops, lens, n) == "10M2I5D3S"
+    assert schema.decode_cigar(*schema.encode_cigar("*", 4)[:2], 0) == "*"
+
+
+def test_cigar_stats():
+    qlen, rlen = schema.cigar_str_stats("10M2I5D3S")
+    assert qlen == 10 + 2 + 3
+    assert rlen == 10 + 5
+
+
+def _recs():
+    return [
+        dict(name="r1", flags=0, contig_idx=0, start=100, mapq=60,
+             cigar="4M", seq="ACGT", qual="IIII", attrs="", md="4"),
+        dict(name="r2", flags=16, contig_idx=1, start=200, mapq=30,
+             cigar="2M1I3M", seq="ACGTAC", qual="IIIIII", attrs="NM:i:1", md="5"),
+        dict(name="r3", flags=4, contig_idx=-1, start=-1, mapq=255,
+             cigar="*", seq="GG", qual="II", attrs="", md=None),
+    ]
+
+
+def test_pack_reads():
+    batch, side = pack_reads(_recs())
+    assert batch.n_rows == 3
+    assert batch.lmax == 6
+    assert batch.n_valid() == 3
+    np.testing.assert_array_equal(np.asarray(batch.lengths), [4, 6, 2])
+    np.testing.assert_array_equal(np.asarray(batch.start), [100, 200, -1])
+    np.testing.assert_array_equal(np.asarray(batch.end), [104, 205, -1])
+    assert schema.decode_bases(np.asarray(batch.bases)[1], 6) == "ACGTAC"
+    assert np.asarray(batch.bases)[0, 4] == schema.BASE_PAD
+    assert side.names == ["r1", "r2", "r3"]
+    assert bool(np.asarray(batch.is_mapped)[2]) is False
+
+
+def test_pack_rounding_and_pad_rows():
+    batch, _ = pack_reads(_recs(), round_rows_to=8)
+    assert batch.n_rows == 8
+    assert batch.n_valid() == 3
+    batch2 = batch.pad_rows(16)
+    assert batch2.n_rows == 16
+    assert batch2.n_valid() == 3
+    assert not bool(np.asarray(batch2.valid)[10])
+
+
+def test_concat_widens():
+    b1, _ = pack_reads(_recs()[:1])
+    b2, _ = pack_reads(_recs()[1:])
+    cat = ReadBatch.concat([b1, b2])
+    assert cat.n_rows == 3
+    assert cat.lmax == 6
+    np.testing.assert_array_equal(np.asarray(cat.lengths), [4, 6, 2])
+
+
+def test_take_is_jittable():
+    import jax
+    batch, _ = pack_reads(_recs())
+    taken = jax.jit(lambda b: b.take(np.array([2, 0])))(batch.to_device())
+    np.testing.assert_array_equal(np.asarray(taken.lengths), [2, 4])

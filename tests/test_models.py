@@ -1,0 +1,110 @@
+import numpy as np
+import pytest
+
+from adam_tpu.models import (
+    ReferencePosition,
+    ReferenceRegion,
+    SequenceDictionary,
+    SequenceRecord,
+    RecordGroupDictionary,
+    RecordGroup,
+)
+from adam_tpu.models.positions import pack_position_key, unpack_position_key
+
+
+def test_region_overlaps_and_merge():
+    a = ReferenceRegion("chr1", 10, 20)
+    b = ReferenceRegion("chr1", 15, 25)
+    c = ReferenceRegion("chr1", 20, 30)
+    d = ReferenceRegion("chr2", 10, 20)
+    assert a.overlaps(b)
+    assert not a.overlaps(c)  # end-exclusive
+    assert a.is_adjacent(c)
+    assert not a.overlaps(d)
+    assert a.merge(b) == ReferenceRegion("chr1", 10, 25)
+    assert a.merge(c) == ReferenceRegion("chr1", 10, 30)
+    with pytest.raises(ValueError):
+        a.merge(ReferenceRegion("chr1", 50, 60))
+    assert a.hull(ReferenceRegion("chr1", 50, 60)) == ReferenceRegion("chr1", 10, 60)
+    assert a.intersection(b) == ReferenceRegion("chr1", 15, 20)
+    assert a.distance(ReferenceRegion("chr1", 40, 50)) == 21
+    assert a.distance(d) is None
+
+
+def test_region_contains_point_ordering():
+    r = ReferenceRegion("chr1", 10, 20)
+    assert r.contains_point(ReferencePosition("chr1", 10))
+    assert not r.contains_point(ReferencePosition("chr1", 20))
+    assert ReferencePosition("chr1", 5) < ReferencePosition("chr1", 6)
+    assert ReferencePosition("chr1", 5) < ReferencePosition("chr2", 0)
+
+
+def test_position_key_roundtrip():
+    c = np.array([0, 3, -1], dtype=np.int32)
+    p = np.array([123456789, 0, 0], dtype=np.int64)
+    keys = pack_position_key(c, p)
+    assert keys.dtype == np.int64
+    # ordering: contig-major then position
+    assert keys[0] < pack_position_key(np.int32(0), np.int64(123456790))
+    assert keys[0] < keys[1]
+    assert keys[2] < keys[0]  # unmapped packs lowest
+    cc, pp = unpack_position_key(keys)
+    np.testing.assert_array_equal(cc, c)
+    np.testing.assert_array_equal(pp[:2], p[:2])
+
+
+def _dict():
+    return SequenceDictionary(
+        (SequenceRecord("1", 1000), SequenceRecord("2", 500))
+    )
+
+
+def test_sequence_dictionary_basic():
+    sd = _dict()
+    assert len(sd) == 2
+    assert "1" in sd and "3" not in sd
+    assert sd.index("2") == 1
+    assert sd.index_or("zz") == -1
+    np.testing.assert_array_equal(sd.offsets, [0, 1000, 1500])
+    assert sd.total_length == 1500
+
+
+def test_sequence_dictionary_merge():
+    sd = _dict()
+    other = SequenceDictionary((SequenceRecord("2", 500), SequenceRecord("3", 42)))
+    merged = sd.merge(other)
+    assert merged.names == ["1", "2", "3"]
+    bad = SequenceDictionary((SequenceRecord("2", 999),))
+    assert not sd.is_compatible_with(bad)
+    with pytest.raises(ValueError):
+        sd.merge(bad)
+
+
+def test_sequence_dictionary_sam_header_roundtrip():
+    lines = ["@SQ\tSN:chrM\tLN:16571\tAS:hg19", "@HD\tVN:1.5"]
+    sd = SequenceDictionary.from_sam_header_lines(lines)
+    assert sd.names == ["chrM"]
+    assert sd["chrM"].length == 16571
+    assert sd["chrM"].assembly == "hg19"
+    out = sd.to_sam_header_lines()
+    assert out == ["@SQ\tSN:chrM\tLN:16571\tAS:hg19"]
+
+
+def test_record_groups():
+    rgd = RecordGroupDictionary.from_sam_header_lines(
+        [
+            "@RG\tID:rg1\tSM:s1\tLB:libA",
+            "@RG\tID:rg2\tSM:s1\tLB:libA",
+            "@RG\tID:rg3\tSM:s2\tLB:libB",
+        ]
+    )
+    assert rgd.names == ["rg1", "rg2", "rg3"]
+    libs = rgd.library_ids()
+    assert libs[0] == libs[1] != libs[2]
+    assert rgd.index("rg3") == 2
+    merged = rgd.merge(RecordGroupDictionary((RecordGroup("rg4"),)))
+    assert len(merged) == 4
+    with pytest.raises(ValueError):
+        rgd.merge(
+            RecordGroupDictionary((RecordGroup("rg1", library="other"),))
+        )
