@@ -1,0 +1,3 @@
+from adam_tpu.api.datasets import AlignmentDataset
+
+__all__ = ["AlignmentDataset"]

@@ -1,0 +1,139 @@
+"""User-facing dataset handles.
+
+The reference enriches Spark RDDs with genomic methods via implicits
+(``import ADAMContext._``, rdd/ADAMContext.scala:54-102;
+AlignmentRecordRDDFunctions).  Here the handle is an explicit value type:
+:class:`AlignmentDataset` bundles the device batch, the host sidecar, and
+the header dictionaries, and exposes the transform/save methods of
+AlignmentRecordRDDFunctions (rdd/read/AlignmentRecordRDDFunctions.scala:45-588).
+
+Transforms delegate to :mod:`adam_tpu.pipelines` and return new datasets
+(immutability mirrors RDD semantics and keeps the device path functional).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import TYPE_CHECKING, Optional
+
+import numpy as np
+
+from adam_tpu.formats.batch import ReadBatch, ReadSidecar
+
+if TYPE_CHECKING:  # avoid io<->api import cycle at runtime
+    from adam_tpu.io.sam import SamHeader
+
+
+@dataclass
+class AlignmentDataset:
+    batch: ReadBatch
+    sidecar: ReadSidecar
+    header: "SamHeader"
+
+    # ------------------------------------------------------------------ io
+    @staticmethod
+    def load(path: str, **kw) -> "AlignmentDataset":
+        from adam_tpu.io import context
+
+        return context.load_alignments(path, **kw)
+
+    def save(self, path: str, sort_order: Optional[str] = None) -> None:
+        """Dispatch on extension like adamSave/adamSAMSave."""
+        p = str(path)
+        if p.endswith(".sam"):
+            from adam_tpu.io import sam
+
+            sam.write_sam(p, self.batch, self.sidecar, self.header, sort_order)
+        elif p.endswith(".bam"):
+            from adam_tpu.io import sam
+
+            sam.write_bam(p, self.batch, self.sidecar, self.header, sort_order)
+        elif p.endswith((".fq", ".fastq")):
+            from adam_tpu.io import fastq
+
+            fastq.write_fastq(p, self.batch, self.sidecar)
+        else:
+            from adam_tpu.io import parquet
+
+            parquet.save_alignments(p, self.batch, self.sidecar, self.header)
+
+    def save_paired_fastq(self, path1: str, path2: str) -> None:
+        from adam_tpu.io import fastq
+
+        fastq.write_paired_fastq(path1, path2, self.batch, self.sidecar)
+
+    # ------------------------------------------------------------- helpers
+    def __len__(self) -> int:
+        return self.batch.n_valid()
+
+    @property
+    def seq_dict(self):
+        return self.header.seq_dict
+
+    @property
+    def read_groups(self):
+        return self.header.read_groups
+
+    def with_batch(
+        self, batch: ReadBatch, sidecar: Optional[ReadSidecar] = None
+    ) -> "AlignmentDataset":
+        return replace(
+            self, batch=batch, sidecar=sidecar if sidecar is not None else self.sidecar
+        )
+
+    def take_rows(self, idx) -> "AlignmentDataset":
+        idx = np.asarray(idx)
+        return replace(
+            self, batch=self.batch.to_numpy().take(idx), sidecar=self.sidecar.take(idx)
+        )
+
+    def compact(self) -> "AlignmentDataset":
+        """Drop invalid (padding/filtered) rows."""
+        return self.take_rows(np.flatnonzero(np.asarray(self.batch.valid)))
+
+    # ---------------------------------------------------------- transforms
+    def sort_by_reference_position(self) -> "AlignmentDataset":
+        from adam_tpu.pipelines import sort
+
+        return sort.sort_by_reference_position(self)
+
+    def mark_duplicates(self) -> "AlignmentDataset":
+        from adam_tpu.pipelines import markdup
+
+        return markdup.mark_duplicates(self)
+
+    def recalibrate_base_qualities(self, known_snps=None, **kw) -> "AlignmentDataset":
+        from adam_tpu.pipelines.bqsr import recalibrate_base_qualities
+
+        return recalibrate_base_qualities(self, known_snps=known_snps, **kw)
+
+    def realign_indels(self, **kw) -> "AlignmentDataset":
+        from adam_tpu.pipelines.realign import realign_indels
+
+        return realign_indels(self, **kw)
+
+    def trim_reads(self, trim_start: int = -1, trim_end: int = -1) -> "AlignmentDataset":
+        from adam_tpu.pipelines import trim
+
+        return trim.trim_reads(self, trim_start, trim_end)
+
+    def trim_low_quality_read_groups(self, phred_threshold: int = 20):
+        from adam_tpu.pipelines import trim
+
+        return trim.trim_low_quality_read_groups(self, phred_threshold)
+
+    # ------------------------------------------------------------ analyses
+    def flagstat(self):
+        from adam_tpu.ops import flagstat
+
+        return flagstat.flagstat(self.batch)
+
+    def count_kmers(self, k: int):
+        from adam_tpu.ops import kmer
+
+        return kmer.count_kmers(self.batch, k)
+
+    def count_qmers(self, k: int):
+        from adam_tpu.ops import kmer
+
+        return kmer.count_qmers(self.batch, k)

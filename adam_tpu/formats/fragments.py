@@ -1,0 +1,107 @@
+"""Columnar reference-fragment batches.
+
+The reference chops contigs into fixed-length ``NucleotideContigFragment``
+records (default 10 kbp — rdd/ADAMContext.scala:443-456,
+converters/FastaConverter.scala:133-185) so a genome becomes a distributed
+dataset like any other.  :class:`FragmentBatch` is the columnar analog: one
+row per fragment, fixed padded width, device-resident — the natural shard
+unit for the genome axis of the mesh, with halo (flank) exchange between
+neighbors for windowed ops (FlankReferenceFragments.scala:26-70).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adam_tpu.formats import schema
+
+Array = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class FragmentBatch:
+    bases: Array        # u8[N, F] base codes, BASE_PAD beyond length
+    lengths: Array      # i32[N]
+    contig_idx: Array   # i32[N]
+    start: Array        # i64[N]  fragment start on contig
+    fragment_number: Array  # i32[N]
+    num_fragments: Array    # i32[N] total fragments in contig
+    valid: Array        # bool[N]
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.bases.shape[0])
+
+    @property
+    def fmax(self) -> int:
+        return int(self.bases.shape[1])
+
+    def replace(self, **kw) -> "FragmentBatch":
+        return dataclasses.replace(self, **kw)
+
+    def take(self, idx) -> "FragmentBatch":
+        return jax.tree.map(lambda x: jnp.asarray(x)[idx], self)
+
+    def to_numpy(self) -> "FragmentBatch":
+        return jax.tree.map(np.asarray, self)
+
+    @staticmethod
+    def from_sequences(
+        seqs: Sequence[tuple[int, str]],
+        fragment_length: int = 10_000,
+    ) -> "FragmentBatch":
+        """(contig_idx, sequence) pairs -> fragment rows."""
+        rows = []
+        for contig_idx, seq in seqs:
+            nfrag = max(1, -(-len(seq) // fragment_length))
+            for k in range(nfrag):
+                chunk = seq[k * fragment_length : (k + 1) * fragment_length]
+                rows.append((contig_idx, k * fragment_length, k, nfrag, chunk))
+        n = len(rows)
+        fmax = max((len(r[4]) for r in rows), default=1)
+        out = FragmentBatch(
+            bases=np.full((n, fmax), schema.BASE_PAD, np.uint8),
+            lengths=np.zeros(n, np.int32),
+            contig_idx=np.zeros(n, np.int32),
+            start=np.zeros(n, np.int64),
+            fragment_number=np.zeros(n, np.int32),
+            num_fragments=np.zeros(n, np.int32),
+            valid=np.ones(n, bool),
+        )
+        for i, (c, s, k, nf, chunk) in enumerate(rows):
+            out.bases[i, : len(chunk)] = schema.encode_bases(chunk)
+            out.lengths[i] = len(chunk)
+            out.contig_idx[i] = c
+            out.start[i] = s
+            out.fragment_number[i] = k
+            out.num_fragments[i] = nf
+        return out
+
+    def extract_region(self, contig_idx: int, start: int, end: int) -> str:
+        """Reconstruct [start, end) on a contig from fragments
+        (adamGetReferenceString semantics, NucleotideContigFragmentRDDFunctions.scala:61)."""
+        b = self.to_numpy()
+        pieces = []
+        for i in np.argsort(np.asarray(b.start), kind="stable"):
+            if not b.valid[i] or int(b.contig_idx[i]) != contig_idx:
+                continue
+            fs = int(b.start[i])
+            fe = fs + int(b.lengths[i])
+            lo, hi = max(fs, start), min(fe, end)
+            if lo < hi:
+                pieces.append(
+                    schema.decode_bases(b.bases[i][lo - fs : hi - fs])
+                )
+        got = "".join(pieces)
+        if len(got) != end - start:
+            raise KeyError(
+                f"region {contig_idx}:{start}-{end} not fully covered by fragments"
+            )
+        return got

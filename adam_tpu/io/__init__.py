@@ -1,0 +1,19 @@
+from adam_tpu.io import sam, fastq, fasta
+from adam_tpu.io.context import (
+    load_alignments,
+    load_bam,
+    load_fasta,
+    load_fastq,
+    load_interleaved_fastq,
+)
+
+__all__ = [
+    "sam",
+    "fastq",
+    "fasta",
+    "load_alignments",
+    "load_bam",
+    "load_fasta",
+    "load_fastq",
+    "load_interleaved_fastq",
+]

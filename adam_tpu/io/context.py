@@ -1,0 +1,121 @@
+"""Load dispatch — the ADAMContext analog.
+
+Format sniffing by file extension, mirroring the dispatch of
+``rdd/ADAMContext.loadAlignments`` (:484-511): .sam/.bam -> SAM/BAM codec,
+.ifq -> interleaved FASTQ, .fq/.fastq -> unpaired FASTQ, .fa/.fasta ->
+FASTA fragments converted to unaligned reads, anything else -> Parquet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import pack_reads
+from adam_tpu.io.sam import SamHeader
+
+
+def load_bam(path: str, **kw) -> AlignmentDataset:
+    from adam_tpu.io import sam
+
+    batch, side, header = sam.read_bam(path, **kw)
+    return AlignmentDataset(batch, side, header)
+
+
+def load_sam(path: str, **kw) -> AlignmentDataset:
+    from adam_tpu.io import sam
+
+    batch, side, header = sam.read_sam(path, **kw)
+    return AlignmentDataset(batch, side, header)
+
+
+def load_fastq(path: str, **kw) -> AlignmentDataset:
+    from adam_tpu.io import fastq
+
+    batch, side, header = fastq.read_fastq(path, **kw)
+    return AlignmentDataset(batch, side, header)
+
+
+def load_interleaved_fastq(path: str, **kw) -> AlignmentDataset:
+    from adam_tpu.io import fastq
+
+    batch, side, header = fastq.read_interleaved_fastq(path, **kw)
+    return AlignmentDataset(batch, side, header)
+
+
+def load_paired_fastq(path1: str, path2: str) -> AlignmentDataset:
+    from adam_tpu.formats.batch import ReadBatch, ReadSidecar
+    from adam_tpu.io import fastq
+
+    b1, s1, _ = fastq.read_fastq(path1, set_first_of_pair=True)
+    b2, s2, _ = fastq.read_fastq(path2, set_second_of_pair=True)
+    return AlignmentDataset(
+        ReadBatch.concat([b1, b2]), ReadSidecar.concat([s1, s2]), SamHeader()
+    )
+
+
+def load_fasta(path: str, fragment_length: int = 10_000):
+    """FASTA -> (FragmentBatch, SequenceDictionary, descriptions)."""
+    from adam_tpu.io import fasta
+
+    return fasta.read_fasta(path, fragment_length)
+
+
+def load_fasta_reads(path: str) -> AlignmentDataset:
+    """FASTA contigs as synthetic unaligned reads (loadAlignments .fa branch,
+    via FragmentConverter semantics)."""
+    from adam_tpu.io import fasta
+
+    fragments, seq_dict, _ = fasta.read_fasta(path, fragment_length=2**31 - 1)
+    b = fragments.to_numpy()
+    records = []
+    for i in range(b.n_rows):
+        if not b.valid[i]:
+            continue
+        seq = schema.decode_bases(b.bases[i][: int(b.lengths[i])])
+        records.append(
+            dict(
+                name=seq_dict.names[int(b.contig_idx[i])],
+                flags=0,
+                contig_idx=int(b.contig_idx[i]),
+                start=int(b.start[i]),
+                mapq=255,
+                cigar=f"{len(seq)}M",
+                seq=seq,
+                qual="*",
+            )
+        )
+    batch, side = pack_reads(records)
+    header = SamHeader(seq_dict=seq_dict)
+    return AlignmentDataset(batch, side, header)
+
+
+def load_parquet_alignments(
+    path: str,
+    projection: Optional[Sequence[str]] = None,
+    predicate=None,
+    **kw,
+) -> AlignmentDataset:
+    from adam_tpu.io import parquet
+
+    batch, side, header = parquet.load_alignments(
+        path, projection=projection, predicate=predicate, **kw
+    )
+    return AlignmentDataset(batch, side, header)
+
+
+def load_alignments(path: str, **kw) -> AlignmentDataset:
+    p = str(path)
+    base = p[:-3] if p.endswith(".gz") else p
+    if base.endswith(".sam"):
+        return load_sam(path, **kw)
+    if base.endswith(".bam"):
+        return load_bam(path, **kw)
+    if base.endswith(".ifq"):
+        return load_interleaved_fastq(path, **kw)
+    if base.endswith((".fq", ".fastq")):
+        return load_fastq(path, **kw)
+    if base.endswith((".fa", ".fasta")):
+        return load_fasta_reads(path)
+    return load_parquet_alignments(path, **kw)

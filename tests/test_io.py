@@ -1,0 +1,193 @@
+import numpy as np
+import pytest
+
+from adam_tpu.formats import schema
+from adam_tpu.io import context as ctx
+from adam_tpu.io import fasta as fasta_io
+from adam_tpu.io import fastq as fastq_io
+from adam_tpu.io import sam as sam_io
+from adam_tpu.io import parquet as pq_io
+
+
+def test_read_sam_reads12(ref_resources):
+    ds = ctx.load_alignments(str(ref_resources / "reads12.sam"))
+    assert len(ds) == 200
+    assert ds.seq_dict.names[:2] == ["1", "2"]
+    b = ds.batch.to_numpy()
+    # first record: simread:1:26472783:false flag 16 pos 26472784 (1-based)
+    assert ds.sidecar.names[0] == "simread:1:26472783:false"
+    assert int(b.flags[0]) == 16
+    assert int(b.start[0]) == 26472783
+    assert int(b.end[0]) == 26472783 + 75
+    assert int(b.mapq[0]) == 60
+    assert schema.decode_bases(b.bases[0], 10) == "GTATAAGAGC"
+
+
+def test_sam_roundtrip(ref_resources, tmp_path):
+    src = str(ref_resources / "small.sam")
+    ds = ctx.load_alignments(src)
+    out = tmp_path / "out.sam"
+    ds.save(str(out))
+    ds2 = ctx.load_alignments(str(out))
+    assert len(ds2) == len(ds)
+    b1, b2 = ds.batch.to_numpy(), ds2.batch.to_numpy()
+    np.testing.assert_array_equal(b1.start, b2.start)
+    np.testing.assert_array_equal(b1.flags, b2.flags)
+    np.testing.assert_array_equal(b1.bases, b2.bases)
+    np.testing.assert_array_equal(b1.quals, b2.quals)
+    assert ds.sidecar.names == ds2.sidecar.names
+    assert ds.sidecar.attrs == ds2.sidecar.attrs
+
+
+def test_bam_roundtrip(ref_resources, tmp_path):
+    ds = ctx.load_alignments(str(ref_resources / "reads12.sam"))
+    out = tmp_path / "out.bam"
+    ds.save(str(out))
+    ds2 = ctx.load_alignments(str(out))
+    assert len(ds2) == len(ds)
+    b1, b2 = ds.batch.to_numpy(), ds2.batch.to_numpy()
+    np.testing.assert_array_equal(b1.start, b2.start)
+    np.testing.assert_array_equal(b1.flags, b2.flags)
+    np.testing.assert_array_equal(b1.bases, b2.bases)
+    np.testing.assert_array_equal(b1.cigar_ops, b2.cigar_ops)
+    assert ds.sidecar.names == ds2.sidecar.names
+    assert ds.sidecar.attrs == ds2.sidecar.attrs
+    assert ds2.seq_dict.names == ds.seq_dict.names
+
+
+def test_bgzf_blocks(tmp_path):
+    data = b"x" * 200_000
+    comp = sam_io.bgzf_compress(data)
+    assert comp.endswith(sam_io.BGZF_EOF)
+    assert sam_io.bgzf_decompress(comp) == data
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4])
+def test_interleaved_fastq_fixtures(ref_resources, n):
+    path = ref_resources / f"interleaved_fastq_sample{n}.ifq"
+    ds = ctx.load_alignments(str(path))
+    b = ds.batch.to_numpy()
+    assert len(ds) % 2 == 0
+    assert (b.flags[b.valid] & schema.FLAG_PAIRED).all()
+    firsts = (b.flags[b.valid] & schema.FLAG_FIRST_OF_PAIR) != 0
+    assert firsts[0::2].all() and not firsts[1::2].any()
+    # names are paired and /1 /2 stripped
+    assert ds.sidecar.names[0] == ds.sidecar.names[1]
+    assert not ds.sidecar.names[0].endswith("/1")
+
+
+def _golden_records(path):
+    """Extract FASTQ records from the Java InputFormat golden .output files
+    (records delimited by >>>...start>>> / <<<...end<<< markers)."""
+    body = [
+        l
+        for l in path.read_text().splitlines()
+        if not (l.startswith(">>>") or l.startswith("<<<"))
+    ]
+    return list(fastq_io.split_fastq_records(body))
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4])
+def test_interleaved_record_boundaries_golden(ref_resources, n):
+    """Split resync matches the Java InterleavedFastqInputFormat golden output."""
+    lines = (
+        (ref_resources / f"interleaved_fastq_sample{n}.ifq").read_text().splitlines()
+    )
+    recs = list(fastq_io.split_fastq_records(lines, resync=True, interleaved=True))
+    golden = _golden_records(ref_resources / f"interleaved_fastq_sample{n}.ifq.output")
+    assert recs == golden
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4])
+def test_single_record_boundaries_golden(ref_resources, n):
+    """Split resync matches the Java SingleFastqInputFormat golden output."""
+    lines = (ref_resources / f"fastq_sample{n}.fq").read_text().splitlines()
+    recs = list(fastq_io.split_fastq_records(lines, resync=True))
+    golden = _golden_records(ref_resources / f"single_fastq_sample{n}.fq.output")
+    assert recs == golden
+
+
+def test_multiline_fastq(ref_resources):
+    lines = (ref_resources / "multiline_fastq.fq").read_text().splitlines()
+    recs = list(fastq_io.split_fastq_records(lines))
+    # multiline file has same records as sample1 single-line file
+    single = (ref_resources / "interleaved_fastq_sample1.ifq").read_text().splitlines()
+    srecs = list(fastq_io.split_fastq_records(single))
+    assert [(r[1], r[2]) for r in recs] == [(r[1], r[2]) for r in srecs]
+
+
+def test_fastq_roundtrip(ref_resources, tmp_path):
+    ds = ctx.load_interleaved_fastq(str(ref_resources / "interleaved_fastq_sample1.ifq"))
+    out = tmp_path / "out.fq"
+    ds.save(str(out))
+    reread = out.read_text().splitlines()
+    orig = (ref_resources / "interleaved_fastq_sample1.ifq").read_text().splitlines()
+    assert reread == orig
+
+
+def test_paired_fastq_load_and_split(ref_resources, tmp_path):
+    ds = ctx.load_paired_fastq(
+        str(ref_resources / "proper_pairs_1.fq"),
+        str(ref_resources / "proper_pairs_2.fq"),
+    )
+    b = ds.batch.to_numpy()
+    assert (b.flags[b.valid] & schema.FLAG_PAIRED).all()
+    p1, p2 = tmp_path / "r1.fq", tmp_path / "r2.fq"
+    ds.save_paired_fastq(str(p1), str(p2))
+    assert p1.read_text().splitlines() == (
+        (ref_resources / "proper_pairs_1.fq").read_text().splitlines()
+    )
+
+
+def test_fasta_fragments_and_region(ref_resources):
+    frags, sd, descs = fasta_io.read_fasta(
+        str(ref_resources / "artificial.fa"), fragment_length=100
+    )
+    assert sd.names == ["artificial"]
+    total = sd["artificial"].length
+    assert frags.n_rows == -(-total // 100)
+    region = frags.extract_region(0, 50, 170)
+    assert len(region) == 120
+    # cross-check against unfragmented read
+    frags1, _, _ = fasta_io.read_fasta(str(ref_resources / "artificial.fa"))
+    assert frags1.extract_region(0, 50, 170) == region
+
+
+def test_fasta_roundtrip(ref_resources, tmp_path):
+    frags, sd, _ = fasta_io.read_fasta(str(ref_resources / "artificial.fa"))
+    out = tmp_path / "out.fa"
+    fasta_io.write_fasta(str(out), frags, sd)
+    frags2, sd2, _ = fasta_io.read_fasta(str(out))
+    assert sd2.names == sd.names
+    assert frags2.extract_region(0, 0, sd["artificial"].length) == frags.extract_region(
+        0, 0, sd["artificial"].length
+    )
+
+
+def test_parquet_roundtrip(ref_resources, tmp_path):
+    ds = ctx.load_alignments(str(ref_resources / "small.sam"))
+    out = tmp_path / "small.adam"
+    ds.save(str(out))
+    ds2 = ctx.load_alignments(str(out))
+    assert len(ds2) == len(ds)
+    b1, b2 = ds.batch.to_numpy(), ds2.batch.to_numpy()
+    np.testing.assert_array_equal(b1.start, b2.start)
+    np.testing.assert_array_equal(b1.bases, b2.bases)
+    assert ds2.seq_dict.names == ds.seq_dict.names
+    assert ds2.sidecar.names == ds.sidecar.names
+
+
+def test_parquet_projection_predicate(ref_resources, tmp_path):
+    import pyarrow.compute as pc
+
+    ds = ctx.load_alignments(str(ref_resources / "reads12.sam"))
+    out = tmp_path / "reads12.adam"
+    ds.save(str(out))
+    proj = ctx.load_parquet_alignments(str(out), projection=["sequence", "flags"])
+    assert len(proj) == len(ds)
+    assert all(n == "" for n in proj.sidecar.names)  # readName pruned
+    filt = ctx.load_parquet_alignments(
+        str(out), predicate=pc.field("start") < 100_000_000
+    )
+    assert 0 < len(filt) < len(ds)
+    assert (np.asarray(filt.batch.start)[np.asarray(filt.batch.valid)] < 1e8).all()
