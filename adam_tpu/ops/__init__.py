@@ -1,0 +1,3 @@
+from adam_tpu.ops import cigar, flagstat, kmer, mdtag, phred, smith_waterman
+
+__all__ = ["cigar", "flagstat", "kmer", "mdtag", "phred", "smith_waterman"]

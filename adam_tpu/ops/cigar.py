@@ -1,0 +1,124 @@
+"""Batched CIGAR walks on device.
+
+The reference walks htsjdk Cigar objects per read on the JVM
+(``rich/RichAlignmentRecord.scala``: referenceLengthFromCigar :41-57,
+unclippedStart/End :110-121, fivePrimePosition :124-126, per-base
+referencePositions :200-229).  Here every walk is a masked reduction over
+the ``[N, C]`` cigar columns, so one XLA fusion covers the whole batch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from adam_tpu.formats import schema
+
+
+def _op_table(table):
+    return jnp.asarray(table)
+
+
+def _valid_mask(cigar_ops, cigar_n):
+    C = cigar_ops.shape[-1]
+    return jnp.arange(C) < cigar_n[..., None]
+
+
+def reference_length(cigar_ops, cigar_lens, cigar_n):
+    """Reference bases consumed by each read's CIGAR (M/D/N/=/X)."""
+    consumes = _op_table(schema.CIGAR_CONSUMES_REF)[cigar_ops]
+    v = _valid_mask(cigar_ops, cigar_n)
+    return jnp.sum(cigar_lens * consumes * v, axis=-1).astype(jnp.int64)
+
+
+def query_length(cigar_ops, cigar_lens, cigar_n):
+    """Query bases consumed (M/I/S/=/X)."""
+    consumes = _op_table(schema.CIGAR_CONSUMES_QUERY)[cigar_ops]
+    v = _valid_mask(cigar_ops, cigar_n)
+    return jnp.sum(cigar_lens * consumes * v, axis=-1).astype(jnp.int32)
+
+
+def _is_clip(cigar_ops):
+    return (cigar_ops == schema.CIGAR_S) | (cigar_ops == schema.CIGAR_H)
+
+
+def leading_clip(cigar_ops, cigar_lens, cigar_n):
+    """Total clipped (S+H) length at the start of each read."""
+    v = _valid_mask(cigar_ops, cigar_n)
+    clip = _is_clip(cigar_ops) & v
+    run = jnp.cumprod(clip.astype(jnp.int32), axis=-1)  # 1 while still clipping
+    return jnp.sum(cigar_lens * run, axis=-1).astype(jnp.int64)
+
+
+def trailing_clip(cigar_ops, cigar_lens, cigar_n):
+    """Total clipped (S+H) length at the end of each read.
+
+    Padding lanes (beyond cigar_n) must not break the trailing run, so the
+    run predicate is clip-or-pad, and only real clip lanes contribute."""
+    v = _valid_mask(cigar_ops, cigar_n)
+    clip = _is_clip(cigar_ops) & v
+    run_pred = (clip | ~v).astype(jnp.int32)
+    run = jnp.flip(jnp.cumprod(jnp.flip(run_pred, axis=-1), axis=-1), axis=-1)
+    return jnp.sum(cigar_lens * clip * run, axis=-1).astype(jnp.int64)
+
+
+def unclipped_start(start, cigar_ops, cigar_lens, cigar_n):
+    """start - leading clips (RichAlignmentRecord.unclippedStart)."""
+    return start - leading_clip(cigar_ops, cigar_lens, cigar_n)
+
+
+def unclipped_end(end, cigar_ops, cigar_lens, cigar_n):
+    """end + trailing clips (end is 0-based exclusive here; the reference's
+    unclippedEnd is inclusive — callers converting to reference semantics
+    subtract 1)."""
+    return end + trailing_clip(cigar_ops, cigar_lens, cigar_n)
+
+
+def five_prime_position(start, end, flags, cigar_ops, cigar_lens, cigar_n):
+    """5' reference position with clipping (fivePrimePosition semantics):
+    unclipped end-1 for reverse-strand reads, unclipped start otherwise.
+
+    Duplicate marking keys on this (ReferencePositionPair via
+    RichAlignmentRecord.fivePrimeReferencePosition)."""
+    rev = (flags & schema.FLAG_REVERSE) != 0
+    us = unclipped_start(start, cigar_ops, cigar_lens, cigar_n)
+    ue = unclipped_end(end, cigar_ops, cigar_lens, cigar_n) - 1
+    return jnp.where(rev, ue, us)
+
+
+def first_real_op(cigar_ops, cigar_n):
+    """Code of the first non-clip op, CIGAR_PAD if none."""
+    C = cigar_ops.shape[-1]
+    v = _valid_mask(cigar_ops, cigar_n)
+    real = v & ~_is_clip(cigar_ops)
+    idx = jnp.argmax(real, axis=-1)
+    any_real = jnp.any(real, axis=-1)
+    got = jnp.take_along_axis(cigar_ops, idx[..., None], axis=-1)[..., 0]
+    return jnp.where(any_real, got, schema.CIGAR_PAD)
+
+
+def reference_positions(cigar_ops, cigar_lens, cigar_n, start, lmax):
+    """Per-base reference position for each read -> i64[N, lmax].
+
+    -1 for bases that don't map to the reference (insertions, soft clips)
+    and for padding lanes — the role of
+    RichAlignmentRecord.referencePositions (:200-229).
+
+    Implemented as a scan-free gather: for each cigar op we know the query
+    span [q0, q1) and the reference offset at q0; a base at query index j
+    inside an M/=/X op maps to start + refoff + (j - q0).
+    """
+    consumes_q = _op_table(schema.CIGAR_CONSUMES_QUERY)[cigar_ops]
+    consumes_r = _op_table(schema.CIGAR_CONSUMES_REF)[cigar_ops]
+    v = _valid_mask(cigar_ops, cigar_n).astype(jnp.int64)
+    qlen = cigar_lens * consumes_q * v  # query span per op
+    rlen = cigar_lens * consumes_r * v
+    q0 = jnp.cumsum(qlen, axis=-1) - qlen  # query offset at op start
+    r0 = jnp.cumsum(rlen, axis=-1) - rlen  # ref offset at op start
+    aligned = (consumes_q * consumes_r * v).astype(bool)  # M/=/X
+
+    j = jnp.arange(lmax)[None, None, :]  # [1, 1, L]
+    in_op = (j >= q0[..., None]) & (j < (q0 + qlen)[..., None]) & aligned[..., None]
+    pos = start[..., None, None] + r0[..., None] + (j - q0[..., None])
+    out = jnp.sum(jnp.where(in_op, pos, 0), axis=-2)
+    hit = jnp.any(in_op, axis=-2)
+    return jnp.where(hit, out, -1)

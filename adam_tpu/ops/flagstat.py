@@ -1,0 +1,155 @@
+"""samtools-flagstat metrics as one fused mask-reduction pass.
+
+Matches the metric definitions of ``rdd/read/FlagStat.scala:24-119``
+(FlagStatMetrics / DuplicateMetrics, split by vendor-quality flag).  The
+reference computes a per-record metrics object then tree-aggregates; here
+each metric is a masked ``sum`` over the batch — a single XLA reduction
+kernel — and the cross-device combine is a ``psum`` (see
+adam_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+
+import jax
+import jax.numpy as jnp
+
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import ReadBatch
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class DuplicateMetrics:
+    total: jnp.ndarray
+    both_mapped: jnp.ndarray
+    only_read_mapped: jnp.ndarray
+    cross_chromosome: jnp.ndarray
+
+    def __add__(self, other):
+        return jax.tree.map(lambda a, b: a + b, self, other)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class FlagStatMetrics:
+    total: jnp.ndarray
+    duplicates_primary: DuplicateMetrics
+    duplicates_secondary: DuplicateMetrics
+    mapped: jnp.ndarray
+    paired_in_sequencing: jnp.ndarray
+    read1: jnp.ndarray
+    read2: jnp.ndarray
+    properly_paired: jnp.ndarray
+    with_self_and_mate_mapped: jnp.ndarray
+    singleton: jnp.ndarray
+    with_mate_mapped_to_diff_chromosome: jnp.ndarray
+    with_mate_mapped_to_diff_chromosome_mapq5: jnp.ndarray
+
+    def __add__(self, other):
+        return jax.tree.map(lambda a, b: a + b, self, other)
+
+    def to_ints(self) -> "FlagStatMetrics":
+        return jax.tree.map(int, self)
+
+
+def _metrics_for(b: ReadBatch, select) -> FlagStatMetrics:
+    """Mask-reduce metrics over rows where ``select`` holds."""
+    flags = b.flags
+
+    def has(bit):
+        return (flags & bit) != 0
+
+    mapped = ~has(schema.FLAG_UNMAPPED)
+    mate_mapped = ~has(schema.FLAG_MATE_UNMAPPED)
+    paired = has(schema.FLAG_PAIRED)
+    primary = ~has(schema.FLAG_SECONDARY)
+    dup = has(schema.FLAG_DUPLICATE)
+    # isSameContig(contig, mateContig): name equality, null==null included
+    # (util/Util.scala:24-30) — index equality reproduces it (-1 == -1).
+    same_contig = b.contig_idx == b.mate_contig_idx
+    diff_chrom = paired & mapped & mate_mapped & ~same_contig
+
+    def count(mask):
+        return jnp.sum((mask & select).astype(jnp.int64))
+
+    def dup_metrics(which):
+        m = dup & which
+        return DuplicateMetrics(
+            total=count(m),
+            both_mapped=count(m & mapped & mate_mapped),
+            only_read_mapped=count(m & mapped & ~mate_mapped),
+            cross_chromosome=count(m & ~same_contig),
+        )
+
+    return FlagStatMetrics(
+        total=count(jnp.ones_like(mapped)),
+        duplicates_primary=dup_metrics(primary),
+        duplicates_secondary=dup_metrics(~primary),
+        mapped=count(mapped),
+        paired_in_sequencing=count(paired),
+        read1=count(paired & has(schema.FLAG_FIRST_OF_PAIR)),
+        read2=count(paired & has(schema.FLAG_SECOND_OF_PAIR)),
+        properly_paired=count(paired & has(schema.FLAG_PROPER_PAIR)),
+        with_self_and_mate_mapped=count(paired & mapped & mate_mapped),
+        singleton=count(paired & mapped & ~mate_mapped),
+        with_mate_mapped_to_diff_chromosome=count(diff_chrom),
+        with_mate_mapped_to_diff_chromosome_mapq5=count(diff_chrom & (b.mapq >= 5)),
+    )
+
+
+@jax.jit
+def flagstat_device(b: ReadBatch) -> tuple[FlagStatMetrics, FlagStatMetrics]:
+    """-> (failed_vendor_quality, passed_vendor_quality) metric structs."""
+    failed = ((b.flags & schema.FLAG_FAILED_QC) != 0) & b.valid
+    passed = ((b.flags & schema.FLAG_FAILED_QC) == 0) & b.valid
+    return _metrics_for(b, failed), _metrics_for(b, passed)
+
+
+def flagstat(b: ReadBatch) -> tuple[FlagStatMetrics, FlagStatMetrics]:
+    failed, passed = flagstat_device(b.to_device())
+    return failed.to_ints(), passed.to_ints()
+
+
+def format_flagstat(failed: FlagStatMetrics, passed: FlagStatMetrics) -> str:
+    """samtools-flagstat-style text report, matching the reference CLI's
+    format string (adam-cli FlagStat.scala:70-112): all percentages are
+    over `total`, and a zero denominator prints 0.00%."""
+    def pct(num, den):
+        return f"{100.0 * num / den:.2f}%" if den else "0.00%"
+
+    p, f = passed, failed
+    lines = [
+        f"{p.total} + {f.total} in total (QC-passed reads + QC-failed reads)",
+        f"{p.duplicates_primary.total} + {f.duplicates_primary.total} primary duplicates",
+        f"{p.duplicates_primary.both_mapped} + {f.duplicates_primary.both_mapped} "
+        "primary duplicates - both read and mate mapped",
+        f"{p.duplicates_primary.only_read_mapped} + {f.duplicates_primary.only_read_mapped} "
+        "primary duplicates - only read mapped",
+        f"{p.duplicates_primary.cross_chromosome} + {f.duplicates_primary.cross_chromosome} "
+        "primary duplicates - cross chromosome",
+        f"{p.duplicates_secondary.total} + {f.duplicates_secondary.total} secondary duplicates",
+        f"{p.duplicates_secondary.both_mapped} + {f.duplicates_secondary.both_mapped} "
+        "secondary duplicates - both read and mate mapped",
+        f"{p.duplicates_secondary.only_read_mapped} + {f.duplicates_secondary.only_read_mapped} "
+        "secondary duplicates - only read mapped",
+        f"{p.duplicates_secondary.cross_chromosome} + {f.duplicates_secondary.cross_chromosome} "
+        "secondary duplicates - cross chromosome",
+        f"{p.mapped} + {f.mapped} mapped ({pct(p.mapped, p.total)}:{pct(f.mapped, f.total)})",
+        f"{p.paired_in_sequencing} + {f.paired_in_sequencing} paired in sequencing",
+        f"{p.read1} + {f.read1} read1",
+        f"{p.read2} + {f.read2} read2",
+        f"{p.properly_paired} + {f.properly_paired} properly paired "
+        f"({pct(p.properly_paired, p.total)}:{pct(f.properly_paired, f.total)})",
+        f"{p.with_self_and_mate_mapped} + {f.with_self_and_mate_mapped} "
+        "with itself and mate mapped",
+        f"{p.singleton} + {f.singleton} singletons "
+        f"({pct(p.singleton, p.total)}:{pct(f.singleton, f.total)})",
+        f"{p.with_mate_mapped_to_diff_chromosome} + "
+        f"{f.with_mate_mapped_to_diff_chromosome} with mate mapped to a different chr",
+        f"{p.with_mate_mapped_to_diff_chromosome_mapq5} + "
+        f"{f.with_mate_mapped_to_diff_chromosome_mapq5} "
+        "with mate mapped to a different chr (mapQ>=5)",
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,128 @@
+"""k-mer and q-mer counting kernels.
+
+The reference counts k-mers with ``sliding(k)`` + ``reduceByKey``
+(rdd/read/AlignmentRecordRDDFunctions.scala:218-226) and quality-weighted
+q-mers (Quake-style) in ``correction/ErrorCorrection.scala:43-80``.
+
+TPU formulation: every window of every read is packed into a single
+integer key — 3 bits per base so N is representable, k <= 21 fits an i64
+— extracted with one gather per window offset (an [N, W, k] gather XLA
+vectorizes), then counted by sort + run-length on device.  The cross-chip
+combine is a hash-sharded all-to-all (adam_tpu.parallel.kmers).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import ReadBatch
+from adam_tpu.ops.phred import phred_to_success_probability
+
+MAX_PACKED_K = 21  # 3 bits/base in a signed i64
+
+
+@partial(jax.jit, static_argnames=("k",))
+def extract_kmers(bases, lengths, valid, k: int):
+    """-> (packed i64[N, W], window_valid bool[N, W]) with W = L - k + 1.
+
+    A window is valid when fully inside the read and the row is valid.
+    N bases participate (code 4) — matching the reference, which counts
+    k-mer *strings* and therefore keeps N-containing k-mers distinct.
+    """
+    n, L = bases.shape
+    W = max(L - k + 1, 1)
+    if k > MAX_PACKED_K:
+        raise ValueError(f"k={k} exceeds packed maximum {MAX_PACKED_K}")
+    offs = jnp.arange(W)[:, None] + jnp.arange(k)[None, :]  # [W, k]
+    windows = bases[:, offs].astype(jnp.int64)  # [N, W, k]
+    shifts = jnp.arange(k - 1, -1, -1, dtype=jnp.int64) * 3
+    packed = jnp.sum(windows << shifts, axis=-1)
+    win_valid = (jnp.arange(W)[None, :] + k <= lengths[:, None]) & valid[:, None]
+    return packed, win_valid
+
+
+def pack_kmer_string(s: str) -> int:
+    v = 0
+    for ch in s:
+        v = (v << 3) | int(schema.BASE_ENCODE_LUT[ord(ch)])
+    return v
+
+
+def unpack_kmer(packed: int, k: int) -> str:
+    chars = []
+    for i in range(k):
+        chars.append("ACGTN"[(packed >> (3 * (k - 1 - i))) & 0x7])
+    return "".join(chars)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def device_kmer_histogram(bases, lengths, valid, k: int):
+    """Sort-based local count: -> (sorted_kmers i64[M], counts i32[M], is_head bool[M]).
+
+    Invalid windows pack to sentinel -1 and sort first; ``is_head`` marks
+    the first row of each run of equal keys (excluding the sentinel), so
+    (sorted_kmers[is_head], counts[is_head]) is the unique histogram.
+    """
+    packed, win_valid = extract_kmers(bases, lengths, valid, k)
+    flat = jnp.where(win_valid, packed, jnp.int64(-1)).ravel()
+    s = jnp.sort(flat)
+    is_new = jnp.concatenate([jnp.array([True]), s[1:] != s[:-1]])
+    is_head = is_new & (s >= 0)
+    # run lengths via segment ids
+    seg = jnp.cumsum(is_new) - 1
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(s, jnp.int32), seg, num_segments=s.shape[0]
+    )
+    run_counts = counts[seg]  # broadcast back; only head rows meaningful
+    return s, run_counts, is_head
+
+
+def count_kmers(batch: ReadBatch, k: int) -> dict[str, int]:
+    """Exact k-mer counts over all reads (sequence strings, N included)."""
+    if batch.n_rows == 0:
+        return {}
+    b = batch.to_device()
+    s, run_counts, is_head = device_kmer_histogram(b.bases, b.lengths, b.valid, k)
+    s, run_counts, is_head = np.asarray(s), np.asarray(run_counts), np.asarray(is_head)
+    keys = s[is_head]
+    vals = run_counts[is_head]
+    return {unpack_kmer(int(key), k): int(v) for key, v in zip(keys, vals)}
+
+
+@partial(jax.jit, static_argnames=("k",))
+def device_qmer_weights(bases, quals, lengths, valid, k: int):
+    """-> (packed i64[N*W], weight f64[N*W]) with weight = prod of base
+    success probabilities (Quake q-mer weight, ErrorCorrection.scala:59-80);
+    invalid windows have weight 0 and key -1."""
+    packed, win_valid = extract_kmers(bases, lengths, valid, k)
+    n, L = bases.shape
+    W = packed.shape[1]
+    succ = phred_to_success_probability(quals)
+    offs = jnp.arange(W)[:, None] + jnp.arange(k)[None, :]
+    wins = succ[:, offs]  # [N, W, k]
+    weights = jnp.prod(wins, axis=-1)
+    flat_keys = jnp.where(win_valid, packed, jnp.int64(-1)).ravel()
+    flat_w = jnp.where(win_valid, weights, 0.0).ravel()
+    return flat_keys, flat_w
+
+
+def count_qmers(batch: ReadBatch, k: int) -> dict[str, float]:
+    if batch.n_rows == 0:
+        return {}
+    b = batch.to_device()
+    keys, weights = device_qmer_weights(b.bases, b.quals, b.lengths, b.valid, k)
+    keys, weights = np.asarray(keys), np.asarray(weights)
+    order = np.argsort(keys, kind="stable")
+    keys, weights = keys[order], weights[order]
+    uniq, start_idx = np.unique(keys, return_index=True)
+    sums = np.add.reduceat(weights, start_idx)
+    return {
+        unpack_kmer(int(key), k): float(w)
+        for key, w in zip(uniq, sums)
+        if key >= 0
+    }

@@ -1,0 +1,306 @@
+"""MD ("mismatchingPositions") tag engine.
+
+Host-side implementation of the reference's ``util/MdTag.scala``: parse
+(:47-109), regeneration from a (read, reference, cigar) alignment
+(:255-304), ``moveAlignment`` after realignment (:148-244), reference
+reconstruction ``getReference`` (:410-458) and the canonical ``toString``
+FSM (:466-532).  Equality = (start, canonical string), as in the
+reference.
+
+The device-facing entry point is :func:`batch_md_arrays`, which turns a
+batch's MD strings into per-base columns (is-mismatch mask + reference
+base codes) that BQSR and realignment kernels consume.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from adam_tpu.formats import schema
+
+_DIGITS = re.compile(r"[0-9]+")
+# Full IUPAC ambiguity alphabet, as the reference's basesPattern accepts
+# (util/MdTag.scala digitPattern/basesPattern definitions).
+_BASES = re.compile(r"[AGCTNUKMRSWBVHDXY]+")
+
+
+def parse_cigar(cigar: str) -> list[tuple[int, str]]:
+    """'4M2D3M' -> [(4,'M'), (2,'D'), (3,'M')]; '*' -> []."""
+    if not cigar or cigar == "*":
+        return []
+    out = []
+    num = 0
+    for ch in cigar:
+        if ch.isdigit():
+            num = num * 10 + ord(ch) - 48
+        else:
+            out.append((num, ch))
+            num = 0
+    return out
+
+
+@dataclass
+class MdTag:
+    start: int
+    matches: list = field(default_factory=list)  # [(start, end)) ref ranges
+    mismatches: dict = field(default_factory=dict)  # ref pos -> ref base
+    deletions: dict = field(default_factory=dict)  # ref pos -> ref base
+
+    # ----------------------------------------------------------- constructors
+    @staticmethod
+    def parse(md: str, reference_start: int) -> "MdTag":
+        """Parse an MD string at a given alignment start."""
+        tag = MdTag(reference_start)
+        if md is None or md == "0" or md == "":
+            return tag
+        s = md.upper()
+        offset = 0
+        pos = reference_start
+
+        def read_matches():
+            nonlocal offset, pos
+            m = _DIGITS.match(s, offset)
+            if not m:
+                raise ValueError(f"malformed MD tag {md!r} at offset {offset}")
+            length = int(m.group())
+            if length > 0:
+                tag.matches.append((pos, pos + length))
+            offset = m.end()
+            pos += length
+
+        read_matches()
+        while offset < len(s):
+            if s[offset] == "^":
+                offset += 1
+                m = _BASES.match(s, offset)
+                if not m:
+                    raise ValueError(f"malformed MD deletion in {md!r}")
+                for base in m.group():
+                    tag.deletions[pos] = base
+                    pos += 1
+                offset = m.end()
+            else:
+                m = _BASES.match(s, offset)
+                if not m:
+                    raise ValueError(f"malformed MD mismatch in {md!r}")
+                for base in m.group():
+                    tag.mismatches[pos] = base
+                    pos += 1
+                offset = m.end()
+            read_matches()
+        return tag
+
+    @staticmethod
+    def from_alignment(
+        read: str, reference: str, cigar: str, start: int
+    ) -> "MdTag":
+        """Generate the MD tag of aligning ``read`` against ``reference``
+        (reference string starting at the alignment start)."""
+        match_count = 0
+        del_count = 0
+        out = ""
+        read_pos = 0
+        ref_pos = 0
+        for length, op in parse_cigar(cigar):
+            if op in "M=X":
+                for _ in range(length):
+                    if read[read_pos] == reference[ref_pos]:
+                        match_count += 1
+                    else:
+                        out += str(match_count) + reference[ref_pos]
+                        match_count = 0
+                    read_pos += 1
+                    ref_pos += 1
+                    del_count = 0
+            elif op == "D":
+                for _ in range(length):
+                    if del_count == 0:
+                        out += str(match_count) + "^"
+                    out += reference[ref_pos]
+                    match_count = 0
+                    del_count += 1
+                    ref_pos += 1
+            elif op in "ISHP":
+                if op in "IS":
+                    read_pos += length
+            else:
+                raise ValueError(f"cannot handle CIGAR op {op} in MD generation")
+        out += str(match_count)
+        return MdTag.parse(out, start)
+
+    @staticmethod
+    def move_alignment(
+        reference: str,
+        sequence: str,
+        new_cigar: str,
+        read_start: int,
+    ) -> "MdTag":
+        """Recompute the tag for a new alignment of ``sequence`` against
+        ``reference`` (string beginning at ``read_start``)."""
+        tag = MdTag(read_start)
+        ref_pos = 0
+        read_pos = 0
+        for length, op in parse_cigar(new_cigar):
+            if op == "M":
+                range_start = 0
+                in_match = False
+                for _ in range(length):
+                    if reference[ref_pos] == sequence[read_pos]:
+                        if not in_match:
+                            range_start = ref_pos
+                            in_match = True
+                    else:
+                        if in_match:
+                            tag.matches.append(
+                                (range_start + read_start, ref_pos + read_start)
+                            )
+                            in_match = False
+                        tag.mismatches[ref_pos + read_start] = reference[ref_pos]
+                    read_pos += 1
+                    ref_pos += 1
+                if in_match:
+                    tag.matches.append(
+                        (range_start + read_start, ref_pos + read_start)
+                    )
+            elif op == "D":
+                for _ in range(length):
+                    tag.deletions[ref_pos + read_start] = reference[ref_pos]
+                    ref_pos += 1
+            elif op in "ISHP":
+                if op in "IS":
+                    read_pos += length
+            else:
+                raise ValueError(f"cannot handle CIGAR op {op}")
+        return tag
+
+    # --------------------------------------------------------------- queries
+    def is_match(self, pos: int) -> bool:
+        return any(s <= pos < e for s, e in self.matches)
+
+    def mismatched_base(self, pos: int):
+        return self.mismatches.get(pos)
+
+    def deleted_base(self, pos: int):
+        return self.deletions.get(pos)
+
+    def end(self) -> int:
+        """Largest reference position covered (inclusive)."""
+        candidates = [e - 1 for _, e in self.matches]
+        candidates += list(self.mismatches)
+        candidates += list(self.deletions)
+        return max(candidates) if candidates else self.start
+
+    def get_reference(self, read_sequence: str, cigar: str) -> str:
+        """Reconstruct the reference over the aligned span from the read."""
+        ref_pos = self.start
+        read_pos = 0
+        out = []
+        for length, op in parse_cigar(cigar):
+            if op in "M=X":
+                for _ in range(length):
+                    base = self.mismatches.get(ref_pos)
+                    out.append(base if base else read_sequence[read_pos])
+                    read_pos += 1
+                    ref_pos += 1
+            elif op == "D":
+                for _ in range(length):
+                    base = self.deletions.get(ref_pos)
+                    if base is None:
+                        raise ValueError(
+                            f"no deleted base recorded at ref pos {ref_pos}"
+                        )
+                    out.append(base)
+                    ref_pos += 1
+            elif op in "IS":
+                read_pos += length
+            elif op in "HP":
+                pass
+            else:
+                raise ValueError(f"cannot handle CIGAR op {op}")
+        return "".join(out)
+
+    # ------------------------------------------------------------- emission
+    def to_string(self) -> str:
+        if not self.matches and not self.mismatches and not self.deletions:
+            return "0"
+        out = []
+        last_was_match = False
+        last_was_deletion = False
+        match_run = 0
+        for i in range(self.start, self.end() + 1):
+            if self.is_match(i):
+                match_run = match_run + 1 if last_was_match else 1
+                last_was_match = True
+                last_was_deletion = False
+            elif i in self.deletions:
+                if not last_was_deletion:
+                    out.append(str(match_run) if last_was_match else "0")
+                    out.append("^")
+                    last_was_match = False
+                    last_was_deletion = True
+                out.append(self.deletions[i])
+            else:
+                out.append(str(match_run) if last_was_match else "0")
+                out.append(self.mismatches[i])
+                last_was_match = False
+                last_was_deletion = False
+        out.append(str(match_run) if last_was_match else "0")
+        return "".join(out)
+
+    __str__ = to_string
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, MdTag)
+            and self.start == other.start
+            and self.to_string() == other.to_string()
+        )
+
+
+def batch_md_arrays(batch, sidecar) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-base MD-derived columns for a batch.
+
+    Returns (is_mismatch bool[N, L], ref_codes u8[N, L], has_md bool[N]):
+    for each *read* position of an aligned base, whether it mismatches the
+    reference and the reference base code there (= read base on match, MD
+    base on mismatch).  Insertions/soft-clips get ref code BASE_PAD and
+    is_mismatch False — the per-residue view BQSR's covariates consume
+    (DecadentRead.Residue semantics, rich/DecadentRead.scala:77-116).
+    """
+    b = batch.to_numpy()
+    N, L = b.bases.shape
+    is_mm = np.zeros((N, L), dtype=bool)
+    ref_codes = np.full((N, L), schema.BASE_PAD, dtype=np.uint8)
+    has_md = np.zeros(N, dtype=bool)
+    for i in range(N):
+        if not b.valid[i]:
+            continue
+        md = sidecar.md[i]
+        if md is None:
+            continue
+        has_md[i] = True
+        tag = MdTag.parse(md, int(b.start[i]))
+        cigar = schema.decode_cigar(
+            b.cigar_ops[i], b.cigar_lens[i], int(b.cigar_n[i])
+        )
+        read_pos = 0
+        ref_pos = int(b.start[i])
+        for length, op in parse_cigar(cigar):
+            if op in "M=X":
+                for _ in range(length):
+                    base = tag.mismatches.get(ref_pos)
+                    if base is not None:
+                        is_mm[i, read_pos] = True
+                        ref_codes[i, read_pos] = schema.BASE_ENCODE_LUT[ord(base)]
+                    else:
+                        ref_codes[i, read_pos] = b.bases[i, read_pos]
+                    read_pos += 1
+                    ref_pos += 1
+            elif op in "DN":
+                ref_pos += length
+            elif op in "IS":
+                read_pos += length
+    return is_mm, ref_codes, has_md

@@ -1,0 +1,34 @@
+"""Phred quality <-> probability conversions.
+
+Device analog of ``util/PhredUtils.scala:22-40``: the 256-entry lookup
+tables become constant arrays gathered on device; conversions back to
+phred use the same round(-10*log10(p)) rule.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Constant tables (f64 so Q40+ stays exact; gathers are cheap).
+PHRED_TO_ERROR = 10.0 ** (-np.arange(256) / 10.0)
+PHRED_TO_SUCCESS = 1.0 - PHRED_TO_ERROR
+
+
+def phred_to_error_probability(phred):
+    """phred (int array) -> error probability."""
+    return jnp.asarray(PHRED_TO_ERROR)[jnp.clip(phred, 0, 255)]
+
+
+def phred_to_success_probability(phred):
+    return jnp.asarray(PHRED_TO_SUCCESS)[jnp.clip(phred, 0, 255)]
+
+
+def error_probability_to_phred(p):
+    """error probability -> phred, rounded like the reference
+    (math.round of -10*log10(p))."""
+    return jnp.round(-10.0 * jnp.log10(p)).astype(jnp.int32)
+
+
+def success_probability_to_phred(p):
+    return error_probability_to_phred(1.0 - p)

@@ -1,0 +1,221 @@
+"""Batched Smith-Waterman local alignment.
+
+Semantics match ``algorithms/smithwaterman/`` in the reference:
+constant-gap scoring with the exact move-priority and tie-breaking of
+``SmithWatermanGapScoringFromFn.buildScoringMatrix``
+(B if m>=d && m>=in && m>0, else J if d>=in && d>0, else I if in>0,
+else terminate) and ``SmithWaterman.maxCoordinates`` (on score ties the
+*later* row/column wins, because the reference's fold keeps the right
+operand on equality), and the same trackback emission
+(B -> M/M, J -> I in x / D in y, I -> D in x / I in y).
+
+TPU formulation: the O(|x|·|y|) matrix fill runs as a ``lax.scan`` over
+anti-diagonals — each step updates a whole diagonal vector-wide, and the
+pair dimension is ``vmap``-batched, so the chip fills thousands of
+matrices concurrently (the per-read-per-consensus sweep of indel
+realignment).  Trackback is O(|x|+|y|) per pair on the host, reading the
+device-produced move matrix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# move codes in the device move matrix
+MOVE_T = 0  # terminate
+MOVE_B = 1  # both (diagonal)
+MOVE_J = 2  # consume x only
+MOVE_I = 3  # consume y only
+
+
+@partial(jax.jit, static_argnames=("lx", "ly"))
+def _sw_fill_diagonals(
+    x_codes, x_len, y_codes, y_len, w_match, w_mismatch, w_insert, w_delete,
+    lx: int, ly: int,
+):
+    """Fill scoring/move matrices for a batch of pairs.
+
+    x_codes: [B, lx] u8, y_codes: [B, ly] u8 (base codes; equality is the
+    match test, so N==N matches — same as the reference's char equality).
+    Returns (scores [B, lx+1, ly+1] f32, moves [B, lx+1, ly+1] u8).
+    """
+    B = x_codes.shape[0]
+    D = lx + ly + 1  # number of anti-diagonals of the (lx+1)x(ly+1) matrix
+    ii = jnp.arange(lx + 1)
+
+    def step(carry, d):
+        d1, d2 = carry  # diagonals d-1 and d-2, each [B, lx+1] indexed by i
+        jj = d - ii  # column index per lane
+        valid = (
+            (ii >= 1)
+            & (jj >= 1)
+            & (ii[None, :] <= x_len[:, None])
+            & (jj[None, :] <= y_len[:, None])
+        )
+        xc = x_codes[:, jnp.clip(ii - 1, 0, lx - 1)]
+        yc = y_codes[:, jnp.clip(jj - 1, 0, ly - 1)]  # jj is batch-invariant
+        sub = jnp.where(xc == yc, w_match, w_mismatch)
+
+        def shift_i(v):  # v[i-1] with 0 at i=0
+            return jnp.pad(v[:, :-1], ((0, 0), (1, 0)))
+
+        m = shift_i(d2) + sub
+        dd = shift_i(d1) + w_delete
+        inn = d1 + w_insert
+
+        take_b = (m >= dd) & (m >= inn) & (m > 0.0)
+        take_j = ~take_b & (dd >= inn) & (dd > 0.0)
+        take_i = ~take_b & ~take_j & (inn > 0.0)
+        score = jnp.where(
+            take_b, m, jnp.where(take_j, dd, jnp.where(take_i, inn, 0.0))
+        )
+        move = jnp.where(
+            take_b,
+            MOVE_B,
+            jnp.where(take_j, MOVE_J, jnp.where(take_i, MOVE_I, MOVE_T)),
+        ).astype(jnp.uint8)
+        score = jnp.where(valid, score, 0.0)
+        move = jnp.where(valid, move, MOVE_T)
+        return (score, d1), (score, move)
+
+    (_, _), (diag_scores, diag_moves) = jax.lax.scan(
+        step,
+        (jnp.zeros((B, lx + 1)), jnp.zeros((B, lx + 1))),
+        jnp.arange(D),
+    )
+    # diag_scores: [D, B, lx+1]; matrix[b, i, j] = diag[i+j, b, i]
+    jj = jnp.arange(ly + 1)
+    dmat = ii[:, None] + jj[None, :]  # [lx+1, ly+1]
+    scores = diag_scores[dmat, :, ii[:, None]]  # [lx+1, ly+1, B]
+    moves = diag_moves[dmat, :, ii[:, None]]
+    return (
+        jnp.moveaxis(scores, -1, 0).astype(jnp.float32),
+        jnp.moveaxis(moves, -1, 0),
+    )
+
+
+@dataclass(frozen=True)
+class SWAlignment:
+    cigar_x: str
+    cigar_y: str
+    x_start: int
+    y_start: int
+    x_end: int  # exclusive end of the aligned span in x
+    y_end: int
+    score: float
+
+
+def _max_coordinates(score: np.ndarray, x_len: int, y_len: int) -> tuple[int, int]:
+    """Reference tie rule: per-row pick the LAST max column, then across
+    rows pick the LAST row achieving the global max."""
+    sub = score[: x_len + 1, : y_len + 1]
+    flipped = sub[:, ::-1]
+    row_arg = sub.shape[1] - 1 - np.argmax(flipped, axis=1)
+    row_max = sub[np.arange(sub.shape[0]), row_arg]
+    i = sub.shape[0] - 1 - int(np.argmax(row_max[::-1]))
+    return i, int(row_arg[i])
+
+
+def _rnn_to_cigar(ops: list[str]) -> str:
+    """Reversed unit-length op list -> run-length CIGAR string."""
+    if not ops:
+        return ""
+    out = []
+    last, run = ops[0], 1
+    for c in ops[1:]:
+        if c == last:
+            run += 1
+        else:
+            out.append(f"{run}{last}")
+            last, run = c, 1
+    out.append(f"{run}{last}")
+    return "".join(reversed(out))
+
+
+def _trackback(
+    moves: np.ndarray, score: np.ndarray, x_len: int, y_len: int
+) -> SWAlignment:
+    i, j = _max_coordinates(score, x_len, y_len)
+    end_i, end_j = i, j
+    cx: list[str] = []
+    cy: list[str] = []
+    while moves[i, j] != MOVE_T:
+        mv = moves[i, j]
+        if mv == MOVE_B:
+            cx.append("M")
+            cy.append("M")
+            i -= 1
+            j -= 1
+        elif mv == MOVE_J:
+            cx.append("I")
+            cy.append("D")
+            i -= 1
+        else:
+            cx.append("D")
+            cy.append("I")
+            j -= 1
+    return SWAlignment(
+        cigar_x=_rnn_to_cigar(cx),
+        cigar_y=_rnn_to_cigar(cy),
+        x_start=i,
+        y_start=j,
+        x_end=end_i,
+        y_end=end_j,
+        score=float(score[end_i, end_j]),
+    )
+
+
+def smith_waterman_batch(
+    x_codes,
+    x_len,
+    y_codes,
+    y_len,
+    w_match: float = 1.0,
+    w_mismatch: float = -0.333,
+    w_insert: float = -0.5,
+    w_delete: float = -0.5,
+) -> list[SWAlignment]:
+    """Align each x[i] against y[i]; device fill + host trackback."""
+    x_codes = jnp.asarray(x_codes)
+    y_codes = jnp.asarray(y_codes)
+    scores, moves = _sw_fill_diagonals(
+        x_codes,
+        jnp.asarray(x_len),
+        y_codes,
+        jnp.asarray(y_len),
+        w_match, w_mismatch, w_insert, w_delete,
+        int(x_codes.shape[1]),
+        int(y_codes.shape[1]),
+    )
+    scores = np.asarray(scores)
+    moves = np.asarray(moves)
+    xl = np.asarray(x_len)
+    yl = np.asarray(y_len)
+    return [
+        _trackback(moves[b], scores[b], int(xl[b]), int(yl[b]))
+        for b in range(x_codes.shape[0])
+    ]
+
+
+def smith_waterman(
+    x: str,
+    y: str,
+    w_match: float = 1.0,
+    w_mismatch: float = -0.333,
+    w_insert: float = -0.5,
+    w_delete: float = -0.5,
+) -> SWAlignment:
+    """Single-pair convenience wrapper (strings in, CIGARs out)."""
+    from adam_tpu.formats.schema import encode_bases
+
+    xc = encode_bases(x)[None, :]
+    yc = encode_bases(y)[None, :]
+    return smith_waterman_batch(
+        xc, np.array([len(x)]), yc, np.array([len(y)]),
+        w_match, w_mismatch, w_insert, w_delete,
+    )[0]
