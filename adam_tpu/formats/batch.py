@@ -65,6 +65,7 @@ class ReadBatch:
     mate_start: Array     # i64[N]
     tlen: Array           # i32[N]    template length (SAM TLEN)
     read_group_idx: Array  # i32[N]   index into RecordGroupDictionary, -1 none
+    has_qual: Array       # bool[N]   false when qual was '*' (null in the reference)
     valid: Array          # bool[N]   row mask
 
     # ---------------------------------------------------------------- sizes
@@ -125,6 +126,7 @@ class ReadBatch:
             mate_start=pad(self.mate_start, -1),
             tlen=pad(self.tlen, 0),
             read_group_idx=pad(self.read_group_idx, -1),
+            has_qual=pad(self.has_qual, False),
             valid=pad(self.valid, False),
         )
 
@@ -160,6 +162,7 @@ class ReadBatch:
             mate_start=np.full(n, -1, np.int64),
             tlen=np.zeros(n, np.int32),
             read_group_idx=np.full(n, -1, np.int32),
+            has_qual=np.zeros(n, bool),
             valid=np.zeros(n, bool),
         )
 
@@ -263,6 +266,7 @@ def pack_reads(
             b.bases[i, :L] = schema.encode_bases(seq)
         if qual and qual != "*":
             b.quals[i, : len(qual)] = schema.encode_quals(qual)
+            b.has_qual[i] = True
         elif L:
             b.quals[i, :L] = 0
         b.lengths[i] = L

@@ -165,8 +165,7 @@ def format_sam_records(
         else:
             rnext = names[mate_contig]
         seq = schema.decode_bases(b.bases[i], L) if L else "*"
-        ql = b.quals[i][:L]
-        qual = schema.decode_quals(ql) if L and not (ql == schema.QUAL_PAD).all() else "*"
+        qual = schema.decode_quals(b.quals[i][:L]) if L and b.has_qual[i] else "*"
         cigar = schema.decode_cigar(b.cigar_ops[i], b.cigar_lens[i], int(b.cigar_n[i]))
         tags = []
         if side.attrs[i]:
@@ -462,7 +461,10 @@ def write_bam(
             nib = np.concatenate([nib, [0]])
         packed = ((nib[0::2] << 4) | nib[1::2]).astype(np.uint8).tobytes()
         quals = b.quals[i][:L]
-        quals = np.where(quals == schema.QUAL_PAD, 0xFF, quals).astype(np.uint8)
+        if b.has_qual[i]:
+            quals = np.where(quals == schema.QUAL_PAD, 0xFF, quals).astype(np.uint8)
+        else:
+            quals = np.full(L, 0xFF, np.uint8)  # BAM spec: missing qual
         rg = int(b.read_group_idx[i])
         tags = _encode_bam_tags(
             side.attrs[i], side.md[i], side.orig_quals[i],

@@ -67,21 +67,24 @@ def unclipped_start(start, cigar_ops, cigar_lens, cigar_n):
 
 
 def unclipped_end(end, cigar_ops, cigar_lens, cigar_n):
-    """end + trailing clips (end is 0-based exclusive here; the reference's
-    unclippedEnd is inclusive — callers converting to reference semantics
-    subtract 1)."""
+    """end + trailing clips.  ``end`` is 0-based exclusive, and so is the
+    reference's unclippedEnd (it folds clip lengths onto the exclusive
+    ``getEnd``, rich/RichAlignmentRecord.scala:110-114) — no -1 anywhere."""
     return end + trailing_clip(cigar_ops, cigar_lens, cigar_n)
 
 
 def five_prime_position(start, end, flags, cigar_ops, cigar_lens, cigar_n):
-    """5' reference position with clipping (fivePrimePosition semantics):
-    unclipped end-1 for reverse-strand reads, unclipped start otherwise.
+    """5' reference position with clipping (fivePrimePosition semantics,
+    rich/RichAlignmentRecord.scala:124-126): the *exclusive* unclipped end
+    for reverse-strand reads — the reference uses `end` directly, which is
+    0-based exclusive — and the unclipped start otherwise.
 
     Duplicate marking keys on this (ReferencePositionPair via
-    RichAlignmentRecord.fivePrimeReferencePosition)."""
+    RichAlignmentRecord.fivePrimeReferencePosition); the key also carries
+    strand, so forward/reverse positions never collide."""
     rev = (flags & schema.FLAG_REVERSE) != 0
     us = unclipped_start(start, cigar_ops, cigar_lens, cigar_n)
-    ue = unclipped_end(end, cigar_ops, cigar_lens, cigar_n) - 1
+    ue = unclipped_end(end, cigar_ops, cigar_lens, cigar_n)
     return jnp.where(rev, ue, us)
 
 

@@ -25,9 +25,9 @@ def phred_to_success_probability(phred):
 
 
 def error_probability_to_phred(p):
-    """error probability -> phred, rounded like the reference
-    (math.round of -10*log10(p))."""
-    return jnp.round(-10.0 * jnp.log10(p)).astype(jnp.int32)
+    """error probability -> phred, rounded like the reference:
+    Scala math.round = floor(x + 0.5), not banker's rounding."""
+    return jnp.floor(-10.0 * jnp.log10(p) + 0.5).astype(jnp.int32)
 
 
 def success_probability_to_phred(p):

@@ -1,0 +1,3 @@
+from adam_tpu.pipelines import markdup, sort
+
+__all__ = ["markdup", "sort"]

@@ -1,0 +1,335 @@
+"""Base Quality Score Recalibration (BQSR).
+
+Two-pass algorithm with the exact semantics of the reference's
+``rdd/read/recalibration/`` package:
+
+* **Observe** (BaseQualityRecalibration.scala:55-85): canonical reads
+  (primary, mapped, not duplicate, qual present, 0 < mapq < 255, passed
+  vendor QC) contribute one observation per residue that has quality > 0,
+  a regular ACGT base, a reference position (not an insertion/soft-clip)
+  and is not masked by the known-SNPs table.  The covariate key is
+  (read group, reported quality, cycle, dinucleotide)
+  (CycleCovariate.scala:23-49, DinucCovariate.scala:24-66).
+* **Recalibrate** (Recalibrator.scala:28-165): every read with qualities
+  gets per-residue recalibrated quality from the log-space delta stack
+  global -> per-quality -> per-cycle/per-dinuc, bounded to Q50
+  (RecalibrationTable), applied only to residues with reported quality >=
+  Q5 (minAcceptableQuality, BaseQualityRecalibration.scala:50).
+
+TPU formulation: the covariate key space is **dense** — (rg, 94 quals,
+2L+1 cycles, 17 dinucs) — so the reference's HashMap-aggregate becomes a
+scatter-add histogram on device, combined across chips with a `psum`, and
+the recalibration table lookups become marginal reductions + gathers:
+no strings, no hashing, one fused kernel per pass.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.formats import schema
+from adam_tpu.models.snp_table import SnpTable
+from adam_tpu.ops import cigar as cigar_ops
+from adam_tpu.ops.mdtag import batch_md_arrays
+from adam_tpu.ops.phred import PHRED_TO_ERROR
+
+N_QUAL = 94  # valid phred range 0..93 (QualityScore.scala)
+N_DINUC = 17  # 16 (prev,cur) pairs + index 16 = None ("NN")
+DINUC_NONE = 16
+MIN_ACCEPTABLE_QUALITY = 5
+MAX_QUAL = 50
+
+
+# --------------------------------------------------------------------------
+# Covariates (device)
+# --------------------------------------------------------------------------
+def compute_cycles(lengths, flags, lmax: int):
+    """Sequencer cycle per residue -> i32[N, L].
+
+    (initial, increment): forward/first (1, +1); forward/second (-1, -1);
+    reverse/first (L, -1); reverse/second (-L, +1) — CycleCovariate.scala:31-49;
+    'second' means paired && secondOfPair, everything else is 'first'.
+    """
+    rev = (flags & schema.FLAG_REVERSE) != 0
+    second = ((flags & schema.FLAG_PAIRED) != 0) & (
+        (flags & schema.FLAG_SECOND_OF_PAIR) != 0
+    )
+    L = lengths.astype(jnp.int32)
+    initial = jnp.where(
+        rev,
+        jnp.where(second, -L, L),
+        jnp.where(second, -1, 1),
+    )
+    increment = jnp.where(rev, jnp.where(second, 1, -1), jnp.where(second, -1, 1))
+    pos = jnp.arange(lmax, dtype=jnp.int32)[None, :]
+    return initial[:, None] + increment[:, None] * pos
+
+
+def compute_dinucs(bases, lengths, flags, lmax: int):
+    """Dinucleotide index per residue -> i32[N, L] in [0, 16].
+
+    Forward: (seq[i-1], seq[i]); reverse: (comp(seq[i+1]), comp(seq[i])) —
+    i.e. the machine-order previous base (DinucCovariate.scala:24-50).
+    None (index 16) at the machine-order first base or when either base
+    is not a regular ACGT.
+    """
+    comp = jnp.asarray(schema.BASE_COMPLEMENT)
+    rev = ((flags & schema.FLAG_REVERSE) != 0)[:, None]
+    cur_f = bases
+    prev_f = jnp.pad(bases[:, :-1], ((0, 0), (1, 0)), constant_values=schema.BASE_N)
+    next_b = jnp.pad(bases[:, 1:], ((0, 0), (0, 1)), constant_values=schema.BASE_N)
+    cur = jnp.where(rev, comp[cur_f], cur_f)
+    prev = jnp.where(rev, comp[next_b], prev_f)
+    i = jnp.arange(lmax)[None, :]
+    in_read = i < lengths[:, None]
+    first_machine = jnp.where(rev, i == (lengths[:, None] - 1), i == 0)
+    regular = (cur < 4) & (prev < 4)
+    ok = in_read & ~first_machine & regular
+    idx = prev.astype(jnp.int32) * 4 + cur.astype(jnp.int32)
+    return jnp.where(ok, idx, DINUC_NONE)
+
+
+# --------------------------------------------------------------------------
+# Observation pass
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("n_rg", "lmax"))
+def observe_kernel(
+    bases, quals, lengths, flags, read_group_idx,
+    residue_ok, is_mismatch, read_ok,
+    n_rg: int, lmax: int,
+):
+    """Scatter-add residue observations into the dense covariate histogram.
+
+    Returns (total, mismatches) as i64[n_rg, N_QUAL, 2*lmax+1, N_DINUC].
+    """
+    n_cyc = 2 * lmax + 1
+    cycles = compute_cycles(lengths, flags, lmax)
+    dinucs = compute_dinucs(bases, lengths, flags, lmax)
+    q = jnp.clip(quals.astype(jnp.int32), 0, N_QUAL - 1)
+    rg = jnp.clip(read_group_idx.astype(jnp.int32), 0, n_rg - 1)
+    include = residue_ok & read_ok[:, None]
+
+    flat_key = (
+        ((rg[:, None] * N_QUAL + q) * n_cyc + (cycles + lmax)) * N_DINUC + dinucs
+    )
+    size = n_rg * N_QUAL * n_cyc * N_DINUC
+    flat_key = jnp.where(include, flat_key, 0).ravel()
+    ones = include.astype(jnp.int64).ravel()
+    mm = (include & is_mismatch).astype(jnp.int64).ravel()
+    total = jnp.zeros(size, jnp.int64).at[flat_key].add(ones)
+    mism = jnp.zeros(size, jnp.int64).at[flat_key].add(mm)
+    shape = (n_rg, N_QUAL, n_cyc, N_DINUC)
+    return total.reshape(shape), mism.reshape(shape)
+
+
+class ObservationTable:
+    """Dense covariate histogram + CSV emission compatible with the
+    reference's ObservationTable.toCSV (GATK-style)."""
+
+    def __init__(self, total: np.ndarray, mismatches: np.ndarray,
+                 rg_names: list[str], lmax: int):
+        self.total = np.asarray(total)
+        self.mismatches = np.asarray(mismatches)
+        self.rg_names = rg_names
+        self.lmax = lmax
+
+    @staticmethod
+    def _dinuc_str(idx: int) -> str:
+        if idx == DINUC_NONE:
+            return "NN"
+        return "ACGT"[idx // 4] + "ACGT"[idx % 4]
+
+    @staticmethod
+    def empirical_quality(total: int, mismatches: int) -> int:
+        """Bayes with Beta(1,1): (1+mm)/(2+total) -> phred
+        (ObservationTable.scala:55-59)."""
+        from adam_tpu.ops.phred import error_probability_to_phred
+
+        p = (1.0 + mismatches) / (2.0 + total)
+        return int(error_probability_to_phred(p))
+
+    def to_csv(self) -> str:
+        lines = ["ReadGroup,ReportedQ,Cycle,Dinuc,TotalCount,MismatchCount,EmpiricalQ,IsSkipped"]
+        rg_idx, q_idx, c_idx, d_idx = np.nonzero(self.total)
+        for rg, q, c, d in zip(rg_idx, q_idx, c_idx, d_idx):
+            t = int(self.total[rg, q, c, d])
+            m = int(self.mismatches[rg, q, c, d])
+            fields = [
+                self.rg_names[rg],
+                str(int(q)),
+                str(int(c) - self.lmax),
+                self._dinuc_str(int(d)),
+                str(t),
+                str(m),
+                str(self.empirical_quality(t, m)),
+            ]
+            if d == DINUC_NONE:
+                fields.append("**")
+            lines.append(",".join(fields))
+        return "\n".join(lines)
+
+
+def build_observation_table(
+    ds: AlignmentDataset, known_snps: Optional[SnpTable] = None
+) -> ObservationTable:
+    b = ds.batch.to_numpy()
+    lmax = b.lmax
+    is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar)
+
+    flags = np.asarray(b.flags)
+    read_ok = (
+        np.asarray(b.valid)
+        & ((flags & schema.FLAG_UNMAPPED) == 0)
+        & ((flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0)
+        & ((flags & schema.FLAG_DUPLICATE) == 0)
+        & ((flags & schema.FLAG_FAILED_QC) == 0)
+        & np.asarray(b.has_qual)
+        & (np.asarray(b.mapq) > 0)
+        & (np.asarray(b.mapq) != 255)
+        & has_md
+    )
+
+    # residue filter: q>0, ACGT base, aligned to reference, not a known SNP
+    ref_pos = np.asarray(
+        cigar_ops.reference_positions(
+            jnp.asarray(b.cigar_ops), jnp.asarray(b.cigar_lens),
+            jnp.asarray(b.cigar_n), jnp.asarray(b.start), lmax,
+        )
+    )
+    has_ref = ref_pos >= 0
+    quals = np.asarray(b.quals)
+    residue_ok = (quals > 0) & (quals < schema.QUAL_PAD) & (np.asarray(b.bases) < 4) & has_ref
+    if known_snps is not None and len(known_snps):
+        masked = known_snps.mask_positions(
+            ds.seq_dict.names, np.asarray(b.contig_idx), ref_pos
+        )
+        residue_ok &= ~masked
+
+    n_rg = max(len(ds.read_groups), 1)
+    total, mism = observe_kernel(
+        jnp.asarray(b.bases), jnp.asarray(b.quals), jnp.asarray(b.lengths),
+        jnp.asarray(flags), jnp.asarray(b.read_group_idx),
+        jnp.asarray(residue_ok), jnp.asarray(is_mm), jnp.asarray(read_ok),
+        n_rg, lmax,
+    )
+    rg_names = ds.read_groups.names or ["null"]
+    return ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
+
+
+# --------------------------------------------------------------------------
+# Recalibration pass
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("lmax",))
+def recalibrate_kernel(
+    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+    total, mismatches, lmax: int,
+):
+    """Apply the log-space delta stack to every residue -> new quals u8[N, L].
+
+    Table semantics (Recalibrator.scala:79-127): with E = empirical error
+    (Bayes (1+mm)/(2+total)) and offsets accumulating residue logP +
+    previous deltas, missing entries (total==0) contribute delta 0; the
+    per-cycle and per-dinuc deltas share the same offset.
+    """
+    err = jnp.asarray(PHRED_TO_ERROR)
+
+    def emp_log(t, m):  # ln of bayesian error probability
+        return jnp.log((1.0 + m) / (2.0 + t))
+
+    # marginals
+    g_t = total.sum(axis=(1, 2, 3))  # [RG]
+    g_m = mismatches.sum(axis=(1, 2, 3))
+    q_levels = jnp.arange(N_QUAL)
+    exp_by_q = err[q_levels][None, :] * total.sum(axis=(2, 3))  # [RG, Q]
+    g_exp = exp_by_q.sum(axis=1)  # [RG] expected mismatches
+    q_t = total.sum(axis=(2, 3))  # [RG, Q]
+    q_m = mismatches.sum(axis=(2, 3))
+    c_t = total.sum(axis=3)  # [RG, Q, C]
+    c_m = mismatches.sum(axis=3)
+    d_t = total.sum(axis=2)  # [RG, Q, D]
+    d_m = mismatches.sum(axis=2)
+
+    n_rg = total.shape[0]
+    rg = jnp.clip(read_group_idx.astype(jnp.int32), 0, n_rg - 1)
+    rg_known = (read_group_idx >= 0) & (read_group_idx < n_rg)
+    q = jnp.clip(quals.astype(jnp.int32), 0, N_QUAL - 1)
+    cycles = compute_cycles(lengths, flags, lmax) + lmax
+    dinucs = compute_dinucs(bases, lengths, flags, lmax)
+
+    residue_logp = jnp.log(err[q])
+
+    gt = g_t[rg][:, None] * jnp.ones_like(q)  # broadcast [N, L]
+    gm = g_m[rg][:, None] * jnp.ones_like(q)
+    gexp = g_exp[rg][:, None] * jnp.ones_like(residue_logp)
+    g_present = (gt > 0) & rg_known[:, None]
+    global_delta = jnp.where(
+        g_present, emp_log(gt, gm) - jnp.log(gexp / jnp.maximum(gt, 1)), 0.0
+    )
+
+    qt = q_t[rg[:, None], q]
+    qm = q_m[rg[:, None], q]
+    q_present = g_present & (qt > 0)
+    offset1 = residue_logp + global_delta
+    quality_delta = jnp.where(q_present, emp_log(qt, qm) - offset1, 0.0)
+
+    offset2 = offset1 + quality_delta
+    ct = c_t[rg[:, None], q, cycles]
+    cm = c_m[rg[:, None], q, cycles]
+    cyc_delta = jnp.where(q_present & (ct > 0), emp_log(ct, cm) - offset2, 0.0)
+    dt = d_t[rg[:, None], q, dinucs]
+    dm = d_m[rg[:, None], q, dinucs]
+    din_delta = jnp.where(q_present & (dt > 0), emp_log(dt, dm) - offset2, 0.0)
+
+    log_p = residue_logp + global_delta + quality_delta + cyc_delta + din_delta
+    max_logp = jnp.log(err[MAX_QUAL])
+    bounded = jnp.minimum(0.0, jnp.maximum(max_logp, log_p))
+    # QualityScore.fromErrorProbability(exp(boundedLogP)) — shared rounding
+    from adam_tpu.ops.phred import error_probability_to_phred
+
+    new_q = error_probability_to_phred(jnp.exp(bounded))
+
+    in_read = jnp.arange(lmax)[None, :] < lengths[:, None]
+    apply_mask = (
+        in_read
+        & (quals >= MIN_ACCEPTABLE_QUALITY)
+        & (quals < schema.QUAL_PAD)
+        & has_qual[:, None]
+        & valid[:, None]
+    )
+    return jnp.where(apply_mask, new_q, quals).astype(jnp.uint8)
+
+
+def recalibrate_base_qualities(
+    ds: AlignmentDataset,
+    known_snps: Optional[SnpTable] = None,
+    dump_observation_table: Optional[str] = None,
+) -> AlignmentDataset:
+    obs = build_observation_table(ds, known_snps)
+    if dump_observation_table:
+        with open(dump_observation_table, "w") as fh:
+            fh.write(obs.to_csv())
+    b = ds.batch.to_numpy()
+    new_quals = recalibrate_kernel(
+        jnp.asarray(b.bases), jnp.asarray(b.quals), jnp.asarray(b.lengths),
+        jnp.asarray(b.flags), jnp.asarray(b.read_group_idx),
+        jnp.asarray(b.has_qual), jnp.asarray(b.valid),
+        jnp.asarray(obs.total), jnp.asarray(obs.mismatches), b.lmax,
+    )
+    # stash original quals in the sidecar (setOrigQual, Recalibrator.scala:36-40)
+    side = ds.sidecar
+    new_oq = list(side.orig_quals)
+    for i in range(b.n_rows):
+        if b.valid[i] and b.has_qual[i] and new_oq[i] is None:
+            new_oq[i] = schema.decode_quals(b.quals[i], int(b.lengths[i]))
+    from dataclasses import replace as dc_replace
+
+    new_side = dc_replace(side, orig_quals=new_oq)
+    return ds.with_batch(
+        b.replace(quals=np.asarray(new_quals)), new_side
+    )

@@ -1,0 +1,197 @@
+"""Duplicate marking.
+
+Picard-style semantics matching ``rdd/read/MarkDuplicates.scala:66-128``:
+
+1. Bucket reads by (record group, read name) — SingleReadBucket
+   (models/SingleReadBucket.scala:30-42).
+2. Key each bucket by its 5'-clipped position pair —
+   ReferencePositionPair (models/ReferencePositionPair.scala:30-52):
+   read1 position is the first first-of-pair read's 5' position (strand
+   included); unmapped reads key by their *sequence* so identical
+   unplaced pairs group; fragments have no read2 position.
+3. Group by (library, left position); within a group, subgroup by right
+   position; in each pair-subgroup keep the highest bucket score
+   (sum of quals >= 15 over primary reads, :45-47) unmarked — its
+   secondaries are still marked — and mark everything else; a
+   fragment-subgroup is wholly marked when pair-subgroups co-exist at the
+   same left position; unmapped reads are never marked.
+
+TPU formulation: 5' keys and bucket scores are device kernels (fused
+CIGAR walks + masked segment sums); the group-subgroup-argmax cascade
+becomes one lexsort + run-boundary scan over the bucket table (no
+hash shuffles), vectorized in numpy on host today — the same
+sort-and-segment shape the distributed path shards by genome position.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import ReadBatch
+from adam_tpu.ops import cigar as cigar_ops
+
+
+@jax.jit
+def _device_read_columns(b: ReadBatch):
+    """Per-read device kernels: 5' position and quality score."""
+    five_prime = cigar_ops.five_prime_position(
+        b.start, b.end, b.flags, b.cigar_ops, b.cigar_lens, b.cigar_n
+    )
+    in_read = jnp.arange(b.lmax)[None, :] < b.lengths[:, None]
+    score = jnp.sum(
+        jnp.where(in_read & (b.quals >= 15), b.quals, 0).astype(jnp.int32), axis=1
+    )
+    return five_prime, score
+
+
+def _bucket_ids(ds: AlignmentDataset) -> tuple[np.ndarray, int]:
+    """(rg, name) -> dense bucket id per row (-1 for invalid rows)."""
+    b = ds.batch.to_numpy()
+    ids = np.full(b.n_rows, -1, dtype=np.int64)
+    table: dict[tuple[int, str], int] = {}
+    for i in range(b.n_rows):
+        if not b.valid[i]:
+            continue
+        key = (int(b.read_group_idx[i]), ds.sidecar.names[i])
+        ids[i] = table.setdefault(key, len(table))
+    return ids, len(table)
+
+
+def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
+    b = ds.batch.to_numpy()
+    n = b.n_rows
+    if n == 0:
+        return ds
+    five_prime, read_score = jax.tree.map(
+        np.asarray, _device_read_columns(ds.batch.to_device())
+    )
+
+    bucket_of, n_buckets = _bucket_ids(ds)
+    if n_buckets == 0:
+        return ds
+
+    flags = np.asarray(b.flags)
+    valid = np.asarray(b.valid)
+    mapped = (flags & schema.FLAG_UNMAPPED) == 0
+    primary = (flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0
+    first = (flags & schema.FLAG_FIRST_OF_PAIR) != 0
+    second = (flags & schema.FLAG_SECOND_OF_PAIR) != 0
+    reverse = (flags & schema.FLAG_REVERSE) != 0
+
+    # ----- per-bucket left/right keys (ReferencePositionPair.apply) -----
+    # Key encoding: (kind, contig_or_hash, pos, strand); kind 0 = none,
+    # 1 = mapped position, 2 = sequence-keyed (unmapped read).
+    NONE_KEY = (0, 0, 0, 0)
+
+    def read_key(i) -> tuple[int, int, int, int]:
+        if mapped[i]:
+            return (1, int(b.contig_idx[i]), int(five_prime[i]), int(reverse[i]))
+        seq = schema.decode_bases(b.bases[i], int(b.lengths[i]))
+        return (2, hash(seq) & 0x7FFFFFFFFFFFFFFF, 0, 0)
+
+    # candidate rows per bucket, in row order (primaryMapped ++ unmapped)
+    bucket_first = [[] for _ in range(n_buckets)]
+    bucket_second = [[] for _ in range(n_buckets)]
+    bucket_frag = [[] for _ in range(n_buckets)]
+    bucket_score = np.zeros(n_buckets, dtype=np.int64)
+    for i in range(n):
+        bid = bucket_of[i]
+        if bid < 0:
+            continue
+        if mapped[i] and primary[i]:
+            bucket_score[bid] += int(read_score[i])
+        candidate = (mapped[i] and primary[i]) or not mapped[i]
+        if not candidate:
+            continue
+        if first[i]:
+            bucket_first[bid].append(i)
+        elif second[i]:
+            bucket_second[bid].append(i)
+        bucket_frag[bid].append(i)  # every candidate (primaryMapped ++ unmapped)
+
+    left_keys = []
+    right_keys = []
+    for bid in range(n_buckets):
+        # primaryMapped ++ unmapped ordering: mapped-primary candidates first
+        def ordered(rows):
+            return sorted(rows, key=lambda i: (not mapped[i], 0))
+
+        firsts = ordered(bucket_first[bid])
+        seconds = ordered(bucket_second[bid])
+        if firsts or seconds:
+            lk = read_key(firsts[0]) if firsts else NONE_KEY
+            rk = read_key(seconds[0]) if seconds else NONE_KEY
+        else:
+            frags = ordered(bucket_frag[bid])
+            lk = read_key(frags[0]) if frags else NONE_KEY
+            rk = NONE_KEY
+        left_keys.append(lk)
+        right_keys.append(rk)
+
+    # library per bucket (library of the first read in the bucket)
+    lib_ids = ds.read_groups.library_ids() if len(ds.read_groups) else np.array([], np.int32)
+    bucket_lib = np.full(n_buckets, -1, dtype=np.int64)
+    for i in range(n):
+        bid = bucket_of[i]
+        if bid >= 0 and bucket_lib[bid] == -1:
+            rg = int(b.read_group_idx[i])
+            bucket_lib[bid] = lib_ids[rg] if rg >= 0 else -1
+
+    # ----- group by (library, left), subgroup by right, mark -----
+    left_arr = np.array(left_keys, dtype=np.int64)  # [B, 4]
+    right_arr = np.array(right_keys, dtype=np.int64)
+    group_order = np.lexsort(
+        tuple(right_arr[:, k] for k in range(3, -1, -1))
+        + tuple(left_arr[:, k] for k in range(3, -1, -1))
+        + (bucket_lib,)
+    )
+
+    primary_dup = np.zeros(n_buckets, dtype=bool)
+    secondary_dup = np.zeros(n_buckets, dtype=bool)
+
+    go = group_order
+    sl = np.concatenate([bucket_lib[go, None], left_arr[go]], axis=1)
+    sr = right_arr[go]
+    new_left = np.ones(len(go), dtype=bool)
+    new_left[1:] = (sl[1:] != sl[:-1]).any(axis=1)
+    new_right = new_left.copy()
+    new_right[1:] |= (sr[1:] != sr[:-1]).any(axis=1)
+    left_starts = np.flatnonzero(new_left)
+    left_ends = np.append(left_starts[1:], len(go))
+    for s, e in zip(left_starts, left_ends):
+        rows = go[s:e]
+        if left_arr[rows[0], 0] == 0:  # left position None: never duplicates
+            continue
+        sub_starts = np.flatnonzero(new_right[s:e]) + s
+        sub_ends = np.append(sub_starts[1:], e)
+        group_count = len(sub_starts)
+        for ss, se in zip(sub_starts, sub_ends):
+            sub = go[ss:se]
+            group_is_fragments = right_arr[sub[0], 0] == 0
+            only_fragments = group_is_fragments and group_count == 1
+            if only_fragments or not group_is_fragments:
+                # keep the highest score; first wins ties (stable order)
+                best = sub[np.argmax(bucket_score[sub])]
+                primary_dup[sub] = True
+                primary_dup[best] = False
+                secondary_dup[sub] = True
+            else:
+                primary_dup[sub] = True
+                secondary_dup[sub] = True
+
+    # ----- apply to reads -----
+    row_bucket = np.clip(bucket_of, 0, None)
+    dup = np.where(
+        mapped & primary,
+        primary_dup[row_bucket],
+        np.where(mapped, secondary_dup[row_bucket], False),
+    )
+    dup &= valid & (bucket_of >= 0)
+    new_flags = np.where(
+        dup, flags | schema.FLAG_DUPLICATE, flags & ~schema.FLAG_DUPLICATE
+    ).astype(np.int32)
+    return ds.with_batch(ds.batch.to_numpy().replace(flags=new_flags))

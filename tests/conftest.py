@@ -12,7 +12,7 @@ import os
 import sys
 import pathlib
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,6 +21,21 @@ if "xla_force_host_platform_device_count" not in flags:
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
+
+# The axon sitecustomize (TPU tunnel) registers an 'axon' PJRT plugin in
+# every interpreter; its client init dials the tunnel even under
+# JAX_PLATFORMS=cpu and can hang when the single-chip lease is busy.
+# Tests are CPU-only by design — drop the plugin before any backend init.
+try:  # pragma: no cover - environment-specific
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    # the sitecustomize imports jax at interpreter start, capturing
+    # JAX_PLATFORMS=axon from the ambient env before this file runs
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

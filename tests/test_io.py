@@ -191,3 +191,26 @@ def test_parquet_projection_predicate(ref_resources, tmp_path):
     )
     assert 0 < len(filt) < len(ds)
     assert (np.asarray(filt.batch.start)[np.asarray(filt.batch.valid)] < 1e8).all()
+
+
+def test_missing_qual_roundtrip(tmp_path):
+    """qual '*' must stay '*' through SAM and BAM, not become phred-0."""
+    from adam_tpu.api.datasets import AlignmentDataset
+    from adam_tpu.formats.batch import pack_reads
+    from adam_tpu.io.sam import SamHeader
+    from adam_tpu.models.dictionaries import SequenceDictionary, SequenceRecord
+
+    sd = SequenceDictionary((SequenceRecord("1", 1000),))
+    recs = [dict(name="nq", flags=0, contig_idx=0, start=5, mapq=60,
+                 cigar="4M", seq="ACGT", qual="*")]
+    batch, side = pack_reads(recs)
+    assert not bool(batch.to_numpy().has_qual[0])
+    ds = AlignmentDataset(batch, side, SamHeader(seq_dict=sd))
+    for ext in ("sam", "bam"):
+        p = tmp_path / f"nq.{ext}"
+        ds.save(str(p))
+        back = ctx.load_alignments(str(p))
+        assert not bool(back.batch.to_numpy().has_qual[0]), ext
+    line = [l for l in (tmp_path / "nq.sam").read_text().splitlines()
+            if not l.startswith("@")][0]
+    assert line.split("\t")[10] == "*"

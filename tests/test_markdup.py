@@ -1,0 +1,176 @@
+"""Duplicate-marking scenarios ported from the reference's
+MarkDuplicatesSuite (adam-core/src/test/.../read/MarkDuplicatesSuite.scala)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import pack_reads
+from adam_tpu.io.sam import SamHeader
+from adam_tpu.models.dictionaries import (
+    RecordGroup,
+    RecordGroupDictionary,
+    SequenceDictionary,
+    SequenceRecord,
+)
+
+_counter = itertools.count()
+
+CONTIGS = ["0", "1", "2", "10", "ref0", "ref1"]
+SD = SequenceDictionary(tuple(SequenceRecord(n, 10_000_000) for n in CONTIGS))
+RGD = RecordGroupDictionary((RecordGroup("machine foo", library="library bar"),))
+
+
+def mapped_read(ref, start, name=None, phred=20, clipped=0, neg=False,
+                primary=True, **kw):
+    name = name or f"read{next(_counter)}"
+    cigar = f"{clipped}S{100 - clipped}M" if clipped else "100M"
+    flags = (0x10 if neg else 0) | (0 if primary else 0x100)
+    return dict(
+        name=name, flags=flags, contig_idx=SD.index(ref), start=start,
+        mapq=60, cigar=cigar, seq="A" * 100, qual=chr(phred + 33) * 100,
+        read_group_idx=0, **kw,
+    )
+
+
+def unmapped_read(name=None):
+    return dict(
+        name=name or f"read{next(_counter)}", flags=0x4, contig_idx=-1,
+        start=-1, mapq=0, cigar="*", seq="A" * 100, qual="5" * 100,
+        read_group_idx=0,
+    )
+
+
+def pair(ref1, s1, ref2, s2, name=None, phred=20):
+    name = name or f"pair{next(_counter)}"
+    r1 = mapped_read(ref1, s1, name=name, phred=phred)
+    r1["flags"] |= 0x1 | 0x40
+    r1["mate_contig_idx"] = SD.index(ref2)
+    r1["mate_start"] = s2
+    r2 = mapped_read(ref2, s2, name=name, phred=phred, neg=True)
+    r2["flags"] |= 0x1 | 0x80
+    r2["mate_contig_idx"] = SD.index(ref1)
+    r2["mate_start"] = s1
+    return [r1, r2]
+
+
+def run_markdup(recs):
+    batch, side = pack_reads(recs)
+    ds = AlignmentDataset(batch, side, SamHeader(seq_dict=SD, read_groups=RGD))
+    out = ds.mark_duplicates()
+    b = out.batch.to_numpy()
+    dup = (np.asarray(b.flags) & schema.FLAG_DUPLICATE) != 0
+    return {out.sidecar.names[i]: bool(dup[i]) for i in range(b.n_rows) if b.valid[i]}, out
+
+
+def dup_names(dups):
+    return {n for n, d in dups.items() if d}
+
+
+def test_single_read():
+    dups, _ = run_markdup([mapped_read("0", 100)])
+    assert not any(dups.values())
+
+
+def test_reads_at_different_positions():
+    dups, _ = run_markdup([mapped_read("0", 42), mapped_read("0", 43)])
+    assert not any(dups.values())
+
+
+def test_reads_at_same_position():
+    recs = [mapped_read("1", 42, name="best", phred=30)] + [
+        mapped_read("1", 42, name=f"poor{i}", phred=20) for i in range(10)
+    ]
+    dups, _ = run_markdup(recs)
+    assert not dups["best"]
+    assert dup_names(dups) == {f"poor{i}" for i in range(10)}
+
+
+def test_reads_at_same_position_with_clipping():
+    recs = (
+        [mapped_read("1", 42, name="best", phred=30)]
+        + [mapped_read("1", 44, name=f"poorClipped{i}", clipped=2) for i in range(5)]
+        + [mapped_read("1", 42, name=f"poorUnclipped{i}") for i in range(5)]
+    )
+    dups, _ = run_markdup(recs)
+    assert not dups["best"]
+    assert len(dup_names(dups)) == 10
+
+
+def test_reads_on_reverse_strand():
+    recs = [mapped_read("10", 42, name="best", phred=30, neg=True)] + [
+        mapped_read("10", 42, name=f"poor{i}", neg=True) for i in range(7)
+    ]
+    dups, _ = run_markdup(recs)
+    assert not dups["best"]
+    assert len(dup_names(dups)) == 7
+
+
+def test_unmapped_reads():
+    dups, _ = run_markdup([unmapped_read(f"u{i}") for i in range(10)])
+    assert not any(dups.values())
+
+
+def test_read_pairs():
+    recs = pair("0", 10, "0", 110, name="best", phred=30)
+    for i in range(10):
+        recs += pair("0", 10, "0", 110, name=f"poor{i}")
+    dups, _ = run_markdup(recs)
+    assert not dups["best"]
+    assert dup_names(dups) == {f"poor{i}" for i in range(10)}
+
+
+def test_read_pairs_with_fragments():
+    """Pairs always beat fragments at the same left position, regardless
+    of score."""
+    recs = [mapped_read("2", 33, name=f"fragment{i}", phred=40) for i in range(10)]
+    recs += pair("2", 33, "2", 100, name="pair", phred=20)
+    dups, _ = run_markdup(recs)
+    assert not dups["pair"]
+    assert dup_names(dups) == {f"fragment{i}" for i in range(10)}
+
+
+def test_quality_score():
+    """Score = sum of phred >= 15 (MarkDuplicates.scala:45-47)."""
+    from adam_tpu.pipelines.markdup import _device_read_columns
+
+    batch, _ = pack_reads(
+        [
+            mapped_read("0", 1, phred=20),
+            dict(name="mixed", flags=0, contig_idx=0, start=1, mapq=60,
+                 cigar="4M", seq="ACGT", qual=chr(33 + 20) * 2 + chr(33 + 10) * 2,
+                 read_group_idx=0),
+        ]
+    )
+    _, score = _device_read_columns(batch.to_device())
+    assert int(np.asarray(score)[0]) == 2000
+    assert int(np.asarray(score)[1]) == 40  # phred-10 bases don't count
+
+
+def test_read_pairs_cross_chromosome():
+    recs = pair("ref0", 10, "ref1", 110, name="best", phred=30)
+    for i in range(10):
+        recs += pair("ref0", 10, "ref1", 110, name=f"poor{i}")
+    dups, _ = run_markdup(recs)
+    assert not dups["best"]
+    assert dup_names(dups) == {f"poor{i}" for i in range(10)}
+
+
+def test_secondary_alignments_marked_with_bucket():
+    """Secondary alignments of the best bucket are still duplicates."""
+    best = [mapped_read("1", 42, name="best", phred=30),
+            mapped_read("1", 42, name="best", phred=30, primary=False)]
+    poor = [mapped_read("1", 42, name="poor", phred=20)]
+    dups, out = run_markdup(best + poor)
+    b = out.batch.to_numpy()
+    flags = np.asarray(b.flags)
+    by_name = {}
+    for i in range(b.n_rows):
+        key = (out.sidecar.names[i], bool(flags[i] & 0x100))
+        by_name[key] = bool(flags[i] & schema.FLAG_DUPLICATE)
+    assert by_name[("best", False)] is False
+    assert by_name[("best", True)] is True  # secondary of winner still dup
+    assert by_name[("poor", False)] is True

@@ -49,7 +49,7 @@ def test_cigar_walks():
 
 
 def test_five_prime_position():
-    # forward read: unclipped start; reverse: unclipped end - 1
+    # forward read: unclipped start; reverse: exclusive unclipped end
     b = _cig_batch(["2S8M", "2S8M"], [100, 100])
     flags = np.array([0, schema.FLAG_REVERSE], np.int32)
     fp = np.asarray(
@@ -57,7 +57,7 @@ def test_five_prime_position():
             b.start, b.end, flags, b.cigar_ops, b.cigar_lens, b.cigar_n
         )
     )
-    np.testing.assert_array_equal(fp, [98, 107])
+    np.testing.assert_array_equal(fp, [98, 108])
 
 
 def test_reference_positions():

@@ -1,0 +1,61 @@
+import numpy as np
+
+from adam_tpu.formats import schema
+from adam_tpu.io import load_alignments
+
+
+def test_sort_reads12(ref_resources):
+    ds = load_alignments(str(ref_resources / "reads12.sam")).sort_by_reference_position()
+    b = ds.batch.to_numpy()
+    valid = np.asarray(b.valid)
+    contigs = np.asarray(b.contig_idx)[valid]
+    starts = np.asarray(b.start)[valid]
+    names = ds.seq_dict.names
+    # non-decreasing (contig-name-rank, start)
+    ranks = np.argsort(np.argsort(np.array(names, dtype=object)))
+    keys = list(zip((ranks[contigs]).tolist(), starts.tolist()))
+    assert keys == sorted(keys)
+
+
+def test_sort_unmapped_last_by_name():
+    from adam_tpu.api.datasets import AlignmentDataset
+    from adam_tpu.formats.batch import pack_reads
+    from adam_tpu.io.sam import SamHeader
+    from adam_tpu.models.dictionaries import SequenceDictionary, SequenceRecord
+
+    sd = SequenceDictionary((SequenceRecord("chr2", 1000), SequenceRecord("chr10", 1000)))
+    recs = [
+        dict(name="u_b", flags=4, contig_idx=-1, start=-1, mapq=0, cigar="*",
+             seq="AC", qual="II"),
+        dict(name="m1", flags=0, contig_idx=0, start=5, mapq=60, cigar="2M",
+             seq="AC", qual="II"),
+        dict(name="u_a", flags=4, contig_idx=-1, start=-1, mapq=0, cigar="*",
+             seq="AC", qual="II"),
+        dict(name="m2", flags=0, contig_idx=1, start=1, mapq=60, cigar="2M",
+             seq="AC", qual="II"),
+    ]
+    batch, side = pack_reads(recs)
+    ds = AlignmentDataset(batch, side, SamHeader(seq_dict=sd))
+    out = ds.sort_by_reference_position()
+    # lexicographic contig names: chr10 < chr2, unmapped last by name
+    assert out.sidecar.names == ["m2", "m1", "u_a", "u_b"]
+
+
+def test_sort_placed_unmapped_goes_last():
+    """FLAG 0x4 with mate's RNAME/POS still sorts after mapped reads."""
+    from adam_tpu.api.datasets import AlignmentDataset
+    from adam_tpu.formats.batch import pack_reads
+    from adam_tpu.io.sam import SamHeader
+    from adam_tpu.models.dictionaries import SequenceDictionary, SequenceRecord
+
+    sd = SequenceDictionary((SequenceRecord("1", 1000),))
+    recs = [
+        dict(name="placed_unmapped", flags=4, contig_idx=0, start=5, mapq=0,
+             cigar="*", seq="AC", qual="II"),
+        dict(name="mapped_late", flags=0, contig_idx=0, start=500, mapq=60,
+             cigar="2M", seq="AC", qual="II"),
+    ]
+    batch, side = pack_reads(recs)
+    ds = AlignmentDataset(batch, side, SamHeader(seq_dict=sd))
+    out = ds.sort_by_reference_position()
+    assert out.sidecar.names == ["mapped_late", "placed_unmapped"]
