@@ -107,7 +107,12 @@ def save_alignments(
                 pa.string(),
             ),
             "qual": pa.array(
-                [schema.decode_quals(b.quals[i], int(b.lengths[i])) for i in rows],
+                [
+                    schema.decode_quals(b.quals[i], int(b.lengths[i]))
+                    if b.has_qual[i]
+                    else None
+                    for i in rows
+                ],
                 pa.string(),
             ),
             "flags": pa.array([int(b.flags[i]) for i in rows], pa.int32()),

@@ -112,7 +112,9 @@ def observe_kernel(
     cycles = compute_cycles(lengths, flags, lmax)
     dinucs = compute_dinucs(bases, lengths, flags, lmax)
     q = jnp.clip(quals.astype(jnp.int32), 0, N_QUAL - 1)
-    rg = jnp.clip(read_group_idx.astype(jnp.int32), 0, n_rg - 1)
+    # reads without a read group get their own bin (index n_rg - 1 of the
+    # n_rg = len(groups)+1 bins), like the reference's null readGroup key
+    rg = jnp.where(read_group_idx >= 0, read_group_idx, n_rg - 1).astype(jnp.int32)
     include = residue_ok & read_ok[:, None]
 
     flat_key = (
@@ -146,28 +148,28 @@ class ObservationTable:
         return "ACGT"[idx // 4] + "ACGT"[idx % 4]
 
     @staticmethod
-    def empirical_quality(total: int, mismatches: int) -> int:
-        """Bayes with Beta(1,1): (1+mm)/(2+total) -> phred
-        (ObservationTable.scala:55-59)."""
-        from adam_tpu.ops.phred import error_probability_to_phred
-
-        p = (1.0 + mismatches) / (2.0 + total)
-        return int(error_probability_to_phred(p))
+    def empirical_quality(total, mismatches):
+        """Bayes with Beta(1,1): (1+mm)/(2+total) -> phred with Scala
+        math.round = floor(x+0.5) (ObservationTable.scala:55-59,
+        PhredUtils rounding).  Vectorized numpy."""
+        p = (1.0 + np.asarray(mismatches)) / (2.0 + np.asarray(total))
+        return np.floor(-10.0 * np.log10(p) + 0.5).astype(np.int64)
 
     def to_csv(self) -> str:
         lines = ["ReadGroup,ReportedQ,Cycle,Dinuc,TotalCount,MismatchCount,EmpiricalQ,IsSkipped"]
         rg_idx, q_idx, c_idx, d_idx = np.nonzero(self.total)
-        for rg, q, c, d in zip(rg_idx, q_idx, c_idx, d_idx):
-            t = int(self.total[rg, q, c, d])
-            m = int(self.mismatches[rg, q, c, d])
+        totals = self.total[rg_idx, q_idx, c_idx, d_idx]
+        mms = self.mismatches[rg_idx, q_idx, c_idx, d_idx]
+        emp = self.empirical_quality(totals, mms)
+        for rg, q, c, d, t, m, e in zip(rg_idx, q_idx, c_idx, d_idx, totals, mms, emp):
             fields = [
                 self.rg_names[rg],
                 str(int(q)),
                 str(int(c) - self.lmax),
                 self._dinuc_str(int(d)),
-                str(t),
-                str(m),
-                str(self.empirical_quality(t, m)),
+                str(int(t)),
+                str(int(m)),
+                str(int(e)),
             ]
             if d == DINUC_NONE:
                 fields.append("**")
@@ -211,14 +213,15 @@ def build_observation_table(
         )
         residue_ok &= ~masked
 
-    n_rg = max(len(ds.read_groups), 1)
+    # one extra bin for RG-less reads (the reference's null readGroup)
+    n_rg = len(ds.read_groups) + 1
     total, mism = observe_kernel(
         jnp.asarray(b.bases), jnp.asarray(b.quals), jnp.asarray(b.lengths),
         jnp.asarray(flags), jnp.asarray(b.read_group_idx),
         jnp.asarray(residue_ok), jnp.asarray(is_mm), jnp.asarray(read_ok),
         n_rg, lmax,
     )
-    rg_names = ds.read_groups.names or ["null"]
+    rg_names = ds.read_groups.names + ["null"]
     return ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
 
 
@@ -256,8 +259,8 @@ def recalibrate_kernel(
     d_m = mismatches.sum(axis=2)
 
     n_rg = total.shape[0]
-    rg = jnp.clip(read_group_idx.astype(jnp.int32), 0, n_rg - 1)
-    rg_known = (read_group_idx >= 0) & (read_group_idx < n_rg)
+    # RG-less reads use the dedicated last bin, symmetric with observe
+    rg = jnp.where(read_group_idx >= 0, read_group_idx, n_rg - 1).astype(jnp.int32)
     q = jnp.clip(quals.astype(jnp.int32), 0, N_QUAL - 1)
     cycles = compute_cycles(lengths, flags, lmax) + lmax
     dinucs = compute_dinucs(bases, lengths, flags, lmax)
@@ -267,7 +270,7 @@ def recalibrate_kernel(
     gt = g_t[rg][:, None] * jnp.ones_like(q)  # broadcast [N, L]
     gm = g_m[rg][:, None] * jnp.ones_like(q)
     gexp = g_exp[rg][:, None] * jnp.ones_like(residue_logp)
-    g_present = (gt > 0) & rg_known[:, None]
+    g_present = gt > 0
     global_delta = jnp.where(
         g_present, emp_log(gt, gm) - jnp.log(gexp / jnp.maximum(gt, 1)), 0.0
     )

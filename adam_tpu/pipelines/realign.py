@@ -1,0 +1,725 @@
+"""GATK-style local indel realignment.
+
+Faithful semantics of the reference's ``rdd/read/realignment/`` +
+``algorithms/consensus/`` packages, re-shaped for TPU:
+
+1. **Target discovery** (RealignmentTargetFinder.scala:99-121,
+   IndelRealignmentTarget.scala:108-143): every I/D CIGAR op (length <=
+   maxIndelSize) yields a target (variation region, read span); targets
+   sort by read span, merge while overlapping (variation hulls), dedupe
+   on equal read spans (TreeSet semantics) and drop spans >
+   maxTargetSize.  Here target extraction is a vectorized walk over the
+   cigar columns.
+2. **Read -> target mapping** (RealignIndels.mapToTarget:72-94): the
+   reference's recursive set-halving search, including its exact pruning
+   rule and the empty-target skew split ``-1 - start/3000``; vectorized
+   so all reads binary-search simultaneously.
+3. **Per-target realignment** (RealignIndels.realignTargetGroup:235-387):
+   rebuild the reference from MD tags, left-normalize single-indel reads,
+   take each indel read's alternate consensus (Consensus.scala:25-70),
+   sweep every read over every consensus, accept the best consensus when
+   the LOD improvement ((old-new)/10) beats the threshold, and rewrite
+   start/CIGAR/MD (+10 mapq, OC/OP provenance tags).
+4. The O(|reads| x |offsets| x |readLen|) **sweep**
+   (sweepReadOverReferenceForQuality:399-417) is the hot loop: here it is
+   one batched device kernel — mismatch-quality(b, o) = totalQual(b) -
+   match-correlation(b, o), computed as a per-pair one-hot conv
+   (MXU-shaped) over all (read, consensus) pairs of all targets at once.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, replace as dc_replace
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.formats import schema
+from adam_tpu.models.snp_table import IndelTable
+from adam_tpu.ops.mdtag import MdTag, parse_cigar
+
+MAX_INDEL_SIZE = 500
+MAX_CONSENSUS_NUMBER = 30
+LOD_THRESHOLD = 5.0
+MAX_TARGET_SIZE = 3000
+
+
+# --------------------------------------------------------------------------
+# CIGAR list helpers (host)
+# --------------------------------------------------------------------------
+def cigar_to_string(elems: list[tuple[int, str]]) -> str:
+    return "".join(f"{n}{op}" for n, op in elems)
+
+
+def cigar_read_len(elems) -> int:
+    return sum(n for n, op in elems if op in "MIS=X")
+
+
+def cigar_num_alignment_blocks(elems) -> int:
+    return sum(1 for _, op in elems if op == "M")
+
+
+def _cigar_total_len(elems) -> int:
+    """Sum of ALL element lengths (RichCigar.getLength — includes D)."""
+    return sum(n for n, _ in elems)
+
+
+def move_cigar_left(elems: list[tuple[int, str]], index: int):
+    """RichCigar.moveLeft semantics (rich/RichCigar.scala:140-186):
+    trim one base from the element before ``index``, grow (or create, as
+    1M) the element after it.  Replicates the reference's slicing,
+    including dropping a 4th element when exactly 4 remain after the
+    indel context."""
+    if index == 0 or len(elems) < 2:
+        return list(elems)
+    head = list(elems[: index - 1])
+    rest = list(elems[index - 1 :])
+    trim = rest[0]
+    move = rest[1] if len(rest) > 1 else None
+    pad = rest[2] if len(rest) > 2 else None
+    after_pad = rest[3:] if len(rest) > 4 else []
+    out = list(head)
+    if trim[0] > 1:
+        out.append((trim[0] - 1, trim[1]))
+    if move is not None:
+        out.append(move)
+    if pad is not None:
+        out.append((pad[0] + 1, pad[1]))
+    else:
+        out.append((1, "M"))
+    out += after_pad
+    return out
+
+
+def shift_indel(elems, position: int, shifts: int):
+    """NormalizationUtils.shiftIndel (:142-153)."""
+    cur = list(elems)
+    total = _cigar_total_len(cur)
+    while True:
+        new = move_cigar_left(cur, position)
+        if shifts == 0 or _cigar_total_len(new) != total:
+            return cur
+        cur = new
+        shifts -= 1
+
+
+def positions_to_shift(variant: str, preceding: str) -> int:
+    """NormalizationUtils.numberOfPositionsToShiftIndel (:115-131)."""
+    acc = 0
+    v, p = variant, preceding
+    while p and v and p[-1] == v[-1]:
+        v = v[-1] + v[:-1]
+        p = p[:-1]
+        acc += 1
+    return acc
+
+
+def left_align_indel(seq: str, cigar: list, md: Optional[MdTag]):
+    """NormalizationUtils.leftAlignIndel (:35-100): shift the single indel
+    left through repeated sequence.  Returns a new cigar list."""
+    indel_pos = -1
+    indel_len = 0
+    read_pos = ref_pos = 0
+    is_insert = False
+    for pos, (n, op) in enumerate(cigar):
+        if op == "I":
+            if indel_pos != -1:
+                return list(cigar)
+            indel_pos, indel_len, is_insert = pos, n, True
+        elif op == "D":
+            if indel_pos != -1:
+                return list(cigar)
+            indel_pos, indel_len = pos, n
+        else:
+            if indel_pos == -1:
+                if op in "MIS=X":
+                    read_pos += n
+                if op in "MDN=X":
+                    ref_pos += n
+    if indel_pos == -1:
+        return list(cigar)
+    if is_insert:
+        variant = seq[read_pos : read_pos + indel_len]
+    else:
+        if md is None:
+            return list(cigar)
+        ref = md.get_reference(seq, cigar_to_string(cigar))
+        variant = ref[ref_pos : ref_pos + indel_len]
+    preceding = seq[:read_pos]
+    shift = positions_to_shift(variant, preceding)
+    return shift_indel(cigar, indel_pos, shift)
+
+
+# --------------------------------------------------------------------------
+# Targets
+# --------------------------------------------------------------------------
+@dataclass
+class RealignmentTarget:
+    contig_idx: int
+    var_start: int  # -1/-1 when no variation
+    var_end: int
+    range_start: int
+    range_end: int
+
+    @property
+    def has_variation(self) -> bool:
+        return self.var_start >= 0
+
+
+def extract_indel_events(b) -> list[RealignmentTarget]:
+    """Per-read I/D targets (IndelRealignmentTarget.apply), vectorized
+    over the cigar columns."""
+    n, C = b.cigar_ops.shape
+    ops = np.asarray(b.cigar_ops)
+    lens = np.asarray(b.cigar_lens).astype(np.int64)
+    flags = np.asarray(b.flags)
+    active = np.asarray(b.valid) & ((flags & schema.FLAG_UNMAPPED) == 0)
+    ref_pos = np.asarray(b.start).astype(np.int64).copy()
+    starts = np.asarray(b.start).astype(np.int64)
+    ends = np.asarray(b.end).astype(np.int64)
+    contigs = np.asarray(b.contig_idx)
+    out = []
+    for k in range(C):
+        op = ops[:, k]
+        ln = lens[:, k]
+        ins = active & (op == schema.CIGAR_I) & (ln <= MAX_INDEL_SIZE)
+        dele = active & (op == schema.CIGAR_D) & (ln <= MAX_INDEL_SIZE)
+        for i in np.flatnonzero(ins):
+            out.append(
+                RealignmentTarget(int(contigs[i]), int(ref_pos[i]),
+                                  int(ref_pos[i]) + 1, int(starts[i]), int(ends[i]))
+            )
+        for i in np.flatnonzero(dele):
+            out.append(
+                RealignmentTarget(int(contigs[i]), int(ref_pos[i]),
+                                  int(ref_pos[i]) + int(ln[i]), int(starts[i]),
+                                  int(ends[i]))
+            )
+        consumes_ref = np.isin(op, [schema.CIGAR_M, schema.CIGAR_D,
+                                    schema.CIGAR_N, schema.CIGAR_EQ,
+                                    schema.CIGAR_X])
+        ref_pos += np.where(consumes_ref, ln, 0)
+    return out
+
+
+def _targets_overlap(a: RealignmentTarget, b: RealignmentTarget) -> bool:
+    """TargetOrdering.overlap: either variation overlaps the other's span."""
+    def ov(vs, ve, rs, re):
+        return ve > rs and re > vs
+
+    if a.contig_idx != b.contig_idx:
+        return False
+    return (a.has_variation and ov(a.var_start, a.var_end, b.range_start, b.range_end)) or (
+        b.has_variation and ov(b.var_start, b.var_end, a.range_start, a.range_end)
+    )
+
+
+def find_targets(ds: AlignmentDataset, max_target_size: int = MAX_TARGET_SIZE):
+    """Sorted, merged, deduped target list."""
+    b = ds.batch.to_numpy()
+    events = extract_indel_events(b)
+    if not events:
+        return []
+    names = ds.seq_dict.names
+    events.sort(key=lambda t: (names[t.contig_idx], t.range_start, t.range_end))
+    merged: list[RealignmentTarget] = []
+    for t in events:
+        if merged and _targets_overlap(merged[-1], t):
+            m = merged[-1]
+            merged[-1] = RealignmentTarget(
+                m.contig_idx,
+                min(m.var_start, t.var_start) if m.has_variation and t.has_variation
+                else (m.var_start if m.has_variation else t.var_start),
+                max(m.var_end, t.var_end) if m.has_variation and t.has_variation
+                else (m.var_end if m.has_variation else t.var_end),
+                min(m.range_start, t.range_start),
+                max(m.range_end, t.range_end),
+            )
+        elif merged and (
+            merged[-1].contig_idx == t.contig_idx
+            and merged[-1].range_start == t.range_start
+            and merged[-1].range_end == t.range_end
+        ):
+            pass  # TreeSet equality on readRange: duplicate dropped
+        else:
+            merged.append(t)
+    return [t for t in merged if t.range_end - t.range_start <= max_target_size]
+
+
+def map_reads_to_targets(
+    read_contig_rank, read_start, read_end, mapped_mask,
+    target_rank, target_start, target_end,
+) -> np.ndarray:
+    """Vectorized replica of RealignIndels.mapToTarget's set-halving
+    search (:72-94), including its pruning rule and the
+    ``-1 - start/3000`` empty-target spreading."""
+    n = len(read_start)
+    nt = len(target_start)
+    lo = np.zeros(n, dtype=np.int64)
+    hi = np.full(n, nt, dtype=np.int64)
+    while True:
+        size = hi - lo
+        if (size <= 1).all():
+            break
+        mult = size > 1
+        mid = lo + size // 2
+        m = np.clip(mid, 0, nt - 1)
+        # lt(targets[mid], read): target orders before read (name,start,end)
+        t_key_lt = (
+            (target_rank[m] < read_contig_rank)
+            | ((target_rank[m] == read_contig_rank) & (target_start[m] < read_start))
+            | ((target_rank[m] == read_contig_rank) & (target_start[m] == read_start)
+               & (target_end[m] < read_end))
+        ) & mapped_mask
+        hi = np.where(mult & t_key_lt, mid, hi)
+        lo = np.where(mult & ~t_key_lt, mid, lo)
+    t = np.clip(lo, 0, nt - 1)
+    contains = (
+        mapped_mask
+        & (target_rank[t] == read_contig_rank)
+        & (target_end[t] > read_start)
+        & (read_end > target_start[t])
+    )
+    empty = (-1 - read_start // 3000).astype(np.int64)
+    return np.where(contains, t, empty)
+
+
+# --------------------------------------------------------------------------
+# Batched sweep kernel (device)
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("lr", "lc"))
+def sweep_kernel(read_codes, read_quals, read_len, cons_codes, cons_len,
+                 lr: int, lc: int):
+    """For each (read, consensus) pair: mismatch quality at every offset.
+
+    mismatchQual(b, o) = sum_i q_i [read_i != cons_{o+i}]
+                       = totalQual(b) - sum_i q_i [read_i == cons_{o+i}]
+    with the match-correlation computed as a one-hot conv per pair.
+    Valid offsets o in [0, cons_len - read_len) (the reference's
+    exclusive sweep loop).  Returns (best_qual i32[B], best_offset i32[B])
+    with the smallest offset winning ties; best_offset = -1 when no valid
+    offset exists.
+    """
+    B = read_codes.shape[0]
+    in_read = jnp.arange(lr)[None, :] < read_len[:, None]
+    q = jnp.where(in_read, read_quals, 0).astype(jnp.float32)
+    total_q = q.sum(axis=1)
+    # one-hot over the 6 codes (N==N matches, PAD never matches quals=0)
+    read_oh = jax.nn.one_hot(read_codes, 6, dtype=jnp.float32) * q[..., None]
+    cons_oh = jax.nn.one_hot(cons_codes, 6, dtype=jnp.float32)
+
+    def corr(x, w):
+        # x: [lc, 6] one-hot consensus; w: [lr, 6] qual-weighted read
+        return jax.lax.conv_general_dilated(
+            x[None], w[:, :, None],
+            window_strides=(1,), padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )[0, :, 0]
+
+    match = jax.vmap(corr)(cons_oh, read_oh)  # [B, lc - lr + 1]
+    mismatch = total_q[:, None] - match
+    n_off = lc - lr + 1
+    offs = jnp.arange(n_off)[None, :]
+    valid = offs < (cons_len - read_len)[:, None]  # exclusive upper bound
+    masked = jnp.where(valid, mismatch, jnp.inf)
+    best_off = jnp.argmin(masked, axis=1).astype(jnp.int32)
+    best_q = jnp.min(masked, axis=1)
+    has_any = valid.any(axis=1)
+    return (
+        jnp.where(has_any, best_q, jnp.inf),
+        jnp.where(has_any, best_off, -1),
+    )
+
+
+def _sum_mismatch_quality(seq: str, ref: str, quals) -> int:
+    """sumMismatchQualityIgnoreCigar: positional zip, truncating to the
+    shorter string (RealignIndels.scala:429-440)."""
+    return int(
+        sum(q for a, b, q in zip(seq, ref, quals) if a != b)
+    )
+
+
+# --------------------------------------------------------------------------
+# Per-target realignment (host orchestration)
+# --------------------------------------------------------------------------
+@dataclass
+class _Read:
+    """Host view of one read under realignment."""
+
+    row: int
+    seq: str
+    quals: list
+    start: int
+    cigar: list  # [(len, op)]
+    md: Optional[MdTag]
+    mapq: int
+
+    @property
+    def end(self) -> int:
+        return self.start + sum(n for n, op in self.cigar if op in "MDN=X")
+
+
+def _get_reference_from_reads(reads: list[_Read]):
+    """RealignIndels.getReferenceFromReads (:185-215)."""
+    refs = []
+    for r in reads:
+        if r.md is not None:
+            refs.append((r.md.get_reference(r.seq, cigar_to_string(r.cigar)),
+                         r.start, r.end))
+    if not refs:
+        raise ValueError("no reads with MD tags in target group")
+    refs.sort(key=lambda x: x[1])
+    ref, cur = "", refs[0][1]
+    ref_start = refs[0][1]
+    for s, start, end in refs:
+        if end < cur:
+            continue
+        if cur >= start:
+            ref += s[cur - start :]
+            cur = end
+        else:
+            raise ValueError(f"gap at {cur} with {start},{end} rebuilding reference")
+    return ref, ref_start, cur
+
+
+@dataclass(frozen=True)
+class Consensus:
+    """models/Consensus.scala: an alternate allele to splice into the
+    reference — insertion when index spans 1bp."""
+
+    consensus: str
+    contig_idx: int
+    index_start: int
+    index_end: int
+
+    def insert_into_reference(self, reference: str, ref_start: int, ref_end: int) -> str:
+        if (self.index_start < ref_start or self.index_start > ref_end
+                or self.index_end - 1 < ref_start or self.index_end - 1 > ref_end):
+            raise ValueError("consensus and reference do not overlap")
+        return (
+            reference[: self.index_start - ref_start]
+            + self.consensus
+            + reference[self.index_end - 1 - ref_start :]
+        )
+
+
+def generate_alternate_consensus(seq: str, start: int, contig_idx: int,
+                                 cigar: list) -> Optional[Consensus]:
+    """Consensus.generateAlternateConsensus (:25-52)."""
+    read_pos = 0
+    ref_pos = start
+    if sum(1 for _, op in cigar if op in "ID") != 1:
+        return None
+    for n, op in cigar:
+        if op == "I":
+            return Consensus(seq[read_pos : read_pos + n], contig_idx,
+                             ref_pos, ref_pos + 1)
+        if op == "D":
+            return Consensus("", contig_idx, ref_pos, ref_pos + n + 1)
+        if op in "M=X":
+            read_pos += n
+            ref_pos += n
+        else:
+            return None
+    return None
+
+
+def realign_indels(
+    ds: AlignmentDataset,
+    consensus_model: str = "reads",
+    known_indels: Optional[IndelTable] = None,
+    max_indel_size: int = MAX_INDEL_SIZE,
+    max_consensus_number: int = MAX_CONSENSUS_NUMBER,
+    lod_threshold: float = LOD_THRESHOLD,
+    max_target_size: int = MAX_TARGET_SIZE,
+    sw_weights: tuple = (1.0, -0.333, -0.5, -0.5),
+    rng: Optional[random.Random] = None,
+) -> AlignmentDataset:
+    b = ds.batch.to_numpy()
+    n = b.n_rows
+    if n == 0:
+        return ds
+    targets = find_targets(ds, max_target_size)
+    if not targets:
+        return ds
+    names = ds.seq_dict.names
+    rank_of_name = {nm: i for i, nm in enumerate(sorted(names))}
+    contig_rank = np.array([rank_of_name[nm] for nm in names], dtype=np.int64)
+
+    t_rank = np.array([contig_rank[t.contig_idx] for t in targets], dtype=np.int64)
+    t_start = np.array([t.range_start for t in targets], dtype=np.int64)
+    t_end = np.array([t.range_end for t in targets], dtype=np.int64)
+
+    flags = np.asarray(b.flags)
+    mapped = ((flags & schema.FLAG_UNMAPPED) == 0) & np.asarray(b.valid)
+    read_rank = np.where(
+        mapped, contig_rank[np.clip(np.asarray(b.contig_idx), 0, len(names) - 1)], -1
+    )
+    tidx = map_reads_to_targets(
+        read_rank, np.asarray(b.start).astype(np.int64),
+        np.asarray(b.end).astype(np.int64), mapped, t_rank, t_start, t_end,
+    )
+
+    # group rows by target, position-sorted within the group (the
+    # reference sorts the RDD before target mapping)
+    groups: dict[int, list[int]] = {}
+    for i in np.flatnonzero(mapped):
+        t = int(tidx[i])
+        if t >= 0:
+            groups.setdefault(t, []).append(i)
+    for rows in groups.values():
+        rows.sort(key=lambda i: (int(b.start[i]), i))
+
+    new_batch = jax.tree.map(np.array, b)  # writable copies
+    side = ds.sidecar
+    new_md = list(side.md)
+    new_attrs = list(side.attrs)
+    rng = rng or random.Random(0)
+
+    # ---- phase 1 (host): per group, rebuild reference + consensuses ----
+    sweep_tasks = []  # (group_key, read, consensus, reference, ref_start)
+    group_ctx = {}
+    for t, rows in groups.items():
+        reads = []
+        for i in rows:
+            L = int(b.lengths[i])
+            reads.append(
+                _Read(
+                    row=i,
+                    seq=schema.decode_bases(b.bases[i], L),
+                    quals=[int(q) for q in b.quals[i][:L]],
+                    start=int(b.start[i]),
+                    cigar=parse_cigar(
+                        schema.decode_cigar(b.cigar_ops[i], b.cigar_lens[i],
+                                            int(b.cigar_n[i]))
+                    ),
+                    md=MdTag.parse(side.md[i], int(b.start[i]))
+                    if side.md[i] is not None
+                    else None,
+                    mapq=int(b.mapq[i]),
+                )
+            )
+        # reads that already match the reference pass through untouched
+        to_clean = [r for r in reads if r.md is None or r.md.mismatches]
+        if not to_clean:
+            continue
+        try:
+            reference, ref_start, ref_end = _get_reference_from_reads(reads)
+        except ValueError:
+            continue
+        contig_idx = targets[t].contig_idx
+
+        # preprocess: left-normalize single-indel reads (and SW-realign
+        # everything first under the smithwaterman model)
+        if consensus_model == "smithwaterman":
+            to_clean = _sw_preprocess(
+                to_clean, reference, ref_start, sw_weights
+            )
+        processed = []
+        for r in to_clean:
+            if cigar_num_alignment_blocks(r.cigar) == 2:
+                new_cigar = left_align_indel(r.seq, r.cigar, r.md)
+                md = MdTag.move_alignment(
+                    r.md.get_reference(r.seq, cigar_to_string(r.cigar)),
+                    r.seq, cigar_to_string(new_cigar), r.start,
+                ) if r.md is not None else None
+                processed.append(dc_replace(r, cigar=new_cigar, md=md))
+            else:
+                processed.append(r)
+        to_clean = processed
+
+        # find consensuses
+        consensuses: list[Consensus] = []
+        if consensus_model == "knowns" and known_indels is not None:
+            region_name = names[contig_idx]
+            from adam_tpu.models.positions import ReferenceRegion
+
+            for rec in known_indels.get_indels_in_region(
+                ReferenceRegion(region_name, ref_start, ref_end)
+            ):
+                consensuses.append(
+                    Consensus(rec.consensus, contig_idx,
+                              rec.region.start, rec.region.end)
+                )
+        else:
+            for r in to_clean:
+                if r.md is None:
+                    continue
+                c = generate_alternate_consensus(
+                    r.seq, r.start, contig_idx, r.cigar
+                )
+                if c is not None:
+                    consensuses.append(c)
+        # distinct
+        seen = set()
+        uniq = []
+        for c in consensuses:
+            key = (c.consensus, c.index_start, c.index_end)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(c)
+        consensuses = uniq
+        if len(consensuses) > max_consensus_number:
+            consensuses = rng.sample(consensuses, max_consensus_number)
+        if not consensuses:
+            # still keep preprocessing results (readsToClean ++ realigned)
+            _write_back(new_batch, new_md, new_attrs, to_clean, realigned={})
+            continue
+
+        group_ctx[t] = (to_clean, consensuses, reference, ref_start, ref_end)
+        for ci, c in enumerate(consensuses):
+            cons_seq = c.insert_into_reference(reference, ref_start, ref_end)
+            for ri, r in enumerate(to_clean):
+                sweep_tasks.append((t, ri, ci, r, cons_seq))
+
+    # ---- phase 2 (device): one batched sweep over all pairs ----
+    sweep_results = {}
+    if sweep_tasks:
+        lr = max(len(task[3].seq) for task in sweep_tasks)
+        lc = max(len(task[4]) for task in sweep_tasks)
+        lc = max(lc, lr + 1)
+        B = len(sweep_tasks)
+        rc = np.full((B, lr), schema.BASE_PAD, np.uint8)
+        rq = np.zeros((B, lr), np.int32)
+        rl = np.zeros(B, np.int32)
+        cc = np.full((B, lc), schema.BASE_PAD, np.uint8)
+        cl = np.zeros(B, np.int32)
+        for k, (t, ri, ci, r, cons_seq) in enumerate(sweep_tasks):
+            rc[k, : len(r.seq)] = schema.encode_bases(r.seq)
+            rq[k, : len(r.quals)] = r.quals
+            rl[k] = len(r.seq)
+            cc[k, : len(cons_seq)] = schema.encode_bases(cons_seq)
+            cl[k] = len(cons_seq)
+        best_q, best_o = jax.tree.map(
+            np.asarray,
+            sweep_kernel(jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
+                         jnp.asarray(cc), jnp.asarray(cl), lr, lc),
+        )
+        for k, (t, ri, ci, _, _) in enumerate(sweep_tasks):
+            sweep_results[(t, ri, ci)] = (float(best_q[k]), int(best_o[k]))
+
+    # ---- phase 3 (host): consensus choice + rewrite ----
+    for t, (to_clean, consensuses, reference, ref_start, ref_end) in group_ctx.items():
+        orig_quals = [
+            _sum_mismatch_quality(
+                r.seq,
+                r.md.get_reference(r.seq, cigar_to_string(r.cigar)) if r.md else "",
+                r.quals,
+            )
+            for r in to_clean
+        ]
+        pre_total = sum(orig_quals)
+        outcomes = []
+        for ci in range(len(consensuses)):
+            total = 0
+            mappings = []
+            for ri, r in enumerate(to_clean):
+                q, o = sweep_results.get((t, ri, ci), (np.inf, -1))
+                if q < orig_quals[ri]:
+                    total += int(q)
+                    mappings.append(o)
+                else:
+                    total += orig_quals[ri]
+                    mappings.append(-1)
+            outcomes.append((total, ci, mappings))
+        # best = min total; reference's fold keeps the later-generated
+        # consensus on ties (list prepend + left fold)
+        best_total, best_ci, best_map = min(
+            reversed(outcomes), key=lambda x: x[0]
+        )
+        lod = (pre_total - best_total) / 10.0
+        realigned = {}
+        if lod > lod_threshold:
+            cons = consensuses[best_ci]
+            for ri, r in enumerate(to_clean):
+                o = best_map[ri]
+                if o == -1:
+                    continue
+                new_start = ref_start + o
+                if cons.index_start == cons.index_end - 1:  # insertion
+                    id_elem = (len(cons.consensus), "I")
+                    end_len = len(r.seq) - len(cons.consensus) - (cons.index_start - new_start)
+                    end_penalty = -len(cons.consensus)
+                else:  # deletion
+                    id_elem = (cons.index_end - 1 - cons.index_start, "D")
+                    end_len = len(r.seq) - (cons.index_start - new_start)
+                    end_penalty = len(cons.consensus)
+                new_cigar = [
+                    (cons.index_start - new_start, "M"),
+                    id_elem,
+                    (end_len, "M"),
+                ]
+                new_end = new_start + len(r.seq) + end_penalty
+                md = MdTag.move_alignment(
+                    reference[o:], r.seq, cigar_to_string(new_cigar), new_start
+                )
+                realigned[ri] = dc_replace(
+                    r, start=new_start, cigar=new_cigar, md=md, mapq=r.mapq + 10
+                ), new_end
+        _write_back(new_batch, new_md, new_attrs, to_clean, realigned)
+
+    new_side = dc_replace(side, md=new_md, attrs=new_attrs)
+    return ds.with_batch(new_batch, new_side)
+
+
+def _sw_preprocess(reads, reference, ref_start, weights):
+    """ConsensusGeneratorFromSmithWaterman.preprocessReadsForRealignment
+    (:40-70): SW-align each read against the region; accept when <= 2
+    alignment blocks, rewriting start/cigar/MD (start from the
+    reference's own xStart+regionStart rule)."""
+    from adam_tpu.ops.smith_waterman import smith_waterman
+
+    out = []
+    w_match, w_mismatch, w_insert, w_delete = weights
+    for r in reads:
+        aln = smith_waterman(r.seq, reference, w_match, w_mismatch,
+                             w_insert, w_delete)
+        cigar = parse_cigar(aln.cigar_x)
+        if cigar_num_alignment_blocks(cigar) <= 2:
+            md = MdTag.from_alignment(
+                r.seq, reference[aln.x_start :], aln.cigar_x, ref_start
+            )
+            out.append(
+                dc_replace(r, start=aln.x_start + ref_start, cigar=cigar, md=md)
+            )
+        else:
+            out.append(r)
+    return out
+
+
+def _write_back(new_batch, new_md, new_attrs, to_clean, realigned):
+    """Apply (possibly realigned) host reads back into the batch."""
+    cmax = new_batch.cmax
+    for ri, r in enumerate(to_clean):
+        if ri in realigned:
+            rr, new_end = realigned[ri]
+            old_start = int(new_batch.start[rr.row])
+            old_cigar = schema.decode_cigar(
+                new_batch.cigar_ops[rr.row], new_batch.cigar_lens[rr.row],
+                int(new_batch.cigar_n[rr.row]),
+            )
+            tag = f"OC:Z:{old_cigar}\tOP:i:{old_start + 1}"
+            new_attrs[rr.row] = (
+                new_attrs[rr.row] + "\t" + tag if new_attrs[rr.row] else tag
+            )
+        else:
+            rr, new_end = r, None
+        cig = cigar_to_string(rr.cigar)
+        ops, lens, ncig = schema.encode_cigar(cig, max(cmax, len(rr.cigar)))
+        if ncig > cmax:
+            raise ValueError("realigned cigar exceeds batch cmax")
+        new_batch.cigar_ops[rr.row] = ops[:cmax]
+        new_batch.cigar_lens[rr.row] = lens[:cmax]
+        new_batch.cigar_n[rr.row] = ncig
+        new_batch.start[rr.row] = rr.start
+        new_batch.mapq[rr.row] = rr.mapq
+        if new_end is not None:
+            new_batch.end[rr.row] = new_end
+        else:
+            new_batch.end[rr.row] = rr.end
+        new_md[rr.row] = rr.md.to_string() if rr.md is not None else new_md[rr.row]

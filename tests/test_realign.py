@@ -1,0 +1,167 @@
+"""Indel realignment tests mirroring the reference's RealignIndelsSuite,
+including the GATK IndelRealigner golden-file comparison on
+artificial.sam -> artificial.realigned.sam."""
+
+import numpy as np
+import pytest
+
+from adam_tpu.formats import schema
+from adam_tpu.io import load_alignments
+from adam_tpu.ops.mdtag import MdTag, parse_cigar
+from adam_tpu.pipelines import realign as ra
+
+
+def test_mismatch_quality_scoring():
+    assert ra._sum_mismatch_quality("AAAAAAAA", "AAGGGGAA", [40] * 8) == 160
+    assert ra._sum_mismatch_quality("AAAAAAAA", "AAAAAAAA", [40] * 8) == 0
+
+
+def test_left_align_indel():
+    # GG insert after AAA repeat region: AAAGG|GAA with insert normalizes left
+    # 3M2I3M on seq AAAGGGAA vs ref AAAGAA (insert GG at pos 3)
+    cigar = parse_cigar("4M2I2M")
+    md = MdTag.parse("6", 0)
+    new = ra.left_align_indel("AAGGGGAA", cigar, md)
+    # preceding 'AAGG', variant 'GG' -> shift 2 left
+    assert ra.cigar_to_string(new) == "2M2I4M"
+
+
+def test_positions_to_shift():
+    assert ra.positions_to_shift("GG", "AAGG") == 2
+    assert ra.positions_to_shift("AG", "AAGG") == 1
+    assert ra.positions_to_shift("TT", "AAGG") == 0
+
+
+def test_generate_alternate_consensus():
+    c = ra.generate_alternate_consensus("AAAGGAAA", 100, 0, parse_cigar("3M2I3M"))
+    assert c.consensus == "GG" and (c.index_start, c.index_end) == (103, 104)
+    c = ra.generate_alternate_consensus("AAAAAA", 100, 0, parse_cigar("3M2D3M"))
+    assert c.consensus == "" and (c.index_start, c.index_end) == (103, 106)
+    assert ra.generate_alternate_consensus("AAAA", 100, 0, parse_cigar("1M1I1M1D1M")) is None
+    assert ra.generate_alternate_consensus("AAAA", 100, 0, parse_cigar("4M")) is None
+
+
+def test_consensus_insert_into_reference():
+    cons = ra.Consensus("GG", 0, 103, 104)
+    assert cons.insert_into_reference("AAAATTTT", 100, 108) == "AAAGGATTTT"
+    # deletion of 2bp at 103 (region [103,106) spans len+1): splices out 2 bases
+    dele = ra.Consensus("", 0, 103, 106)
+    assert dele.insert_into_reference("AAAATTTT", 100, 108) == "AAATTT"
+
+
+def test_artificial_targets(ref_resources):
+    ds = load_alignments(str(ref_resources / "artificial.sam"))
+    targets = ra.find_targets(ds)
+    assert len(targets) == 1
+    t = targets[0]
+    assert t.has_variation
+    # all reads starting <= 25 map inside the target; later reads don't
+    b = ds.batch.to_numpy()
+    names = ds.seq_dict.names
+    rank = {nm: i for i, nm in enumerate(sorted(names))}
+    contig_rank = np.array([rank[nm] for nm in names])
+    mapped = np.asarray(b.valid) & ((np.asarray(b.flags) & 4) == 0)
+    tidx = ra.map_reads_to_targets(
+        np.where(mapped, contig_rank[np.clip(b.contig_idx, 0, len(names) - 1)], -1),
+        np.asarray(b.start), np.asarray(b.end), mapped,
+        np.array([contig_rank[t.contig_idx]]),
+        np.array([t.range_start]), np.array([t.range_end]),
+    )
+    for i in range(b.n_rows):
+        if not b.valid[i]:
+            continue
+        if int(b.start[i]) <= 25:
+            assert tidx[i] == 0
+            assert t.range_start <= int(b.start[i]) and t.range_end >= int(b.end[i])
+        else:
+            assert tidx[i] < 0
+
+
+def test_artificial_consensus(ref_resources):
+    ds = load_alignments(str(ref_resources / "artificial.sam"))
+    b = ds.batch.to_numpy()
+    consensus = []
+    for i in range(b.n_rows):
+        if not b.valid[i] or ds.sidecar.md[i] is None:
+            continue
+        md = MdTag.parse(ds.sidecar.md[i], int(b.start[i]))
+        if not md.mismatches:
+            continue
+        cigar = parse_cigar(
+            schema.decode_cigar(b.cigar_ops[i], b.cigar_lens[i], int(b.cigar_n[i]))
+        )
+        seq = schema.decode_bases(b.bases[i], int(b.lengths[i]))
+        c = ra.generate_alternate_consensus(seq, int(b.start[i]), 0, cigar)
+        if c is not None and c not in consensus:
+            consensus.append(c)
+    assert len(consensus) >= 2
+    assert (consensus[0].index_start, consensus[0].index_end) == (34, 45)
+    assert consensus[0].consensus == ""
+    assert (consensus[1].index_start, consensus[1].index_end) == (54, 65)
+    assert consensus[1].consensus == ""
+
+
+def test_artificial_reference_from_reads(ref_resources):
+    ds = load_alignments(str(ref_resources / "artificial.sam"))
+    b = ds.batch.to_numpy()
+    reads = []
+    for i in range(b.n_rows):
+        if not b.valid[i] or int(b.start[i]) > 25:
+            continue
+        L = int(b.lengths[i])
+        reads.append(
+            ra._Read(
+                row=i,
+                seq=schema.decode_bases(b.bases[i], L),
+                quals=[int(q) for q in b.quals[i][:L]],
+                start=int(b.start[i]),
+                cigar=parse_cigar(
+                    schema.decode_cigar(b.cigar_ops[i], b.cigar_lens[i],
+                                        int(b.cigar_n[i]))
+                ),
+                md=MdTag.parse(ds.sidecar.md[i], int(b.start[i])),
+                mapq=int(b.mapq[i]),
+            )
+        )
+    ref, ref_start, ref_end = ra._get_reference_from_reads(reads)
+    ref_str = ("A" * 34 + "G" * 10 + "A" * 10 + "G" * 10 + "A" * 148)
+    assert ref == ref_str[ref_start:ref_end]
+
+
+def test_artificial_realigned_matches_gatk(ref_resources):
+    """read4 of our realignment matches GATK IndelRealigner's output in
+    (name, start, cigar, mapq) — the reference suite's golden assertion."""
+    ds = load_alignments(str(ref_resources / "artificial.sam"))
+    out = ds.realign_indels().sort_by_reference_position()
+    gatk = load_alignments(
+        str(ref_resources / "artificial.realigned.sam")
+    ).sort_by_reference_position()
+    assert len(out) == len(gatk)
+
+    def rows(d, name):
+        b = d.batch.to_numpy()
+        res = []
+        for i in range(b.n_rows):
+            if b.valid[i] and d.sidecar.names[i] == name:
+                res.append(
+                    (
+                        int(b.start[i]),
+                        schema.decode_cigar(b.cigar_ops[i], b.cigar_lens[i],
+                                            int(b.cigar_n[i])),
+                        int(b.mapq[i]),
+                    )
+                )
+        return res
+
+    ours = rows(out, "read4")
+    theirs = rows(gatk, "read4")
+    assert len(ours) == len(theirs) and len(ours) > 0
+    assert ours == theirs
+
+
+def test_realign_no_targets_passthrough(ref_resources):
+    ds = load_alignments(str(ref_resources / "reads12.sam"))
+    out = ds.realign_indels()
+    b0, b1 = ds.batch.to_numpy(), out.batch.to_numpy()
+    np.testing.assert_array_equal(b0.start, b1.start)
+    np.testing.assert_array_equal(b0.cigar_ops, b1.cigar_ops)
