@@ -1,0 +1,3 @@
+from adam_tpu.parallel import dist, mesh, partitioner
+
+__all__ = ["dist", "mesh", "partitioner"]

@@ -1,0 +1,267 @@
+"""Distributed (multi-chip) kernels via shard_map + XLA collectives.
+
+Spark-primitive -> collective mapping (SURVEY.md §2.5/§2.6):
+
+* driver ``aggregate`` (flagstat, BQSR observation table, sequence
+  dictionaries) -> ``psum`` of fixed-shape metric structs / histograms;
+* ``reduceByKey`` over k-mers -> hash-sharded ``all_to_all`` exchange,
+  then a local sort/run-length count of each shard's key slice;
+* sort ``sortByKey`` -> splitter-based ``all_to_all`` redistribution +
+  local sort;
+* flanking/halo exchange between genome-adjacent fragments
+  (FlankReferenceFragments.scala:26-70) -> ``ppermute`` with the
+  neighbor shard.
+
+Everything here runs under ``shard_map`` over a 1-D mesh, so the same
+code drives 8 virtual CPU devices in tests, one real TPU chip, or a
+multi-host pod (collectives ride ICI within a slice, DCN across hosts).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from adam_tpu.formats.batch import ReadBatch
+from adam_tpu.ops import flagstat as fs
+from adam_tpu.ops import kmer as kmer_ops
+from adam_tpu.parallel.mesh import SHARD_AXIS, genome_mesh
+
+
+def _row_specs(batch: ReadBatch):
+    return jax.tree.map(lambda _: P(SHARD_AXIS), batch)
+
+
+def pad_batch_for_mesh(batch: ReadBatch, n_shards: int) -> ReadBatch:
+    """Pad rows so the leading axis divides evenly across shards."""
+    n = batch.n_rows
+    target = -(-max(n, 1) // n_shards) * n_shards
+    return batch.pad_rows(target)
+
+
+# --------------------------------------------------------------------------
+# psum aggregations
+# --------------------------------------------------------------------------
+def distributed_flagstat(batch: ReadBatch, mesh=None):
+    """flagstat over a row-sharded batch; cross-chip combine is one psum
+    of the metrics pytree (the reference's tree-aggregate to the driver).
+    """
+    mesh = mesh or genome_mesh()
+    batch = pad_batch_for_mesh(batch, mesh.devices.size).to_device()
+
+    out_struct = jax.eval_shape(fs.flagstat_device.__wrapped__, batch)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(_row_specs(batch),),
+        out_specs=jax.tree.map(lambda _: P(), out_struct),
+        check_vma=False,
+    )
+    def run(local):
+        failed, passed = fs.flagstat_device.__wrapped__(local)
+        return jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS), (failed, passed))
+
+    failed, passed = run(batch)
+    return failed.to_ints(), passed.to_ints()
+
+
+def distributed_observe(batch: ReadBatch, residue_ok, is_mismatch, read_ok,
+                        n_rg: int, mesh=None):
+    """BQSR observation histograms with cross-chip psum combine."""
+    from adam_tpu.pipelines.bqsr import observe_kernel
+
+    mesh = mesh or genome_mesh()
+    n_shards = mesh.devices.size
+    batch = pad_batch_for_mesh(batch, n_shards)
+    lmax = batch.lmax
+
+    def pad_rows(x):
+        return np.pad(np.asarray(x), [(0, batch.n_rows - x.shape[0])] + [(0, 0)] * (x.ndim - 1))
+
+    residue_ok = pad_rows(residue_ok)
+    is_mismatch = pad_rows(is_mismatch)
+    read_ok = pad_rows(read_ok)
+    b = batch.to_device()
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(_row_specs(b), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def run(local, res_ok, is_mm, rd_ok):
+        total, mism = observe_kernel.__wrapped__(
+            local.bases, local.quals, local.lengths, local.flags,
+            local.read_group_idx, res_ok, is_mm, rd_ok, n_rg, lmax,
+        )
+        return (
+            jax.lax.psum(total, SHARD_AXIS),
+            jax.lax.psum(mism, SHARD_AXIS),
+        )
+
+    return run(b, jnp.asarray(residue_ok), jnp.asarray(is_mismatch),
+               jnp.asarray(read_ok))
+
+
+# --------------------------------------------------------------------------
+# fixed-capacity all_to_all routing, shared by k-mer count and sort
+# --------------------------------------------------------------------------
+def _route_all_to_all(values, dest, n_dev: int, pad):
+    """Send each value to its destination shard; returns the flat array of
+    values received by this shard, padded with ``pad``.
+
+    Fixed capacity: every shard sends an [n_dev, m] buffer (worst case all
+    m local values to one destination); row d goes to device d.
+    """
+    m = values.shape[0]
+    order = jnp.argsort(dest)
+    vals_sorted = values[order]
+    dest_sorted = dest[order]
+    slot = jnp.arange(m) - jnp.searchsorted(dest_sorted, jnp.arange(n_dev))[dest_sorted]
+    buf = jnp.full((n_dev, m), pad, dtype=values.dtype)
+    buf = buf.at[dest_sorted, slot].set(vals_sorted)
+    return jax.lax.all_to_all(buf, SHARD_AXIS, 0, 0).reshape(-1)
+
+
+def _mix_hash(keys):
+    """Bit-mix i64 keys before modular sharding — the raw 3-bit-per-base
+    packing puts only codes 0..4 in the low bits, which would starve most
+    shards of a power-of-two mesh."""
+    h = keys * jnp.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as i64
+    return (h >> 32) & jnp.int64(0x7FFFFFFF)
+
+
+# --------------------------------------------------------------------------
+# k-mer counting with hash-sharded all_to_all
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("k", "mesh"))
+def _distributed_kmers_jit(bases, lengths, valid, k: int, mesh):
+    n_dev = mesh.devices.size
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+    def run(b, l, v):
+        packed, win_valid = kmer_ops.extract_kmers(b, l, v, k)
+        keys = jnp.where(win_valid, packed, jnp.int64(-1)).ravel()
+        dest = jnp.where(keys >= 0, _mix_hash(keys) % n_dev, jnp.int64(0))
+        mine = _route_all_to_all(keys, dest, n_dev, jnp.int64(-1))
+        s = jnp.sort(mine)
+        is_new = jnp.concatenate([jnp.array([True]), s[1:] != s[:-1]])
+        is_head = is_new & (s >= 0)
+        seg = jnp.cumsum(is_new) - 1
+        counts = jax.ops.segment_sum(
+            (s >= 0).astype(jnp.int32), seg, num_segments=s.shape[0]
+        )
+        return s[None], counts[seg][None], is_head[None]
+
+    return run(bases, lengths, valid)
+
+
+def distributed_count_kmers(batch: ReadBatch, k: int, mesh=None) -> dict[str, int]:
+    """Exact global k-mer counts over a row-sharded batch.
+
+    Local extraction -> hash-partitioned all_to_all so each device owns a
+    disjoint key slice -> local sort/unique; host merges the per-device
+    unique lists (no overlap by construction).
+    """
+    if batch.n_rows == 0:
+        return {}
+    mesh = mesh or genome_mesh()
+    batch = pad_batch_for_mesh(batch, mesh.devices.size).to_device()
+    s, counts, heads = jax.tree.map(
+        np.asarray,
+        _distributed_kmers_jit(batch.bases, batch.lengths, batch.valid, k, mesh),
+    )
+    out: dict[str, int] = {}
+    for d in range(s.shape[0]):
+        keys = s[d][heads[d]]
+        vals = counts[d][heads[d]]
+        for key, v in zip(keys, vals):
+            out[kmer_ops.unpack_kmer(int(key), k)] = int(v)
+    return out
+
+
+# --------------------------------------------------------------------------
+# distributed sort (splitter-based all_to_all)
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("mesh",))
+def distributed_sort_keys(keys, mesh):
+    """Globally sort an i64 key array sharded across the mesh.
+
+    Sample-splitter strategy: all_gather a per-shard sample, derive
+    n_dev-1 splitters (identical on every shard), route each key to its
+    splitter bucket with a fixed-capacity all_to_all, then sort locally.
+    Returns [n_dev, cap] keys per shard (padded with i64 max) whose
+    concatenation is globally sorted.
+    """
+    n_dev = mesh.devices.size
+    PAD = jnp.iinfo(jnp.int64).max
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS),),
+        out_specs=P(SHARD_AXIS),
+        check_vma=False,
+    )
+    def run(local):
+        local = local.ravel()
+        samples = jax.lax.all_gather(jnp.sort(local), SHARD_AXIS).ravel()
+        samples = jnp.sort(samples)
+        # n_dev-1 splitters at even quantiles
+        idx = (jnp.arange(1, n_dev) * samples.shape[0]) // n_dev
+        splitters = samples[idx]
+        dest = jnp.searchsorted(splitters, local, side="right")
+        recv = _route_all_to_all(local, dest, n_dev, PAD)
+        return jnp.sort(recv)[None]
+
+    return run(keys)
+
+
+# --------------------------------------------------------------------------
+# halo (flank) exchange between genome-adjacent shards
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("flank", "mesh"))
+def halo_exchange_right(chunks, mesh, flank: int):
+    """Append each shard's first ``flank`` bases to its LEFT neighbor's
+    chunk — the ppermute form of fragment flanking
+    (FlankReferenceFragments: a fragment is extended with the start of
+    the next fragment so windows spanning the boundary are complete).
+
+    chunks: u8[n_shards, width] sharded on axis 0 -> returns
+    u8[n_shards, width + flank] sharded the same way; the last shard's
+    halo is BASE_PAD.
+    """
+    from adam_tpu.formats import schema
+
+    n_dev = mesh.devices.size
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS),),
+        out_specs=P(SHARD_AXIS),
+        check_vma=False,
+    )
+    def run(local):
+        head = local[:, :flank]
+        # send my head to my left neighbor (shard i -> i-1)
+        perm = [(i, (i - 1) % n_dev) for i in range(n_dev)]
+        halo = jax.lax.ppermute(head, SHARD_AXIS, perm)
+        me = jax.lax.axis_index(SHARD_AXIS)
+        halo = jnp.where(me == n_dev - 1, jnp.uint8(schema.BASE_PAD), halo)
+        return jnp.concatenate([local, halo], axis=1)
+
+    return run(chunks)

@@ -1,0 +1,69 @@
+"""Genome-coordinate partitioning.
+
+Semantics of ``rdd/GenomicPartitioners.scala``:
+
+* :func:`position_partition` — GenomicPositionPartitioner.getPartition
+  (:63-85): map (contig, pos) to one of N partitions by cumulative genome
+  offset, with one extra partition for unmapped reads (partition N).
+* :func:`region_partition` — GenomicRegionPartitioner (:102-121):
+  fixed-size coordinate bins per contig.
+
+Both return plain arrays so the result can drive either a host-side
+scatter into per-device shards or a device all_to_all exchange.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from adam_tpu.models.dictionaries import SequenceDictionary
+
+
+def position_partition(
+    seq_dict: SequenceDictionary,
+    contig_idx,
+    pos,
+    num_partitions: int,
+) -> np.ndarray:
+    """Partition id per read; unmapped (contig_idx < 0) -> num_partitions.
+
+    Mapped reads land in int(num_partitions * flattened / total_length),
+    the cumulative-offset binning of the reference.
+    """
+    contig_idx = np.asarray(contig_idx)
+    pos = np.asarray(pos)
+    offsets = seq_dict.offsets
+    total = max(seq_dict.total_length, 1)
+    safe_idx = np.clip(contig_idx, 0, max(len(seq_dict) - 1, 0))
+    flat = offsets[safe_idx] + np.maximum(pos, 0)
+    part = (num_partitions * flat) // total
+    part = np.clip(part, 0, num_partitions - 1)
+    return np.where(contig_idx < 0, num_partitions, part).astype(np.int64)
+
+
+def region_partition(
+    seq_dict: SequenceDictionary,
+    contig_idx,
+    pos,
+    partition_size: int,
+) -> np.ndarray:
+    """Fixed-size bin id, unique across contigs (bins stack per contig)."""
+    contig_idx = np.asarray(contig_idx)
+    pos = np.asarray(pos)
+    lengths = seq_dict.lengths
+    bins_per_contig = -(-lengths // partition_size)
+    bin_offsets = np.concatenate([[0], np.cumsum(bins_per_contig)])
+    safe_idx = np.clip(contig_idx, 0, max(len(seq_dict) - 1, 0))
+    local_bin = np.maximum(pos, 0) // partition_size
+    out = bin_offsets[safe_idx] + local_bin
+    return np.where(contig_idx < 0, -1, out).astype(np.int64)
+
+
+def shard_rows_by_position(
+    seq_dict: SequenceDictionary, contig_idx, pos, n_shards: int
+) -> list[np.ndarray]:
+    """Row indices per shard (unmapped rows appended to the last shard),
+    the host-side scatter used to feed a genome-sharded mesh."""
+    part = position_partition(seq_dict, contig_idx, pos, n_shards)
+    part = np.where(part >= n_shards, n_shards - 1, part)
+    return [np.flatnonzero(part == s) for s in range(n_shards)]

@@ -1,0 +1,150 @@
+"""Benchmark: reads/sec/chip on the fused transform step.
+
+Times the flagship device kernel (BQSR observe + recalibrate + duplicate
+-marking keys + flagstat, one jit region — the hot per-partition work of
+the reference's `transform` pipeline) on synthetic 100 bp reads, on
+whatever accelerator JAX provides (the real TPU chip under the driver).
+
+`vs_baseline` compares against a single-host vectorized numpy
+implementation of the same observe+recalibrate math (the stand-in for
+the reference's Spark-CPU executor loop; numpy is a *stronger* CPU
+baseline than per-record JVM objects, so the ratio is conservative
+relative to BASELINE.md's >=20x-over-Spark north star).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+import numpy as np
+
+
+def _numpy_baseline(batch, residue_ok, is_mm, n_rg, lmax, repeats=3):
+    """Vectorized single-host numpy version of observe + recalibrate."""
+    from adam_tpu.formats import schema
+
+    bases = np.asarray(batch.bases)
+    quals = np.asarray(batch.quals).astype(np.int64)
+    lengths = np.asarray(batch.lengths)
+    flags = np.asarray(batch.flags)
+    rg = np.asarray(batch.read_group_idx)
+    n, L = bases.shape
+    err = 10.0 ** (-np.arange(256) / 10.0)
+
+    def run_once():
+        # cycles
+        rev = (flags & 0x10) != 0
+        second = ((flags & 0x1) != 0) & ((flags & 0x80) != 0)
+        initial = np.where(rev, np.where(second, -lengths, lengths),
+                           np.where(second, -1, 1))
+        inc = np.where(rev, np.where(second, 1, -1), np.where(second, -1, 1))
+        cycles = initial[:, None] + inc[:, None] * np.arange(L)[None, :]
+        # dinucs
+        comp = schema.BASE_COMPLEMENT
+        prev_f = np.pad(bases[:, :-1], ((0, 0), (1, 0)), constant_values=4)
+        next_b = np.pad(bases[:, 1:], ((0, 0), (0, 1)), constant_values=4)
+        cur = np.where(rev[:, None], comp[bases], bases)
+        prev = np.where(rev[:, None], comp[next_b], prev_f)
+        i = np.arange(L)[None, :]
+        first = np.where(rev[:, None], i == lengths[:, None] - 1, i == 0)
+        ok = (i < lengths[:, None]) & ~first & (cur < 4) & (prev < 4)
+        dinucs = np.where(ok, prev.astype(np.int64) * 4 + cur, 16)
+        # observe
+        n_cyc = 2 * L + 1
+        key = (((np.clip(rg, 0, n_rg - 1)[:, None] * 94 + np.clip(quals, 0, 93))
+                * n_cyc + cycles + L) * 17 + dinucs)
+        inc_mask = residue_ok
+        size = n_rg * 94 * n_cyc * 17
+        total = np.bincount(key[inc_mask].ravel(), minlength=size)
+        mism = np.bincount(key[inc_mask & is_mm].ravel(), minlength=size)
+        total = total.reshape(n_rg, 94, n_cyc, 17)
+        mism = mism.reshape(n_rg, 94, n_cyc, 17)
+        # recalibrate
+        g_t = total.sum(axis=(1, 2, 3))
+        g_m = mism.sum(axis=(1, 2, 3))
+        g_exp = (err[np.arange(94)][None, :] * total.sum(axis=(2, 3))).sum(axis=1)
+        q_t = total.sum(axis=(2, 3))
+        q_m = mism.sum(axis=(2, 3))
+        c_t = total.sum(axis=3)
+        c_m = mism.sum(axis=3)
+        d_t = total.sum(axis=2)
+        d_m = mism.sum(axis=2)
+        rgc = np.clip(rg, 0, n_rg - 1)[:, None] * np.ones((1, L), np.int64)
+        q = np.clip(quals, 0, 93)
+        rlp = np.log(err[q])
+
+        def emp(t, m):
+            return np.log((1.0 + m) / (2.0 + t))
+
+        gt = g_t[rgc]
+        gd = np.where(gt > 0, emp(gt, g_m[rgc]) - np.log(g_exp[rgc] / np.maximum(gt, 1)), 0.0)
+        qt = q_t[rgc, q]
+        qp = (gt > 0) & (qt > 0)
+        off1 = rlp + gd
+        qd = np.where(qp, emp(qt, q_m[rgc, q]) - off1, 0.0)
+        off2 = off1 + qd
+        ct = c_t[rgc, q, cycles + L]
+        cd = np.where(qp & (ct > 0), emp(ct, c_m[rgc, q, cycles + L]) - off2, 0.0)
+        dt = d_t[rgc, q, dinucs]
+        dd = np.where(qp & (dt > 0), emp(dt, d_m[rgc, q, dinucs]) - off2, 0.0)
+        logp = np.clip(rlp + gd + qd + cd + dd, np.log(err[50]), 0.0)
+        return np.floor(-10.0 * logp / np.log(10.0) + 0.5)
+
+    run_once()
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        run_once()
+    return (time.perf_counter() - t0) / repeats
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from adam_tpu.pipelines.transform_step import (
+        synthetic_batch,
+        synthetic_masks,
+        transform_step,
+    )
+
+    n_reads = 65_536
+    read_len = 100
+    n_rg = 2
+    batch = synthetic_batch(n_reads=n_reads, read_len=read_len)
+    residue_ok, is_mm = synthetic_masks(batch)
+    dev_batch = batch.to_device()
+    res_d, mm_d = jnp.asarray(residue_ok), jnp.asarray(is_mm)
+
+    # warmup/compile
+    out, aux = transform_step(dev_batch, res_d, mm_d, n_rg, read_len)
+    jax.block_until_ready(out.quals)
+
+    repeats = 10
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out, aux = transform_step(dev_batch, res_d, mm_d, n_rg, read_len)
+    jax.block_until_ready(out.quals)
+    device_time = (time.perf_counter() - t0) / repeats
+    reads_per_sec = n_reads / device_time
+
+    baseline_time = _numpy_baseline(batch, residue_ok, is_mm, n_rg, read_len)
+    baseline_rps = n_reads / baseline_time
+
+    print(
+        json.dumps(
+            {
+                "metric": "transform_step_reads_per_sec_per_chip",
+                "value": round(reads_per_sec, 1),
+                "unit": "reads/sec (100bp, BQSR observe+recalibrate+markdup keys+flagstat)",
+                "vs_baseline": round(reads_per_sec / baseline_rps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
