@@ -41,6 +41,15 @@ class SequenceDictionary:
     records: tuple[SequenceRecord, ...] = ()
 
     @staticmethod
+    def from_lists(names, lengths) -> "SequenceDictionary":
+        return SequenceDictionary(
+            tuple(
+                SequenceRecord(name=n, length=int(l))
+                for n, l in zip(names, lengths)
+            )
+        )
+
+    @staticmethod
     def from_sam_header_lines(lines: Iterable[str]) -> "SequenceDictionary":
         recs = []
         for line in lines:

@@ -1,3 +1,3 @@
-from adam_tpu.ops import cigar, flagstat, kmer, mdtag, phred, smith_waterman
+from adam_tpu.ops import cigar, flagstat, intervals, kmer, mdtag, phred, smith_waterman
 
-__all__ = ["cigar", "flagstat", "kmer", "mdtag", "phred", "smith_waterman"]
+__all__ = ["cigar", "flagstat", "intervals", "kmer", "mdtag", "phred", "smith_waterman"]

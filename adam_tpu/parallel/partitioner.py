@@ -14,9 +14,58 @@ scatter into per-device shards or a device all_to_all exchange.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from functools import cached_property
+
 import numpy as np
 
 from adam_tpu.models.dictionaries import SequenceDictionary
+
+
+@dataclass(frozen=True)
+class GenomeBins:
+    """Fixed-size genome binning (ShuffleRegionJoin.scala:140-193).
+
+    Bin ids stack per contig in dictionary order; ``invert`` recovers the
+    bin's region. This is the static genome->shard mapping shared by
+    :func:`region_partition` and the shuffle region join.
+    """
+
+    bin_size: int
+    seq_dict: SequenceDictionary
+
+    @cached_property
+    def bins_per_contig(self) -> np.ndarray:
+        return -(-self.seq_dict.lengths // self.bin_size)
+
+    @cached_property
+    def bin_offsets(self) -> np.ndarray:
+        return np.concatenate([[0], np.cumsum(self.bins_per_contig)])
+
+    @property
+    def num_bins(self) -> int:
+        return int(self.bin_offsets[-1])
+
+    def start_bin(self, contig_idx, start):
+        return (
+            self.bin_offsets[np.asarray(contig_idx)]
+            + np.asarray(start) // self.bin_size
+        )
+
+    def end_bin(self, contig_idx, end):
+        """Bin of the last covered base (end is exclusive)."""
+        return (
+            self.bin_offsets[np.asarray(contig_idx)]
+            + np.maximum(np.asarray(end) - 1, 0) // self.bin_size
+        )
+
+    def invert(self, bin_id: int):
+        """bin id -> (contig_idx, start, end) region of the bin."""
+        contig = int(np.searchsorted(self.bin_offsets, bin_id, "right") - 1)
+        local = bin_id - int(self.bin_offsets[contig])
+        start = local * self.bin_size
+        end = min(start + self.bin_size, int(self.seq_dict.lengths[contig]))
+        return contig, start, end
 
 
 def position_partition(
@@ -50,12 +99,9 @@ def region_partition(
     """Fixed-size bin id, unique across contigs (bins stack per contig)."""
     contig_idx = np.asarray(contig_idx)
     pos = np.asarray(pos)
-    lengths = seq_dict.lengths
-    bins_per_contig = -(-lengths // partition_size)
-    bin_offsets = np.concatenate([[0], np.cumsum(bins_per_contig)])
+    bins = GenomeBins(partition_size, seq_dict)
     safe_idx = np.clip(contig_idx, 0, max(len(seq_dict) - 1, 0))
-    local_bin = np.maximum(pos, 0) // partition_size
-    out = bin_offsets[safe_idx] + local_bin
+    out = bins.start_bin(safe_idx, np.maximum(pos, 0))
     return np.where(contig_idx < 0, -1, out).astype(np.int64)
 
 

@@ -1,3 +1,3 @@
-from adam_tpu.pipelines import markdup, sort
+from adam_tpu.pipelines import markdup, region_join, sort
 
-__all__ = ["markdup", "sort"]
+__all__ = ["markdup", "region_join", "sort"]

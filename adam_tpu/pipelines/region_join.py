@@ -1,0 +1,203 @@
+"""Region joins, coverage, and sorted pairing.
+
+Distributed-primitive parity (SURVEY §2 [DIST] rows):
+
+* :class:`NonoverlappingRegions` / :func:`broadcast_region_join` —
+  semantics of ``rdd/BroadcastRegionJoin.scala`` (:65-130, index at
+  :169-301): build a small merged-region index from the left side,
+  replicate it (the broadcast), key the right side by binary search, join
+  within groups. Here the index is two sorted key arrays and the "binary
+  search per record" is one ``searchsorted`` over the whole batch.
+* :class:`GenomeBins` / :func:`shuffle_region_join` — semantics of
+  ``rdd/ShuffleRegionJoin.scala`` (:72-134, bins :140-193, sweep
+  :223-290): fixed-size genome bins, both sides replicated into every bin
+  they overlap, per-bin sort-merge join, and the dedupe rule that a pair
+  is emitted only where at least one side *starts* in the bin. Bins are
+  the unit that maps onto mesh shards in the multi-chip layout
+  (:mod:`adam_tpu.parallel`).
+* :func:`find_coverage_regions` — ``rdd/Coverage.scala:55-190``: minimal
+  disjoint non-adjacent region set covering every covered base. The
+  reference needs windowing + groupBy + a per-window sweep + a collapse
+  pass; columnar merge does it in one sort+scan.
+* :func:`sliding` / :func:`pair` / :func:`pair_with_ends` —
+  ``rdd/PairingRDD.scala:54-130`` over sorted arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from adam_tpu.models.dictionaries import SequenceDictionary
+from adam_tpu.ops import intervals as iv
+from adam_tpu.parallel.partitioner import GenomeBins
+
+
+@dataclass(frozen=True)
+class IntervalArrays:
+    """Columnar interval set: the argument/return type of the joins."""
+
+    contig: np.ndarray  # i64[N] contig index into a SequenceDictionary
+    start: np.ndarray  # i64[N]
+    end: np.ndarray  # i64[N]
+
+    def __len__(self):
+        return len(self.start)
+
+    @staticmethod
+    def of(contig, start, end) -> "IntervalArrays":
+        return IntervalArrays(
+            np.asarray(contig, np.int64),
+            np.asarray(start, np.int64),
+            np.asarray(end, np.int64),
+        )
+
+
+class NonoverlappingRegions:
+    """Merged-region index over an interval set — the broadcast side.
+
+    The reference stores distinct-union endpoints and walks them with
+    ``binaryPointSearch`` (BroadcastRegionJoin.scala:197-227). Here the
+    merged groups live as sorted columnar arrays; queries resolve to
+    contiguous group-id ranges in two vectorized searches.
+    """
+
+    def __init__(self, regions: IntervalArrays):
+        if len(regions) == 0:
+            raise ValueError("regions list must be non-empty")
+        m_c, m_s, m_e, group = iv.merge_intervals(
+            regions.contig, regions.start, regions.end
+        )
+        self.m_contig, self.m_start, self.m_end = m_c, m_s, m_e
+        self.group_of_input = group
+
+    def __len__(self):
+        return len(self.m_start)
+
+    def regions_for(self, query: IntervalArrays):
+        """Per-query [lo, hi) merged-group range (findOverlappingRegions)."""
+        return iv.overlap_group_ranges(
+            self.m_contig, self.m_start, self.m_end,
+            query.contig, query.start, query.end,
+        )
+
+    def has_regions_for(self, query: IntervalArrays) -> np.ndarray:
+        lo, hi = self.regions_for(query)
+        return hi > lo
+
+
+def broadcast_region_join(left: IntervalArrays, right: IntervalArrays):
+    """(li, ri) index pairs of overlapping left/right intervals.
+
+    Equivalent output to BroadcastRegionJoin.partitionAndJoin
+    (BroadcastRegionJoin.scala:65-130); callers carry their own payloads
+    and gather with the returned indices (columnar replacement for the
+    RDD[(T, U)] of the reference).
+    """
+    return iv.overlap_join(
+        left.contig, left.start, left.end,
+        right.contig, right.start, right.end,
+    )
+
+
+def shuffle_region_join(
+    left: IntervalArrays,
+    right: IntervalArrays,
+    seq_dict: SequenceDictionary,
+    bin_size: int = 1_000_000,
+):
+    """(li, ri) overlap pairs via genome-binned sort-merge join.
+
+    Mirrors ShuffleRegionJoin.partitionAndJoin (:72-134): both sides are
+    replicated into every bin they overlap, each bin joins independently
+    (this is the per-shard unit for the mesh), and a pair is kept only if
+    at least one side starts inside the bin — the chromsweep dedupe rule
+    (SortedIntervalPartitionJoin filter, ShuffleRegionJoin.scala:262-267).
+    """
+    bins = GenomeBins(bin_size, seq_dict)
+    out_l, out_r = [], []
+
+    l_lo = bins.start_bin(left.contig, left.start)
+    l_hi = bins.end_bin(left.contig, left.end) + 1
+    r_lo = bins.start_bin(right.contig, right.start)
+    r_hi = bins.end_bin(right.contig, right.end) + 1
+    li_rep, l_bin = iv.expand_ranges(l_lo, l_hi)
+    ri_rep, r_bin = iv.expand_ranges(r_lo, r_hi)
+
+    # per-bin independent joins: iterate only over bins both sides touch
+    active = np.intersect1d(l_bin, r_bin)
+    l_order = np.argsort(l_bin, kind="stable")
+    r_order = np.argsort(r_bin, kind="stable")
+    l_bin_sorted, r_bin_sorted = l_bin[l_order], r_bin[r_order]
+    for b in active:
+        lsel = li_rep[l_order[np.searchsorted(l_bin_sorted, b):
+                              np.searchsorted(l_bin_sorted, b, "right")]]
+        rsel = ri_rep[r_order[np.searchsorted(r_bin_sorted, b):
+                              np.searchsorted(r_bin_sorted, b, "right")]]
+        pl, pr = iv.overlap_join(
+            left.contig[lsel], left.start[lsel], left.end[lsel],
+            right.contig[rsel], right.start[rsel], right.end[rsel],
+        )
+        if len(pl) == 0:
+            continue
+        gl, gr = lsel[pl], rsel[pr]
+        _, bstart, bend = bins.invert(int(b))
+        keep = (
+            (left.start[gl] >= bstart) & (left.start[gl] < bend)
+        ) | ((right.start[gr] >= bstart) & (right.start[gr] < bend))
+        out_l.append(gl[keep])
+        out_r.append(gr[keep])
+
+    if not out_l:
+        z = np.zeros(0, np.int64)
+        return z, z
+    return np.concatenate(out_l), np.concatenate(out_r)
+
+
+def find_coverage_regions(regions: IntervalArrays) -> IntervalArrays:
+    """Minimal disjoint non-adjacent covering set (Coverage.scala:55-78)."""
+    m_c, m_s, m_e, _ = iv.merge_intervals(
+        regions.contig, regions.start, regions.end, adjacent=True
+    )
+    return IntervalArrays(m_c, m_s, m_e)
+
+
+def depth_at(
+    sites: IntervalArrays, reads: IntervalArrays
+) -> np.ndarray:
+    """Read depth at each site start — the `depth` command core
+    (adam-cli CalculateDepth.scala:41, via BroadcastRegionJoin + count)."""
+    return iv.point_depth(
+        reads.contig, reads.start, reads.end, sites.contig, sites.start
+    )
+
+
+# ------------------------------------------------------------- pairing
+
+def sliding(sorted_values: np.ndarray, width: int) -> np.ndarray:
+    """All width-length windows of a sorted array, in order
+    (PairingRDD.sliding, rdd/PairingRDD.scala:54-68). Returns
+    ``[N-width+1, width]`` — a strided view, no copy, and the same
+    expression is jittable for device windows."""
+    v = np.asarray(sorted_values)
+    n = len(v)
+    if n < width:
+        return v[:0].reshape(0, width)
+    return np.lib.stride_tricks.sliding_window_view(v, width, axis=0)
+
+
+def pair(sorted_values: np.ndarray):
+    """Consecutive pairs (PairingRDD.pair, :82-87)."""
+    v = np.asarray(sorted_values)
+    return v[:-1], v[1:]
+
+
+def pair_with_ends(sorted_values: np.ndarray):
+    """Consecutive pairs with None-padded ends (PairingRDD.pairWithEnds,
+    :108-128) as host lists of optional values."""
+    v = list(np.asarray(sorted_values))
+    if not v:
+        return []
+    padded = [None] + v + [None]
+    return list(zip(padded[:-1], padded[1:]))
