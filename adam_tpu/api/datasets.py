@@ -137,3 +137,94 @@ class AlignmentDataset:
         from adam_tpu.ops import kmer
 
         return kmer.count_qmers(self.batch, k)
+
+
+@dataclass
+class GenotypeDataset:
+    """Variant sites + per-sample calls — the VariantContext aggregate.
+
+    Covers the surface of VariantContextRDDFunctions /
+    GenotypeRDDFunctions (rdd/variation/VariationRDDFunctions.scala:40-160):
+    VCF load/save, callset samples, variant-keyed annotation join, and
+    the allele-count analysis. Variants and genotypes stay columnar
+    (:mod:`adam_tpu.formats.variants`), linked by ``genotypes.variant_idx``.
+    """
+
+    variants: "object"  # formats.variants.VariantBatch
+    genotypes: "object"  # formats.variants.GenotypeBatch
+    seq_dict: "object"  # SequenceDictionary
+
+    @staticmethod
+    def load(path: str, **kw) -> "GenotypeDataset":
+        from adam_tpu.io import vcf as vcf_io
+
+        v, g, sd = vcf_io.read_vcf(path, **kw)
+        return GenotypeDataset(v, g, sd)
+
+    def save(self, path: str, sort_on_save: bool = False) -> None:
+        from adam_tpu.io import vcf as vcf_io
+
+        vcf_io.write_vcf(
+            path, self.variants, self.genotypes, self.seq_dict, sort_on_save
+        )
+
+    def __len__(self) -> int:
+        return len(self.variants)
+
+    @property
+    def contig_names(self) -> list:
+        return [r.name for r in self.seq_dict.records]
+
+    def callset_samples(self) -> list:
+        """Distinct sample ids (getCallsetSamples, :62-68)."""
+        return list(self.genotypes.samples)
+
+    def variant_keys(self) -> np.ndarray:
+        return self.variants.variant_keys(self.contig_names)
+
+    def join_annotations(self, ann_keys, ann_values) -> list:
+        """Left outer join on variant key
+        (joinDatabaseVariantAnnotation, :55-60): returns per-site
+        annotation values (None where unmatched)."""
+        table = dict(zip(list(ann_keys), list(ann_values)))
+        return [table.get(k) for k in self.variant_keys()]
+
+    def allele_count(self):
+        from adam_tpu.formats.variants import allele_counts
+
+        return allele_counts(self.variants, self.genotypes, self.contig_names)
+
+    def snp_table(self):
+        """Known-sites table for BQSR (SnpTable VCF constructor,
+        models/SnpTable.scala:77-96: every ref position of every
+        variant masks)."""
+        from adam_tpu.models.snp_table import SnpTable
+
+        names = self.contig_names
+        pairs = []
+        for i in range(len(self.variants)):
+            c = names[self.variants.contig_idx[i]]
+            for p in range(
+                int(self.variants.start[i]), int(self.variants.end[i])
+            ):
+                pairs.append((c, p))
+        return SnpTable.from_variants(pairs)
+
+    def indel_table(self):
+        """Known-indels table for realignment
+        (IndelTable.apply from variants, models/IndelTable.scala:43-66)."""
+        from adam_tpu.models.snp_table import IndelTable
+
+        names = self.contig_names
+        side = self.variants.sidecar
+        tuples = [
+            (
+                names[self.variants.contig_idx[i]],
+                int(self.variants.start[i]),
+                side.ref_allele[i],
+                side.alt_allele[i],
+            )
+            for i in range(len(self.variants))
+            if side.alt_allele[i]
+        ]
+        return IndelTable.from_variants(tuples)

@@ -1,19 +1,24 @@
-from adam_tpu.io import sam, fastq, fasta
+from adam_tpu.io import sam, fastq, fasta, vcf
 from adam_tpu.io.context import (
     load_alignments,
     load_bam,
     load_fasta,
     load_fastq,
     load_interleaved_fastq,
+    load_vcf,
+    load_genotypes,
 )
 
 __all__ = [
     "sam",
     "fastq",
     "fasta",
+    "vcf",
     "load_alignments",
     "load_bam",
     "load_fasta",
     "load_fastq",
     "load_interleaved_fastq",
+    "load_vcf",
+    "load_genotypes",
 ]

@@ -105,6 +105,18 @@ def load_parquet_alignments(
     return AlignmentDataset(batch, side, header)
 
 
+def load_vcf(path: str, **kw):
+    """VCF -> GenotypeDataset (loadVcf, rdd/ADAMContext.scala:311-335)."""
+    from adam_tpu.api.datasets import GenotypeDataset
+
+    return GenotypeDataset.load(path, **kw)
+
+
+def load_genotypes(path: str, **kw):
+    """Dispatcher over genotype sources (loadGenotypes analog)."""
+    return load_vcf(path, **kw)
+
+
 def load_alignments(path: str, **kw) -> AlignmentDataset:
     p = str(path)
     base = p[:-3] if p.endswith(".gz") else p

@@ -53,11 +53,13 @@ class GenomeBins:
         )
 
     def end_bin(self, contig_idx, end):
-        """Bin of the last covered base (end is exclusive)."""
-        return (
-            self.bin_offsets[np.asarray(contig_idx)]
-            + np.maximum(np.asarray(end) - 1, 0) // self.bin_size
-        )
+        """Bin of the last covered base (end is exclusive). Clamped to the
+        contig's last bin so intervals overhanging a declared contig
+        length never spill into the next contig's bin-id range."""
+        ci = np.asarray(contig_idx)
+        local = np.maximum(np.asarray(end) - 1, 0) // self.bin_size
+        local = np.minimum(local, self.bins_per_contig[ci] - 1)
+        return self.bin_offsets[ci] + local
 
     def invert(self, bin_id: int):
         """bin id -> (contig_idx, start, end) region of the bin."""

@@ -139,6 +139,15 @@ class TestShuffleJoin:
             assert len(got) == len(li), "dedupe rule failed"
             assert got == brute_overlap_pairs(l, r)
 
+    def test_overhanging_interval_no_duplicates(self):
+        # interval extending past the declared contig length must not
+        # spill into the next contig's bin range and duplicate pairs
+        sd = SequenceDictionary.from_lists(["c1", "c2"], [2000, 2000])
+        l = IntervalArrays.of([0], [1950], [3100])
+        r = IntervalArrays.of([0], [1960], [3050])
+        li, ri = shuffle_region_join(l, r, sd, bin_size=1000)
+        assert list(zip(li.tolist(), ri.tolist())) == [(0, 0)]
+
     def test_genome_bins(self):
         sd = self.make_dict()
         bins = GenomeBins(1000, sd)

@@ -1,0 +1,227 @@
+"""Variation layer tests.
+
+Mirrors the reference's VariantContextConverterSuite /
+GenotypesSuite / ADAMVariationRDDFunctionsSuite patterns: conversion
+fidelity on the shipped ``small.vcf`` fixture, multi-allelic splitting
+with PL punch-out, gVCF reference-model rows, VCF round-trip, and the
+allele-count / known-table derivations.
+"""
+
+import numpy as np
+import pytest
+
+from adam_tpu.api.datasets import GenotypeDataset
+from adam_tpu.formats import variants as vf
+from adam_tpu.io import vcf as vcf_io
+
+SMALL_VCF = "/root/reference/adam-core/src/test/resources/small.vcf"
+
+
+@pytest.fixture(scope="module")
+def small(tmp_path_factory):
+    return GenotypeDataset.load(SMALL_VCF)
+
+
+class TestReadSmallVcf:
+    def test_sites_and_samples(self, small):
+        # 5 records, all bi-allelic already
+        assert len(small) == 5
+        assert small.callset_samples() == ["NA12878", "NA12891", "NA12892"]
+        assert len(small.genotypes) == 15
+
+    def test_coordinates_zero_based(self, small):
+        v = small.variants
+        # first record: 1:14397 CTGT -> C
+        assert small.contig_names[v.contig_idx[0]] == "1"
+        assert v.start[0] == 14396
+        assert v.end[0] == 14400  # start + len(CTGT)
+        assert v.sidecar.ref_allele[0] == "CTGT"
+        assert v.sidecar.alt_allele[0] == "C"
+
+    def test_filters(self, small):
+        v = small.variants
+        assert v.filters_applied.all()
+        assert v.passing.tolist() == [False, False, True, True, True]
+        assert v.sidecar.filters[0] == ["IndelQD"]
+
+    def test_genotype_fields(self, small):
+        g = small.genotypes
+        # NA12878 at site 0: 0/1:16,4:20:rd:99:120,0,827
+        assert g.alleles[0].tolist() == [vf.ALLELE_REF, vf.ALLELE_ALT]
+        assert g.ref_depth[0] == 16 and g.alt_depth[0] == 4
+        assert g.dp[0] == 20 and g.gq[0] == 99
+        assert g.pl[0].tolist() == [120, 0, 827]
+        assert g.genotype_filters[0] == "rd"
+        # NA12892 at site 4: 1/1
+        assert g.alleles[14].tolist() == [vf.ALLELE_ALT, vf.ALLELE_ALT]
+
+    def test_variant_flags(self, small):
+        v = small.variants
+        assert v.is_snp.tolist() == [False, True, False, False, True]
+        assert v.is_indel.tolist() == [True, False, True, True, False]
+
+    def test_rs_ids(self, small):
+        assert small.variants.sidecar.names[3] == "rs201888535"
+        assert small.variants.sidecar.names[0] == ""
+
+
+class TestMultiAllelicSplit:
+    def write(self, tmp_path, body):
+        p = tmp_path / "t.vcf"
+        p.write_text(
+            "##fileformat=VCFv4.1\n"
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\n"
+            + body
+        )
+        return str(p)
+
+    def test_triallelic_site_splits(self, tmp_path):
+        # genotype 1/2: alt1 from allele 1, alt2 from allele 2
+        path = self.write(
+            tmp_path,
+            "1\t100\t.\tA\tG,T\t50\tPASS\t.\tGT:AD:PL\t1/2:2,7,6:40,30,20,10,5,0\n",
+        )
+        ds = GenotypeDataset.load(path)
+        v, g = ds.variants, ds.genotypes
+        assert len(v) == 2
+        assert v.sidecar.alt_allele == ["G", "T"]
+        assert g.split_from_multiallelic.all()
+        assert g.phased.all()  # split genotypes marked phased
+        # vs G: allele 1 -> Alt, allele 2 -> OtherAlt
+        assert g.alleles[0].tolist() == [vf.ALLELE_ALT, vf.ALLELE_OTHER_ALT]
+        # vs T: allele 1 -> OtherAlt, allele 2 -> Alt
+        assert g.alleles[1].tolist() == [vf.ALLELE_OTHER_ALT, vf.ALLELE_ALT]
+        # AD punch-out keeps ref + this alt
+        assert g.ref_depth.tolist() == [2, 2]
+        assert g.alt_depth.tolist() == [7, 6]
+        # PL punch-out: alleles {0,1} -> idx [0,1,2] = [40,30,20] -> -20
+        assert g.pl[0].tolist() == [20, 10, 0]
+        # alleles {0,2} -> idx [0,3,5] = [40,10,0] -> already min 0
+        assert g.pl[1].tolist() == [40, 10, 0]
+
+    def test_gvcf_reference_block(self, tmp_path):
+        path = self.write(
+            tmp_path, "1\t200\t.\tG\t<NON_REF>\t.\t.\tEND=300\tGT:PL\t0/0:0,30,300\n"
+        )
+        ds = GenotypeDataset.load(path)
+        assert len(ds) == 1
+        assert ds.variants.sidecar.alt_allele == [None]
+        assert ds.variants.alt_len[0] == 0
+        # INFO END extends the block span (1-based inclusive -> end 300)
+        assert ds.variants.end[0] == 300
+        g = ds.genotypes
+        assert g.nonref_pl[0].tolist() == [0, 30, 300]
+        assert g.pl[0].tolist() == [vf.PL_MISSING] * 3
+        # round-trips: END survives in INFO, PL returns to nonref_pl
+        out = str(tmp_path / "gvcf_rt.vcf")
+        ds.save(out)
+        back = GenotypeDataset.load(out)
+        assert back.variants.end[0] == 300
+        assert back.genotypes.nonref_pl[0].tolist() == [0, 30, 300]
+
+    def test_missing_ad_entries_keep_positions(self, tmp_path):
+        # '.' in AD must not shift later allele depths
+        path = self.write(
+            tmp_path,
+            "1\t100\t.\tA\tG,T\t50\tPASS\t.\tGT:AD\t1/2:.,4,6\n",
+        )
+        g = GenotypeDataset.load(path).genotypes
+        assert g.ref_depth.tolist() == [-1, -1]
+        assert g.alt_depth.tolist() == [4, 6]
+
+    def test_genotype_filter_round_trip(self, tmp_path):
+        path = self.write(
+            tmp_path, "1\t100\t.\tA\tG\t50\tPASS\t.\tGT:FT\t0/1:rd\n"
+        )
+        ds = GenotypeDataset.load(path)
+        assert ds.genotypes.genotype_filters == ["rd"]
+        out = str(tmp_path / "ft_rt.vcf")
+        ds.save(out)
+        assert GenotypeDataset.load(out).genotypes.genotype_filters == ["rd"]
+
+    def test_alt_plus_nonref(self, tmp_path):
+        # gVCF variant row: one real alt + <NON_REF> stays one site
+        path = self.write(
+            tmp_path, "1\t300\t.\tC\tT,<NON_REF>\t90\tPASS\t.\tGT:PL\t0/1:45,0,60,99,99,99\n"
+        )
+        ds = GenotypeDataset.load(path)
+        assert len(ds) == 1
+        assert ds.variants.sidecar.alt_allele == ["T"]
+        assert ds.genotypes.pl[0].tolist() == [45, 0, 60]
+
+
+class TestRoundTrip:
+    def test_small_vcf_round_trip(self, small, tmp_path):
+        out = str(tmp_path / "out.vcf")
+        small.save(out)
+        back = GenotypeDataset.load(out)
+        v0, v1 = small.variants, back.variants
+        assert np.array_equal(v0.start, v1.start)
+        assert v0.sidecar.ref_allele == v1.sidecar.ref_allele
+        assert v0.sidecar.alt_allele == v1.sidecar.alt_allele
+        assert v0.sidecar.names == v1.sidecar.names
+        assert np.array_equal(v0.passing, v1.passing)
+        g0, g1 = small.genotypes, back.genotypes
+        assert np.array_equal(g0.alleles, g1.alleles)
+        assert np.array_equal(g0.pl, g1.pl)
+        assert np.array_equal(g0.dp, g1.dp)
+        assert np.array_equal(g0.ref_depth, g1.ref_depth)
+        assert g0.samples == g1.samples
+
+    def test_sort_on_save(self, tmp_path):
+        p = tmp_path / "u.vcf"
+        p.write_text(
+            "##fileformat=VCFv4.1\n"
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+            "1\t500\t.\tA\tG\t10\tPASS\t.\n"
+            "1\t100\t.\tC\tT\t10\tPASS\t.\n"
+        )
+        ds = GenotypeDataset.load(str(p))
+        out = str(tmp_path / "sorted.vcf")
+        ds.save(out, sort_on_save=True)
+        starts = GenotypeDataset.load(out).variants.start
+        assert starts.tolist() == sorted(starts.tolist())
+
+
+class TestAnalyses:
+    def test_allele_count(self, small):
+        counts = small.allele_count()
+        # site 752720 (0-based): all three samples 1/1 -> 6 x G
+        assert ("1", 752720, "G", 6) in counts
+        # site 14396: two 0/1 + one 0/0 -> 4 ref CTGT, 2 alt C
+        assert ("1", 14396, "CTGT", 4) in counts
+        assert ("1", 14396, "C", 2) in counts
+
+    def test_snp_table(self, small):
+        t = small.snp_table()
+        assert t.contains("1", 14521)  # SNP G->A at 0-based 14521
+        assert t.contains("1", 14396)  # indel ref span masks too
+        assert t.contains("1", 14399)
+        assert not t.contains("1", 14400)
+
+    def test_indel_table(self, small):
+        t = small.indel_table()
+        from adam_tpu.models.positions import ReferenceRegion
+
+        recs = t.get_indels_in_region(ReferenceRegion("1", 14390, 14410))
+        assert len(recs) == 1
+        assert recs[0].consensus == ""  # deletion CTGT->C
+        assert recs[0].region.start == 14397
+        assert recs[0].region.end == 14400
+
+    def test_join_annotations(self, small):
+        keys = small.variant_keys()
+        ann = small.join_annotations([keys[1], keys[3]], ["x", "y"])
+        assert ann == [None, "x", None, "y", None]
+
+    def test_genotype_stats(self):
+        assert vf.rms_doubles([3.0, 4.0]) == pytest.approx(
+            np.sqrt(12.5)
+        )
+        assert vf.rms_phred([]) == 0
+        assert vf.rms_phred([30, 30]) == 30
+        # callers pass per-genotype miss probabilities (1 - Pg);
+        # result is 1 - prod(values)
+        assert vf.variant_quality_from_genotypes(
+            [0.1, 0.1]
+        ) == pytest.approx(0.99)
