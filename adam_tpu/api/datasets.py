@@ -140,6 +140,42 @@ class AlignmentDataset:
 
 
 @dataclass
+class FeatureDataset:
+    """Genomic features handle (GTF/BED/narrowPeak) — the
+    FeatureRDDFunctions / GeneFeatureRDDFunctions surface
+    (rdd/features/, SURVEY §2 feature rows)."""
+
+    batch: "object"  # formats.features.FeatureBatch
+
+    @staticmethod
+    def load(path: str, fmt=None) -> "FeatureDataset":
+        from adam_tpu.io import features as fio
+
+        return FeatureDataset(fio.read_features(path, fmt))
+
+    def save(self, path: str) -> None:
+        from adam_tpu.io import features as fio
+
+        fio.write_bed(path, self.batch)
+
+    def __len__(self) -> int:
+        return len(self.batch)
+
+    def filter_by_overlapping_region(self, contig, start, end):
+        return FeatureDataset(
+            self.batch.filter_by_overlapping_region(contig, start, end)
+        )
+
+    def as_genes(self):
+        from adam_tpu.models.genes import as_genes
+
+        return as_genes(self.batch)
+
+    def intervals(self, contig_names=None):
+        return self.batch.intervals(contig_names)
+
+
+@dataclass
 class GenotypeDataset:
     """Variant sites + per-sample calls — the VariantContext aggregate.
 
@@ -201,12 +237,16 @@ class GenotypeDataset:
         from adam_tpu.models.snp_table import SnpTable
 
         names = self.contig_names
+        side = self.variants.sidecar
         pairs = []
         for i in range(len(self.variants)):
+            # skip gVCF reference-model rows (alt=None): their END-extended
+            # spans are non-variant sequence, not known sites
+            if side.alt_allele[i] is None:
+                continue
             c = names[self.variants.contig_idx[i]]
-            for p in range(
-                int(self.variants.start[i]), int(self.variants.end[i])
-            ):
+            start = int(self.variants.start[i])
+            for p in range(start, start + int(self.variants.ref_len[i])):
                 pairs.append((c, p))
         return SnpTable.from_variants(pairs)
 

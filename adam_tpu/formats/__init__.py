@@ -1,5 +1,5 @@
-from adam_tpu.formats import schema, variants
+from adam_tpu.formats import features, schema, variants
 from adam_tpu.formats.batch import ReadBatch
 from adam_tpu.formats.variants import GenotypeBatch, VariantBatch
 
-__all__ = ["schema", "variants", "ReadBatch", "VariantBatch", "GenotypeBatch"]
+__all__ = ["features", "schema", "variants", "ReadBatch", "VariantBatch", "GenotypeBatch"]

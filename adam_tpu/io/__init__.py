@@ -1,4 +1,4 @@
-from adam_tpu.io import sam, fastq, fasta, vcf
+from adam_tpu.io import sam, fastq, fasta, features, vcf
 from adam_tpu.io.context import (
     load_alignments,
     load_bam,
@@ -13,6 +13,7 @@ __all__ = [
     "sam",
     "fastq",
     "fasta",
+    "features",
     "vcf",
     "load_alignments",
     "load_bam",

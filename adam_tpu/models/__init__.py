@@ -1,3 +1,4 @@
+from adam_tpu.models import genes
 from adam_tpu.models.positions import ReferencePosition, ReferenceRegion
 from adam_tpu.models.dictionaries import (
     SequenceDictionary,
@@ -7,6 +8,7 @@ from adam_tpu.models.dictionaries import (
 )
 
 __all__ = [
+    "genes",
     "ReferencePosition",
     "ReferenceRegion",
     "SequenceDictionary",

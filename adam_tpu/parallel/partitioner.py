@@ -36,7 +36,9 @@ class GenomeBins:
 
     @cached_property
     def bins_per_contig(self) -> np.ndarray:
-        return -(-self.seq_dict.lengths // self.bin_size)
+        # every contig owns at least one bin, so contigs with undeclared
+        # (0) length still have a home in the bin-id space
+        return np.maximum(-(-self.seq_dict.lengths // self.bin_size), 1)
 
     @cached_property
     def bin_offsets(self) -> np.ndarray:
@@ -47,9 +49,10 @@ class GenomeBins:
         return int(self.bin_offsets[-1])
 
     def start_bin(self, contig_idx, start):
-        return (
-            self.bin_offsets[np.asarray(contig_idx)]
-            + np.asarray(start) // self.bin_size
+        ci = np.asarray(contig_idx)
+        local = np.asarray(start) // self.bin_size
+        return self.bin_offsets[ci] + np.minimum(
+            local, self.bins_per_contig[ci] - 1
         )
 
     def end_bin(self, contig_idx, end):
@@ -58,15 +61,28 @@ class GenomeBins:
         length never spill into the next contig's bin-id range."""
         ci = np.asarray(contig_idx)
         local = np.maximum(np.asarray(end) - 1, 0) // self.bin_size
-        local = np.minimum(local, self.bins_per_contig[ci] - 1)
-        return self.bin_offsets[ci] + local
+        return self.bin_offsets[ci] + np.minimum(
+            local, self.bins_per_contig[ci] - 1
+        )
 
     def invert(self, bin_id: int):
         """bin id -> (contig_idx, start, end) region of the bin."""
         contig = int(np.searchsorted(self.bin_offsets, bin_id, "right") - 1)
         local = bin_id - int(self.bin_offsets[contig])
         start = local * self.bin_size
-        end = min(start + self.bin_size, int(self.seq_dict.lengths[contig]))
+        end = max(
+            min(start + self.bin_size, int(self.seq_dict.lengths[contig])),
+            start,
+        )
+        return contig, start, end
+
+    def dedupe_region(self, bin_id: int):
+        """Like :meth:`invert`, but the last bin of each contig extends to
+        +inf: overhanging intervals clamp into that bin, and their starts
+        must still satisfy the at-least-one-side-starts-here join rule."""
+        contig, start, end = self.invert(bin_id)
+        if bin_id == int(self.bin_offsets[contig + 1]) - 1:
+            end = np.iinfo(np.int64).max
         return contig, start, end
 
 

@@ -142,7 +142,7 @@ def shuffle_region_join(
         if len(pl) == 0:
             continue
         gl, gr = lsel[pl], rsel[pr]
-        _, bstart, bend = bins.invert(int(b))
+        _, bstart, bend = bins.dedupe_region(int(b))
         keep = (
             (left.start[gl] >= bstart) & (left.start[gl] < bend)
         ) | ((right.start[gr] >= bstart) & (right.start[gr] < bend))

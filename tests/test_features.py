@@ -1,0 +1,188 @@
+"""Feature layer tests.
+
+Mirrors the reference's FeatureParserSuite / GeneFeatureRDDSuite
+patterns, running against the GTF/BED/narrowPeak fixtures shipped in the
+reference test resources.
+"""
+
+import numpy as np
+import pytest
+
+from adam_tpu.api.datasets import FeatureDataset
+from adam_tpu.io import features as fio
+from adam_tpu.models.genes import as_genes, reverse_complement
+
+RES = "/root/reference/adam-core/src/test/resources/features"
+GTF = f"{RES}/Homo_sapiens.GRCh37.75.trun100.gtf"
+BED = f"{RES}/gencode.v7.annotation.trunc10.bed"
+PEAK = f"{RES}/wgEncodeOpenChromDnaseGm19238Pk.trunc10.narrowPeak"
+
+
+class TestGTF:
+    @pytest.fixture(scope="class")
+    def feats(self):
+        return FeatureDataset.load(GTF).batch
+
+    def test_coordinates_converted(self, feats):
+        # first record: gene DDX11L1 at 1-based [11869, 14412] closed
+        assert feats.start[0] == 11868
+        assert feats.end[0] == 14412
+        assert feats.contig_names[feats.contig_idx[0]] == "1"
+
+    def test_type_and_ids(self, feats):
+        side = feats.sidecar
+        assert side.feature_type[0] == "gene"
+        assert side.feature_id[0] == "ENSG00000223972"
+        assert side.parent_ids[0] == []
+        # transcripts parent to the gene
+        assert side.feature_type[1] == "transcript"
+        assert side.feature_id[1] == "ENST00000456328"
+        assert side.parent_ids[1] == ["ENSG00000223972"]
+        # exons use exon_id and parent to the transcript
+        assert side.feature_type[2] == "exon"
+        assert side.feature_id[2] == "ENSE00002234944"
+        assert side.parent_ids[2] == ["ENST00000456328"]
+
+    def test_attributes(self, feats):
+        assert feats.sidecar.attributes[0]["gene_name"] == "DDX11L1"
+
+    def test_as_genes(self, feats):
+        genes = as_genes(feats)
+        by_id = {g.id: g for g in genes}
+        assert "ENSG00000223972" in by_id
+        g = by_id["ENSG00000223972"]
+        assert len(g.transcripts) >= 2
+        tx = {t.id: t for t in g.transcripts}["ENST00000456328"]
+        assert len(tx.exons) == 3
+        assert tx.strand is True
+        assert tx.region.start == 11868 and tx.region.end == 14409
+        # gene regions = union of transcript spans
+        assert len(g.regions) == 1
+        assert g.regions[0].referenceName == "1"
+
+    def test_filter_by_overlapping_region(self, feats):
+        hit = feats.filter_by_overlapping_region("1", 11900, 11950)
+        assert len(hit) > 0
+        assert (hit.start < 11950).all() and (hit.end > 11900).all()
+        assert len(feats.filter_by_overlapping_region("99", 0, 100)) == 0
+
+
+class TestBED:
+    def test_parse(self):
+        feats = FeatureDataset.load(BED).batch
+        assert len(feats) == 10
+        # BED coords pass through unchanged
+        first = open(BED).readline().split("\t")
+        assert feats.start[0] == int(first[1])
+        assert feats.end[0] == int(first[2])
+        assert feats.sidecar.feature_type[0] == first[3]
+
+    def test_round_trip(self, tmp_path):
+        feats = FeatureDataset.load(BED)
+        out = str(tmp_path / "rt.bed")
+        feats.save(out)
+        back = FeatureDataset.load(out)
+        assert np.array_equal(feats.batch.start, back.batch.start)
+        assert np.array_equal(feats.batch.end, back.batch.end)
+        assert np.array_equal(feats.batch.strand, back.batch.strand)
+
+
+class TestNarrowPeak:
+    def test_parse(self):
+        feats = FeatureDataset.load(PEAK).batch
+        assert len(feats) == 10
+        side = feats.sidecar
+        assert "signalValue" in side.attributes[0]
+        assert "pValue" in side.attributes[0]
+
+
+class TestDispatch:
+    def test_unknown_extension_rejected(self, tmp_path):
+        p = tmp_path / "x.unknown"
+        p.write_text("a\t1\t2\n")
+        with pytest.raises(ValueError, match="cannot infer"):
+            fio.read_features(str(p))
+
+    def test_gzip_and_gff3(self, tmp_path):
+        import gzip
+
+        p = tmp_path / "a.gff3.gz"
+        with gzip.open(p, "wt") as fh:
+            fh.write("1\tens\tgene\t100\t200\t.\t+\t.\tID=g1;Name=G\n")
+        feats = fio.read_features(str(p))
+        assert len(feats) == 1
+        assert feats.start[0] == 99
+        assert feats.sidecar.attributes[0]["ID"] == "g1"
+
+    def test_intervals_remap_to_seq_dict(self):
+        feats = FeatureDataset.load(BED)
+        # target space lists contigs in a different order + an extra one
+        own = feats.batch.contig_names
+        target = ["decoy"] + list(own)
+        iv = feats.intervals(target)
+        assert iv.contig.tolist() == (feats.batch.contig_idx + 1).tolist()
+        # unknown contigs map to -1
+        iv2 = feats.intervals(["nothing"])
+        assert (iv2.contig == -1).all()
+
+
+class TestWigFix:
+    def test_expansion(self):
+        lines = [
+            "fixedStep chrom=chr1 start=100 step=10 span=5",
+            "1.0",
+            "2.0",
+            "fixedStep chrom=chr2 start=1 step=1",
+            "0.5",
+        ]
+        rows = list(fio.wigfix_to_bed_lines(lines))
+        assert rows[0] == "chr1\t99\t104\t\t1.0"
+        assert rows[1] == "chr1\t109\t114\t\t2.0"
+        # span persists across declarations unless reset
+        assert rows[2] == "chr2\t0\t5\t\t0.5"
+
+
+class TestSequenceExtraction:
+    def make_tx(self):
+        from adam_tpu.models.genes import Exon, CDS, Transcript
+        from adam_tpu.models.positions import ReferenceRegion
+
+        exons = (
+            Exon("e1", "t", True, ReferenceRegion("1", 2, 6)),
+            Exon("e2", "t", True, ReferenceRegion("1", 10, 14)),
+        )
+        cds = (CDS("t", True, ReferenceRegion("1", 4, 6)),)
+        return Transcript("t", ("t",), "g", True, exons, cds)
+
+    def test_forward(self):
+        ref = "AACCGGTTAACCGGTT"
+        tx = self.make_tx()
+        assert tx.extract_transcribed_rna_sequence(ref) == ref[2:14]
+        assert tx.extract_spliced_mrna_sequence(ref) == ref[2:6] + ref[10:14]
+        assert tx.extract_coding_sequence(ref) == ref[4:6]
+
+    def test_reverse(self):
+        from dataclasses import replace
+
+        ref = "AACCGGTTAACCGGTT"
+        tx = self.make_tx()
+        rtx = replace(
+            tx,
+            strand=False,
+            exons=tuple(
+                type(e)(e.id, e.transcript_id, False, e.region)
+                for e in tx.exons
+            ),
+        )
+        assert rtx.extract_transcribed_rna_sequence(ref) == reverse_complement(
+            ref[2:14]
+        )
+        # exons emitted 3'->5' in genome order, each revcomped
+        assert rtx.extract_spliced_mrna_sequence(ref) == reverse_complement(
+            ref[10:14]
+        ) + reverse_complement(ref[2:6])
+
+    def test_reverse_complement(self):
+        assert reverse_complement("ACGT") == "ACGT"
+        assert reverse_complement("AAGG") == "CCTT"
+        assert reverse_complement("ANC") == "GNT"

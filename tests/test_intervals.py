@@ -148,6 +148,15 @@ class TestShuffleJoin:
         li, ri = shuffle_region_join(l, r, sd, bin_size=1000)
         assert list(zip(li.tolist(), ri.tolist())) == [(0, 0)]
 
+    def test_zero_length_contig_still_joins(self):
+        # contigs with undeclared (0) length own one bin; their pairs
+        # survive, including when both sides start past the bin size
+        sd = SequenceDictionary.from_lists(["c0", "c1", "c2"], [0, 2000, 0])
+        l = IntervalArrays.of([0, 2, 2], [10, 5000, 9000], [20, 5100, 9100])
+        r = IntervalArrays.of([0, 2], [15, 5050], [25, 5150])
+        li, ri = shuffle_region_join(l, r, sd, bin_size=1000)
+        assert set(zip(li.tolist(), ri.tolist())) == {(0, 0), (1, 1)}
+
     def test_genome_bins(self):
         sd = self.make_dict()
         bins = GenomeBins(1000, sd)

@@ -192,6 +192,19 @@ class TestAnalyses:
         assert ("1", 14396, "CTGT", 4) in counts
         assert ("1", 14396, "C", 2) in counts
 
+    def test_snp_table_skips_reference_blocks(self, tmp_path):
+        p = tmp_path / "g.vcf"
+        p.write_text(
+            "##fileformat=VCFv4.1\n"
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS\n"
+            "1\t100\t.\tA\tG\t50\tPASS\t.\tGT\t0/1\n"
+            "1\t200\t.\tG\t<NON_REF>\t.\t.\tEND=1000\tGT\t0/0\n"
+        )
+        t = GenotypeDataset.load(str(p)).snp_table()
+        assert len(t) == 1  # only the real variant masks
+        assert t.contains("1", 99)
+        assert not t.contains("1", 500)
+
     def test_snp_table(self, small):
         t = small.snp_table()
         assert t.contains("1", 14521)  # SNP G->A at 0-based 14521
