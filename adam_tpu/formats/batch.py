@@ -204,6 +204,16 @@ class ReadSidecar:
     attrs: list = field(default_factory=list)       # raw SAM tag strings ("NM:i:0\tAS:i:75")
     md: list = field(default_factory=list)          # MD tag string or None
     orig_quals: list = field(default_factory=list)  # OQ or None
+    # basesTrimmedFromStart/End bookkeeping (AlignmentRecord fields set by
+    # TrimReads.trimRead, rdd/read/correction/TrimReads.scala:363-368)
+    trimmed_from_start: list = field(default_factory=list)
+    trimmed_from_end: list = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.trimmed_from_start:
+            self.trimmed_from_start = [0] * len(self.names)
+        if not self.trimmed_from_end:
+            self.trimmed_from_end = [0] * len(self.names)
 
     def take(self, idx) -> "ReadSidecar":
         idx = np.asarray(idx)
@@ -212,6 +222,8 @@ class ReadSidecar:
             attrs=[self.attrs[i] for i in idx],
             md=[self.md[i] for i in idx],
             orig_quals=[self.orig_quals[i] for i in idx],
+            trimmed_from_start=[self.trimmed_from_start[i] for i in idx],
+            trimmed_from_end=[self.trimmed_from_end[i] for i in idx],
         )
 
     @staticmethod
@@ -222,6 +234,8 @@ class ReadSidecar:
             out.attrs += s.attrs
             out.md += s.md
             out.orig_quals += s.orig_quals
+            out.trimmed_from_start += s.trimmed_from_start
+            out.trimmed_from_end += s.trimmed_from_end
         return out
 
     def __len__(self) -> int:
@@ -294,5 +308,7 @@ def pack_reads(
         side.attrs.append(r.get("attrs", ""))
         side.md.append(r.get("md"))
         side.orig_quals.append(r.get("orig_qual"))
+        side.trimmed_from_start.append(r.get("trimmed_from_start", 0))
+        side.trimmed_from_end.append(r.get("trimmed_from_end", 0))
 
     return b, side

@@ -75,8 +75,10 @@ class FeatureBatch:
         The batch's private contig table need not match anyone else's
         index space: pass the target ``contig_names`` (e.g. from a
         SequenceDictionary) to remap; rows on contigs unknown to the
-        target get contig -1 (joins never match them). With no argument
-        the batch's own table is used — only valid when both join sides
+        target become empty intervals on contig -1, which can overlap
+        nothing (a half-open overlap needs start < other.end AND
+        end > other.start) — not even each other. With no argument the
+        batch's own table is used — only valid when both join sides
         share it.
         """
         from adam_tpu.pipelines.region_join import IntervalArrays
@@ -87,8 +89,12 @@ class FeatureBatch:
         remap = np.array(
             [target.get(n, -1) for n in self.contig_names], np.int64
         )
+        contig = remap[self.contig_idx]
+        unknown = contig < 0
         return IntervalArrays.of(
-            remap[self.contig_idx], self.start, self.end
+            contig,
+            np.where(unknown, 0, self.start),
+            np.where(unknown, 0, self.end),
         )
 
     def filter_by_overlapping_region(

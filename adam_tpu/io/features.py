@@ -54,20 +54,31 @@ def _gtf_line(builder: FeatureBatchBuilder, line: str) -> None:
     seqname, source, ftype, start, end, score, strand, _frame, attr = f[:9]
     attrs = parse_gtf_attrs(attr)
 
+    # GFF3 spells transcripts 'mRNA' and wires hierarchy with ID=/Parent=;
+    # normalize so downstream gene assembly (models/genes.as_genes) sees
+    # one vocabulary.
+    if ftype == "mRNA":
+        attrs.setdefault("original_type", ftype)
+        ftype = "transcript"
+    gff3_id, gff3_parent = attrs.get("ID"), attrs.get("Parent")
+
     exon_id = attrs.get("exon_id")
     if exon_id is None and "transcript_id" in attrs and "exon_number" in attrs:
         exon_id = attrs["transcript_id"] + "_" + attrs["exon_number"]
 
     if ftype == "gene":
-        fid, parent = attrs.get("gene_id"), None
+        fid, parent = attrs.get("gene_id") or gff3_id, None
     elif ftype == "transcript":
-        fid, parent = attrs.get("transcript_id"), attrs.get("gene_id")
+        fid = attrs.get("transcript_id") or gff3_id
+        parent = attrs.get("gene_id") or gff3_parent
     elif ftype == "exon":
-        fid, parent = exon_id, attrs.get("transcript_id")
+        fid = exon_id or gff3_id
+        parent = attrs.get("transcript_id") or gff3_parent
     elif ftype in ("CDS", "UTR"):
-        fid, parent = attrs.get("id"), attrs.get("transcript_id")
+        fid = attrs.get("id") or gff3_id
+        parent = attrs.get("transcript_id") or gff3_parent
     else:
-        fid, parent = attrs.get("id"), None
+        fid, parent = attrs.get("id") or gff3_id, gff3_parent
 
     builder.add(
         seqname,
@@ -168,12 +179,14 @@ _WIG_DECL = re.compile(
     r"^fixedStep\s+chrom=(.+?)\s+start=([0-9]+)\s+step=([0-9]+)"
     r"\s*(?:$|span=([0-9]+).*$)"
 )
-_WIG_FEAT = re.compile(r"^\s*([-]?[0-9]*\.?[0-9]*)\s*$")
-
-
 def wigfix_to_bed_lines(lines):
     """Expand a fixedStep wiggle stream to BED rows
-    (WigFix2Bed.run, adam-cli Wiggle2Bed.scala:57-81)."""
+    (WigFix2Bed.run, adam-cli Wiggle2Bed.scala:57-81).
+
+    Every non-blank, non-declaration line must be a numeric value
+    (including scientific notation); anything else is a format error —
+    silently skipping a line would desynchronize every later coordinate.
+    """
     contig, current, step, span = "", 0, 0, 1
     for line in lines:
         m = _WIG_DECL.match(line)
@@ -183,12 +196,12 @@ def wigfix_to_bed_lines(lines):
             step = int(m.group(3))
             span = int(m.group(4)) if m.group(4) else span
             continue
-        m = _WIG_FEAT.match(line)
-        if m and m.group(1):
-            yield "\t".join(
-                [contig, str(current), str(current + span), "", m.group(1)]
-            )
-            current += step
+        s = line.strip()
+        if not s:
+            continue
+        float(s)  # raises ValueError on malformed data lines
+        yield "\t".join([contig, str(current), str(current + span), "", s])
+        current += step
 
 
 def wigfix_to_bed(wig_path: str, bed_path: str) -> None:

@@ -35,6 +35,7 @@ ALIGNMENT_FIELDS = [
     "readName", "sequence", "qual", "flags", "contig", "start", "end",
     "mapq", "cigar", "mateContig", "mateAlignmentStart", "inferredInsertSize",
     "recordGroupName", "attributes", "mismatchingPositions", "origQual",
+    "basesTrimmedFromStart", "basesTrimmedFromEnd",
 ]
 
 
@@ -158,6 +159,12 @@ def save_alignments(
             "attributes": pa.array([side.attrs[i] for i in rows], pa.string()),
             "mismatchingPositions": pa.array([side.md[i] for i in rows], pa.string()),
             "origQual": pa.array([side.orig_quals[i] for i in rows], pa.string()),
+            "basesTrimmedFromStart": pa.array(
+                [side.trimmed_from_start[i] for i in rows], pa.int32()
+            ),
+            "basesTrimmedFromEnd": pa.array(
+                [side.trimmed_from_end[i] for i in rows], pa.int32()
+            ),
         }
     )
     table = table.replace_schema_metadata(_header_meta(header))
@@ -204,6 +211,8 @@ def load_alignments(
     attrs = col("attributes", "")
     mds = col("mismatchingPositions")
     oqs = col("origQual")
+    tfs = col("basesTrimmedFromStart", 0)
+    tfe = col("basesTrimmedFromEnd", 0)
 
     records = [
         dict(
@@ -222,6 +231,8 @@ def load_alignments(
             attrs=attrs[i] or "",
             md=mds[i],
             orig_qual=oqs[i],
+            trimmed_from_start=tfs[i] or 0,
+            trimmed_from_end=tfe[i] or 0,
         )
         for i in range(table.num_rows)
     ]

@@ -118,6 +118,21 @@ def shuffle_region_join(
     bins = GenomeBins(bin_size, seq_dict)
     out_l, out_r = [], []
 
+    # rows on contigs outside the dictionary (negative / out-of-range ids)
+    # cannot land in any genome bin — exclude them rather than crash
+    n_contigs = len(seq_dict.names)
+    l_keep = np.flatnonzero((left.contig >= 0) & (left.contig < n_contigs))
+    r_keep = np.flatnonzero((right.contig >= 0) & (right.contig < n_contigs))
+    if len(l_keep) < len(left) or len(r_keep) < len(right):
+        left = IntervalArrays.of(
+            left.contig[l_keep], left.start[l_keep], left.end[l_keep]
+        )
+        right = IntervalArrays.of(
+            right.contig[r_keep], right.start[r_keep], right.end[r_keep]
+        )
+        li, ri = shuffle_region_join(left, right, seq_dict, bin_size)
+        return l_keep[li], r_keep[ri]
+
     l_lo = bins.start_bin(left.contig, left.start)
     l_hi = bins.end_bin(left.contig, left.end) + 1
     r_lo = bins.start_bin(right.contig, right.start)

@@ -1,0 +1,321 @@
+"""Read trimming — fixed-length and adaptive (quality-profile) variants.
+
+Covers the surface of ``rdd/read/correction/TrimReads.scala``:
+
+* ``trim_reads(ds, trim_start, trim_end)`` — fixed trim of every read
+  (``TrimReads.apply(rdd, trimStart, trimEnd)``, :111-133): drops bases
+  and quals, rewrites the CIGAR with hard clips (excising deletions /
+  reference skips that are trimmed through, :255-341), shifts
+  ``start``/``end`` when alignment-match bases are trimmed, and trims the
+  MD tag (:163-240).
+* ``trim_low_quality_read_groups(ds, phred_threshold)`` — the adaptive
+  variant (:39-109): per (read group, cycle) mean quality profile, trim
+  the leading/trailing cycles whose mean phred is below the threshold.
+
+TPU-first split: the quality profile is a device kernel (scatter-add of
+log success probabilities into a dense ``[n_rg, Lmax]`` histogram — the
+analog of the reference's ``reduceByKeyLocally`` over ``((rg, pos),
+logp)`` pairs); base/qual trimming is a vectorized shift of the batch
+columns; only the variable-length CIGAR/MD rewrite stays host-side,
+like the realignment writer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import ReadBatch
+from adam_tpu.ops import phred
+
+# ------------------------------------------------------------------ profile
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def quality_profile_kernel(batch: ReadBatch, n_rg: int):
+    """Sum of log success probabilities and counts per (read group, cycle).
+
+    Reads with no read group land in bin ``n_rg`` (the reference keys them
+    by a null record-group name, TrimReads.scala:145-153).
+    """
+    n, lmax = batch.quals.shape
+    pos_ok = (jnp.arange(lmax)[None, :] < batch.lengths[:, None]) & (
+        batch.valid & batch.has_qual
+    )[:, None]
+    logp = jnp.log(phred.phred_to_success_probability(batch.quals))
+    rg = jnp.where(batch.read_group_idx < 0, n_rg, batch.read_group_idx)
+    flat_bins = rg[:, None] * lmax + jnp.arange(lmax)[None, :]
+    size = (n_rg + 1) * lmax
+    sums = jax.ops.segment_sum(
+        jnp.where(pos_ok, logp, 0.0).reshape(-1), flat_bins.reshape(-1), size
+    )
+    counts = jax.ops.segment_sum(
+        pos_ok.astype(jnp.int32).reshape(-1), flat_bins.reshape(-1), size
+    )
+    return sums.reshape(n_rg + 1, lmax), counts.reshape(n_rg + 1, lmax)
+
+
+def mean_quality_profile(batch: ReadBatch, n_rg: int):
+    """Per-(rg, cycle) mean phred: successProbabilityToPhred(exp(sum/count))
+    (TrimReads.scala:76-87)."""
+    sums, counts = quality_profile_kernel(batch.to_device(), n_rg)
+    sums, counts = np.asarray(sums), np.asarray(counts)
+    means = np.full(sums.shape, -1, np.int64)
+    nz = counts > 0
+    succ = np.exp(sums[nz] / counts[nz])
+    means[nz] = np.floor(-10.0 * np.log10(1.0 - succ) + 0.5).astype(np.int64)
+    return means, counts
+
+
+def trim_lengths(mean_quals: np.ndarray, counts: np.ndarray, threshold: int):
+    """takeWhile(mean < threshold) from each end (TrimReads.scala:89-92)."""
+    idx = np.flatnonzero(counts > 0)
+    if idx.size == 0:
+        return 0, 0
+    quals = mean_quals[idx]
+    below = quals < threshold
+    if below.all():
+        # every cycle fails the threshold: the whole read would go
+        return len(quals), 0
+    return int(np.argmin(below)), int(np.argmin(below[::-1]))
+
+
+# ------------------------------------------------------------- cigar / md
+
+
+def trim_cigar(
+    ops: np.ndarray, lens: np.ndarray, n: int, trim_start: int, trim_end: int,
+    start: int, end: int,
+):
+    """Trim a CIGAR, returning (elems, new_start, new_end).
+
+    Mirrors TrimReads.trimCigar (:255-341): D/N runs hit while trimming
+    are excised whole (advancing the reference coordinate by their full
+    length); trimmed segments are replaced with hard clips.
+    """
+    elems = [(int(lens[i]), int(ops[i])) for i in range(n)]
+
+    def trim_front(elems, trim, pos, step):
+        out = list(elems)
+        while trim > 0 and out:
+            ln, op = out[0]
+            if op in (schema.CIGAR_D, schema.CIGAR_N):
+                out.pop(0)
+                pos += step * ln
+                continue
+            if ln == 1:
+                out.pop(0)
+            else:
+                out[0] = (ln - 1, op)
+            if op in (schema.CIGAR_M, schema.CIGAR_EQ, schema.CIGAR_X):
+                pos += step
+            trim -= 1
+        return out, pos
+
+    elems, start = trim_front(elems, trim_start, start, +1)
+    rev, end = trim_front(elems[::-1], trim_end, end, -1)
+    elems = rev[::-1]
+    if trim_start > 0:
+        elems.insert(0, (trim_start, schema.CIGAR_H))
+    if trim_end > 0:
+        elems.append((trim_end, schema.CIGAR_H))
+    return elems, start, end
+
+
+def _md_tokens(md: str) -> list:
+    """MD string -> [int match | 'A' mismatch | '^ACG' deletion] tokens."""
+    toks, i = [], 0
+    while i < len(md):
+        c = md[i]
+        if c.isdigit():
+            j = i
+            while j < len(md) and md[j].isdigit():
+                j += 1
+            toks.append(int(md[i:j]))
+            i = j
+        elif c == "^":
+            j = i + 1
+            while j < len(md) and md[j].isalpha():
+                j += 1
+            toks.append(md[i:j])
+            i = j
+        else:
+            toks.append(c)
+            i += 1
+    return toks
+
+
+def _md_string(toks: list) -> str:
+    """Emit tokens with match counts (0 where absent) between events."""
+    out, need_num = [], True
+    for t in toks:
+        if isinstance(t, int):
+            out.append(str(t))
+            need_num = False
+        else:
+            if need_num:
+                out.append("0")
+            out.append(t)
+            need_num = True
+    if need_num:
+        out.append("0")
+    return "".join(out)
+
+
+def trim_md_tag(md: str, trim_start: int, trim_end: int) -> str:
+    """Trim aligned bases off an MD tag (TrimReads.trimMdTag, :163-240).
+
+    Deletions hit while trimming are excised without consuming trim
+    length (they consume reference, not read, bases).
+    """
+    toks = _md_tokens(md)
+
+    def trim_front(toks, trim):
+        out = list(toks)
+        while trim > 0 and out:
+            t = out[0]
+            if isinstance(t, str) and t.startswith("^"):
+                out.pop(0)
+            elif isinstance(t, str):
+                out.pop(0)
+                trim -= 1
+            else:  # match run
+                if t == 0:
+                    out.pop(0)
+                else:
+                    out[0] = t - 1
+                    trim -= 1
+        return out
+
+    toks = trim_front(toks, trim_start)
+    toks = trim_front(toks[::-1], trim_end)[::-1]
+    return _md_string(toks)
+
+
+# ------------------------------------------------------------------- apply
+
+
+def _shift_columns(b: ReadBatch, ts: int, te: int, rows: np.ndarray) -> ReadBatch:
+    """Vectorized drop of ts leading / te trailing bases for ``rows``."""
+    bases = np.array(b.bases)
+    quals = np.array(b.quals)
+    lengths = np.array(b.lengths)
+    lmax = bases.shape[1]
+    new_len = np.maximum(lengths[rows] - ts - te, 0)
+    keep = np.arange(lmax)[None, :] < new_len[:, None]
+    pad_cols = ((0, 0), (0, ts))
+    g = np.pad(bases[rows][:, ts:], pad_cols, constant_values=schema.BASE_PAD)
+    bases[rows] = np.where(keep, g, schema.BASE_PAD)
+    gq = np.pad(quals[rows][:, ts:], pad_cols, constant_values=schema.QUAL_PAD)
+    quals[rows] = np.where(keep, gq, schema.QUAL_PAD)
+    lengths[rows] = new_len
+    return b.replace(bases=bases, quals=quals, lengths=lengths)
+
+
+def trim_reads(
+    ds: AlignmentDataset, trim_start: int = -1, trim_end: int = -1,
+    rg_idx: int | None = None, strict: bool = True,
+) -> AlignmentDataset:
+    """Fixed trim of ``trim_start``/``trim_end`` bases (negative = 0).
+
+    ``rg_idx`` restricts the trim to one read group (the adaptive
+    variant's per-group loop, TrimReads.scala:64-96).  With
+    ``strict=False``, reads too short for the trim are left untouched
+    instead of raising (the adaptive path uses this: a group's
+    profile-derived trim must not be fatal for its shortest reads).
+    """
+    ts, te = max(trim_start, 0), max(trim_end, 0)
+    if ts == 0 and te == 0:
+        return ds
+    b = ds.batch.to_numpy()
+    side = ds.sidecar
+    mask = np.asarray(b.valid).copy()
+    if rg_idx is not None:
+        mask &= np.asarray(b.read_group_idx) == rg_idx
+    too_short = np.asarray(b.lengths) <= ts + te
+    if strict and bool((mask & too_short).any()):
+        raise ValueError("cannot trim more than the length of the read")
+    mask &= ~too_short
+    rows = np.flatnonzero(mask)
+    if rows.size == 0:
+        return ds
+
+    b = _shift_columns(b, ts, te, rows)
+
+    # CIGAR / start / end / MD rewrite, host-side per affected row.
+    cigar_ops = np.array(b.cigar_ops)
+    cigar_lens = np.array(b.cigar_lens)
+    cigar_n = np.array(b.cigar_n)
+    start = np.array(b.start)
+    end = np.array(b.end)
+    new_md = list(side.md)
+    new_elems: dict[int, list] = {}
+    cmax = b.cmax
+    for i in rows:
+        i = int(i)
+        if cigar_n[i] == 0:
+            continue
+        elems, s, e = trim_cigar(
+            cigar_ops[i], cigar_lens[i], int(cigar_n[i]), ts, te,
+            int(start[i]), int(end[i]),
+        )
+        new_elems[i] = elems
+        start[i], end[i] = s, e
+        if side.md[i] is not None:
+            new_md[i] = trim_md_tag(side.md[i], ts, te)
+        cmax = max(cmax, len(elems))
+    if cmax > b.cmax:
+        b = b.widen(b.lmax, cmax)
+        cigar_ops = np.array(b.cigar_ops)
+        cigar_lens = np.array(b.cigar_lens)
+    for i, elems in new_elems.items():
+        cigar_ops[i] = schema.CIGAR_PAD
+        cigar_lens[i] = 0
+        for j, (ln, op) in enumerate(elems):
+            cigar_ops[i, j] = op
+            cigar_lens[i, j] = ln
+        cigar_n[i] = len(elems)
+
+    b = b.replace(
+        cigar_ops=cigar_ops, cigar_lens=cigar_lens, cigar_n=cigar_n,
+        start=start, end=end,
+    )
+    from dataclasses import replace as dc_replace
+
+    rowset = set(int(r) for r in rows)
+    side = dc_replace(
+        side,
+        md=new_md,
+        trimmed_from_start=[
+            v + (ts if k in rowset else 0)
+            for k, v in enumerate(side.trimmed_from_start)
+        ],
+        trimmed_from_end=[
+            v + (te if k in rowset else 0)
+            for k, v in enumerate(side.trimmed_from_end)
+        ],
+    )
+    return ds.with_batch(b, side)
+
+
+def trim_low_quality_read_groups(
+    ds: AlignmentDataset, phred_threshold: int = 20
+) -> AlignmentDataset:
+    """Adaptive trim: per-read-group mean quality profile, trim cycles
+    below ``phred_threshold`` from each end (TrimReads.scala:39-109)."""
+    n_rg = len(ds.header.read_groups.names)
+    means, counts = mean_quality_profile(ds.batch, n_rg)
+    out = ds
+    for rg in range(n_rg + 1):
+        ts, te = trim_lengths(means[rg], counts[rg], phred_threshold)
+        if ts == 0 and te == 0:
+            continue
+        out = trim_reads(
+            out, ts, te, rg_idx=rg if rg < n_rg else -1, strict=False
+        )
+    return out

@@ -186,3 +186,104 @@ class TestSequenceExtraction:
         assert reverse_complement("ACGT") == "ACGT"
         assert reverse_complement("AAGG") == "CCTT"
         assert reverse_complement("ANC") == "GNT"
+
+
+class TestReviewRegressions:
+    def test_gff3_hierarchy_wired(self, tmp_path):
+        """GFF3 ID=/Parent= and mRNA rows must build a gene model."""
+        from adam_tpu.io import features as fio
+
+        p = tmp_path / "x.gff3"
+        p.write_text(
+            "chr1\tsrc\tgene\t100\t500\t.\t+\t.\tID=gene1\n"
+            "chr1\tsrc\tmRNA\t100\t500\t.\t+\t.\tID=tx1;Parent=gene1\n"
+            "chr1\tsrc\texon\t100\t200\t.\t+\t.\tID=ex1;Parent=tx1\n"
+            "chr1\tsrc\texon\t300\t500\t.\t+\t.\tID=ex2;Parent=tx1\n"
+        )
+        feats = fio.read_features(str(p))
+        assert feats.sidecar.feature_id[:2] == ["gene1", "tx1"]
+        assert feats.sidecar.feature_type[1] == "transcript"
+        assert feats.sidecar.parent_ids[1] == ["gene1"]
+        assert feats.sidecar.parent_ids[2] == ["tx1"]
+        from adam_tpu.models.genes import as_genes
+
+        genes = as_genes(feats)
+        assert len(genes) == 1
+        assert genes[0].id == "gene1"
+        assert len(genes[0].transcripts) == 1
+        assert len(genes[0].transcripts[0].exons) == 2
+
+    def test_wigfix_scientific_notation_keeps_cursor(self):
+        from adam_tpu.io.features import wigfix_to_bed_lines
+
+        rows = list(
+            wigfix_to_bed_lines(
+                ["fixedStep chrom=chr1 start=10 step=1", "1e-5", "0.5"]
+            )
+        )
+        assert len(rows) == 2
+        assert rows[0].split("\t")[:3] == ["chr1", "9", "10"]
+        assert rows[0].split("\t")[4] == "1e-5"
+        assert rows[1].split("\t")[:3] == ["chr1", "10", "11"]
+
+    def test_wigfix_malformed_line_raises(self):
+        import pytest
+
+        from adam_tpu.io.features import wigfix_to_bed_lines
+
+        with pytest.raises(ValueError):
+            list(
+                wigfix_to_bed_lines(
+                    ["fixedStep chrom=chr1 start=10 step=1", "."]
+                )
+            )
+
+    def test_unknown_contigs_stay_distinct_in_joins(self):
+        """Rows on contigs missing from the target dictionary must not
+        match each other, and the shuffle join must not crash on them."""
+        import numpy as np
+
+        from adam_tpu.formats.features import FeatureBatchBuilder
+        from adam_tpu.models.dictionaries import (
+            SequenceDictionary,
+            SequenceRecord,
+        )
+        from adam_tpu.pipelines.region_join import (
+            broadcast_region_join,
+            shuffle_region_join,
+        )
+
+        b1 = FeatureBatchBuilder()
+        b1.add("chrUn_A", 100, 200)
+        b1.add("chr1", 10, 20)
+        b2 = FeatureBatchBuilder()
+        b2.add("chrUn_B", 150, 250)
+        b2.add("chr1", 15, 30)
+        sd = SequenceDictionary((SequenceRecord("chr1", 1000),))
+        left = b1.build().intervals(["chr1"])
+        right = b2.build().intervals(["chr1"])
+        li, ri = broadcast_region_join(left, right)
+        assert list(zip(li.tolist(), ri.tolist())) == [(1, 1)]
+        li, ri = shuffle_region_join(left, right, sd)
+        assert list(zip(li.tolist(), ri.tolist())) == [(1, 1)]
+
+    def test_adaptive_trim_tolerates_short_reads(self):
+        """A short read in a group whose profile demands a larger trim is
+        left untouched instead of aborting the whole dataset."""
+        from adam_tpu.api.datasets import AlignmentDataset
+        from adam_tpu.formats.batch import pack_reads
+        from adam_tpu.io.sam import SamHeader
+        from adam_tpu.pipelines import trim
+
+        recs = [
+            dict(name="long", flags=0, seq="A" * 20, start=-1, cigar="*",
+                 qual="#" * 5 + "I" * 10 + "#" * 5),
+            dict(name="short", flags=0, seq="A" * 8, start=-1, cigar="*",
+                 qual="#" * 8),
+        ]
+        batch, side = pack_reads(recs)
+        ds = AlignmentDataset(batch, side, SamHeader())
+        out = trim.trim_low_quality_read_groups(ds, 10)
+        assert out.sidecar.trimmed_from_start[1] == 0
+        assert out.sidecar.trimmed_from_end[1] == 0
+        assert out.sidecar.trimmed_from_start[0] > 0

@@ -1,0 +1,139 @@
+"""Trim pipeline tests — mirrors the reference TrimReadsSuite
+(adam-core/src/test/scala/.../rdd/read/correction/TrimReadsSuite.scala)."""
+
+import numpy as np
+
+from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.formats import schema
+from adam_tpu.formats.batch import pack_reads
+from adam_tpu.io import context as ctx
+from adam_tpu.io.sam import SamHeader
+from adam_tpu.pipelines import trim
+
+
+def _trim_cigar_str(cigar, ts, te, start, end):
+    ops, lens, n = schema.encode_cigar(cigar, 8)
+    elems, s, e = trim.trim_cigar(ops, lens, n, ts, te, start, end)
+    return (
+        "".join(f"{ln}{schema.CIGAR_CHARS[op]}" for ln, op in elems),
+        s,
+        e,
+    )
+
+
+def test_trim_md_tags():
+    assert trim.trim_md_tag("10", 2, 0) == "8"
+    assert trim.trim_md_tag("2A10", 4, 0) == "9"
+    assert trim.trim_md_tag("0C10C1", 1, 2) == "10"
+    assert trim.trim_md_tag("1^AC3", 2, 0) == "2"
+    assert trim.trim_md_tag("3^AC1", 0, 2) == "2"
+    assert trim.trim_md_tag("2A0C0", 3, 0) == "0C0"
+    assert trim.trim_md_tag("2A0C0", 0, 1) == "2A0"
+
+
+def test_trim_cigar_clips_and_matches():
+    assert _trim_cigar_str("2S10M", 1, 0, 0, 10) == ("1H1S10M", 0, 10)
+    assert _trim_cigar_str("10M3S", 0, 2, 0, 10) == ("10M1S2H", 0, 10)
+    assert _trim_cigar_str("2S10M3S", 1, 2, 0, 10) == ("1H1S10M1S2H", 0, 10)
+    assert _trim_cigar_str("2S10M", 2, 0, 0, 10) == ("2H10M", 0, 10)
+    assert _trim_cigar_str("10M3S", 0, 3, 0, 10) == ("10M3H", 0, 10)
+    assert _trim_cigar_str("2S10M3S", 2, 3, 0, 10) == ("2H10M3H", 0, 10)
+    assert _trim_cigar_str("2S10M", 3, 0, 0, 10) == ("3H9M", 1, 10)
+    assert _trim_cigar_str("10M3S", 0, 4, 0, 10) == ("9M4H", 0, 9)
+    assert _trim_cigar_str("2S10M3S", 3, 4, 0, 10) == ("3H8M4H", 1, 9)
+
+
+def test_trim_cigar_indels():
+    assert _trim_cigar_str("2S2M2D4M", 5, 0, 0, 8) == ("5H3M", 5, 8)
+    assert _trim_cigar_str("4M1D1M", 0, 3, 0, 6) == ("2M3H", 0, 2)
+    assert _trim_cigar_str("2S2M2N4M", 5, 0, 0, 8) == ("5H3M", 5, 8)
+    assert _trim_cigar_str("4M1N1M", 0, 3, 0, 6) == ("2M3H", 0, 2)
+    assert _trim_cigar_str("2M2I10M", 3, 0, 0, 12) == ("3H1I10M", 2, 12)
+    assert _trim_cigar_str("10M3I1M", 0, 3, 0, 11) == ("10M1I3H", 0, 10)
+
+
+def _dataset(records):
+    batch, side = pack_reads(records)
+    return AlignmentDataset(batch, side, SamHeader())
+
+
+def _read(seq, qual, cigar="*", start=-1, **kw):
+    return dict(
+        name="r", flags=0, seq=seq, qual=qual, cigar=cigar, start=start, **kw
+    )
+
+
+def test_trim_read_with_cigar():
+    ds = _dataset(
+        [
+            _read("ACTCGCCCACTCAAA", "##/9:::::::::##", "2S11M2S", 5),
+            _read("ACTCGCCCACTCAAA", "##/9:::::::::##", "15M", 5),
+        ]
+    )
+    t2 = trim.trim_reads(ds, 2, 2)
+    b = t2.batch.to_numpy()
+    assert schema.decode_bases(b.bases[0], int(b.lengths[0])) == "TCGCCCACTCA"
+    assert schema.decode_quals(b.quals[0], int(b.lengths[0])) == "/9:::::::::"
+    assert int(b.start[0]) == 5 and int(b.end[0]) == 16
+    assert (
+        schema.decode_cigar(b.cigar_ops[0], b.cigar_lens[0], int(b.cigar_n[0]))
+        == "2H11M2H"
+    )
+    assert t2.sidecar.trimmed_from_start[0] == 2
+    assert t2.sidecar.trimmed_from_end[0] == 2
+
+    t3 = trim.trim_reads(ds, 4, 3)
+    b = t3.batch.to_numpy()
+    assert schema.decode_bases(b.bases[1], int(b.lengths[1])) == "GCCCACTC"
+    assert int(b.start[1]) == 9 and int(b.end[1]) == 17
+    assert (
+        schema.decode_cigar(b.cigar_ops[1], b.cigar_lens[1], int(b.cigar_n[1]))
+        == "4H8M3H"
+    )
+
+
+def test_trim_batch_sequential():
+    seqs = ["AACTCGACGCTTT", "AACTCCCTGCTTT", "AACTCATAGCTTT",
+            "AACTCCCAGCTTT", "AACTCGGAGCTTT"]
+    ds = _dataset([_read(s, "##::::::::$$$") for s in seqs])
+    front = trim.trim_reads(ds, 2, 0)
+    b = front.batch.to_numpy()
+    for i in range(5):
+        s = schema.decode_bases(b.bases[i], int(b.lengths[i]))
+        q = schema.decode_quals(b.quals[i], int(b.lengths[i]))
+        assert len(s) == 11 and len(q) == 11
+        assert s.startswith("CT") and s.endswith("TTT")
+        assert q.startswith("::") and q.endswith("$$$")
+        assert front.sidecar.trimmed_from_start[i] == 2
+        assert front.sidecar.trimmed_from_end[i] == 0
+
+    both = trim.trim_reads(front, 0, 3)
+    b = both.batch.to_numpy()
+    for i in range(5):
+        s = schema.decode_bases(b.bases[i], int(b.lengths[i]))
+        assert len(s) == 8
+        assert s.startswith("CT") and s.endswith("GC")
+        assert schema.decode_quals(b.quals[i], int(b.lengths[i])) == "::::::::"
+        assert both.sidecar.trimmed_from_start[i] == 2
+        assert both.sidecar.trimmed_from_end[i] == 3
+
+
+def test_adaptive_trim_bqsr1(ref_resources):
+    """Threshold Q10 on bqsr1.sam trims 5 bases off each end
+    (TrimReadsSuite 'adaptively trim reads')."""
+    ds = ctx.load_alignments(str(ref_resources / "bqsr1.sam"))
+    trimmed = trim.trim_low_quality_read_groups(ds, 10)
+    assert all(v == 5 for v in trimmed.sidecar.trimmed_from_start)
+    assert all(v == 5 for v in trimmed.sidecar.trimmed_from_end)
+
+
+def test_trim_api_roundtrip(tmp_path):
+    ds = _dataset([_read("ACGTACGTAC", "IIIIIIIIII", "10M", 3)])
+    t = ds.trim_reads(1, 1)
+    out = tmp_path / "t.adam"
+    t.save(str(out))
+    ds2 = AlignmentDataset.load(str(out))
+    assert ds2.sidecar.trimmed_from_start == [1]
+    assert ds2.sidecar.trimmed_from_end == [1]
+    b = ds2.batch.to_numpy()
+    assert int(b.lengths[0]) == 8
