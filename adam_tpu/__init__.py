@@ -24,7 +24,8 @@ Package layout (mirrors the reference's layer map, SURVEY.md §1):
 * ``adam_tpu.parallel``  — L4: mesh, partitioners, collective shuffles
 * ``adam_tpu.api``       — L7: user-facing dataset classes + plugin API
 * ``adam_tpu.cli``       — L8: command line (transform, flagstat, ...)
-* ``adam_tpu.instrument``— L9: named-timer registry
+* ``adam_tpu.plugins``   — L7: user-plugin API (ADAMPlugin analog)
+* ``adam_tpu.utils``     — L9 + misc: named-timer registry, flattener, ...
 """
 
 import os

@@ -192,17 +192,33 @@ class GenotypeDataset:
 
     @staticmethod
     def load(path: str, **kw) -> "GenotypeDataset":
-        from adam_tpu.io import vcf as vcf_io
+        """.vcf(.gz) -> VCF codec; anything else -> genotype Parquet
+        directory (the loadVcf / Parquet dispatch of loadGenotypes)."""
+        p = str(path)
+        if p.endswith((".vcf", ".vcf.gz")):
+            from adam_tpu.io import vcf as vcf_io
 
-        v, g, sd = vcf_io.read_vcf(path, **kw)
+            v, g, sd = vcf_io.read_vcf(p, **kw)
+        else:
+            from adam_tpu.io import parquet
+
+            v, g, sd = parquet.load_genotypes(p, **kw)
         return GenotypeDataset(v, g, sd)
 
     def save(self, path: str, sort_on_save: bool = False) -> None:
-        from adam_tpu.io import vcf as vcf_io
+        p = str(path)
+        if p.endswith((".vcf", ".vcf.gz")):
+            from adam_tpu.io import vcf as vcf_io
 
-        vcf_io.write_vcf(
-            path, self.variants, self.genotypes, self.seq_dict, sort_on_save
-        )
+            vcf_io.write_vcf(
+                p, self.variants, self.genotypes, self.seq_dict, sort_on_save
+            )
+        else:
+            from adam_tpu.io import parquet
+
+            parquet.save_genotypes(
+                p, self.variants, self.genotypes, self.seq_dict
+            )
 
     def __len__(self) -> int:
         return len(self.variants)

@@ -1,0 +1,7 @@
+"""Command-line layer — the adam-cli module of the reference.
+
+Registry and lifecycle in :mod:`adam_tpu.cli.main` (ADAMMain.scala:26-110
+/ ADAMCommand.scala:43-91); commands grouped as the reference groups them:
+:mod:`.actions` (ADAM ACTIONS), :mod:`.conversions` (CONVERSION
+OPERATIONS), :mod:`.printers` (PRINT).
+"""

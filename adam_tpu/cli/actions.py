@@ -1,0 +1,375 @@
+"""ADAM ACTIONS command group (ADAMMain.scala:32-48).
+
+depth, count_kmers, count_contig_kmers, transform, adam2fastq, plugin,
+flatten — each docstring cites the reference command it mirrors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from adam_tpu.cli.main import Command
+from adam_tpu.utils import instrumentation as ins
+
+
+class CalculateDepth(Command):
+    """Read depth at each variant of a VCF via broadcast region join
+    (adam-cli CalculateDepth.scala:41-120)."""
+
+    name = "depth"
+    description = "Calculate the depth from a given ADAM file, at each variant in a VCF"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("adam", metavar="ADAM",
+                       help="The read file to use to calculate depths")
+        p.add_argument("vcf", metavar="VCF",
+                       help="The VCF containing the sites at which to calculate depths")
+        p.add_argument("-cartesian", action="store_true",
+                       help="use a cartesian join, then filter")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.api.datasets import AlignmentDataset, GenotypeDataset
+        from adam_tpu.pipelines.region_join import (
+            IntervalArrays,
+            broadcast_region_join,
+        )
+
+        ds = AlignmentDataset.load(args.adam)
+        b = ds.batch.to_numpy()
+        mapped = np.flatnonzero(np.asarray(b.is_mapped) & np.asarray(b.valid))
+        reads = IntervalArrays.of(
+            b.contig_idx[mapped], b.start[mapped], b.end[mapped]
+        )
+        gt = GenotypeDataset.load(args.vcf, contig_names=ds.seq_dict.names)
+        sites = IntervalArrays.of(
+            gt.variants.contig_idx,
+            gt.variants.start,
+            gt.variants.start + 1,  # variant *position*, as the reference keys it
+        )
+        si, _ri = broadcast_region_join(sites, reads)
+        depth = np.bincount(si, minlength=len(sites))
+        names = gt.variants.sidecar.names
+        print("location\tname\tdepth")
+        order = np.lexsort((gt.variants.start, gt.variants.contig_idx))
+        for i in order:
+            loc = "%s:%d" % (
+                ds.seq_dict.names[gt.variants.contig_idx[i]],
+                int(gt.variants.start[i]),
+            )
+            print("%20s\t%15s\t% 5d" % (loc, names[i] or ".", int(depth[i])))
+        return 0
+
+
+class CountReadKmers(Command):
+    """k-mers/q-mers from a read dataset (CountReadKmers.scala:30-100)."""
+
+    name = "count_kmers"
+    description = "Counts the k-mers/q-mers from a read dataset."
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT")
+        p.add_argument("output", metavar="OUTPUT",
+                       help="Location for storing k-mer counts")
+        p.add_argument("kmer_length", metavar="KMER_LENGTH", type=int)
+        p.add_argument("-countQmers", action="store_true",
+                       help="counts q-mers instead of k-mers")
+        p.add_argument("-printHistogram", action="store_true",
+                       help="prints a histogram of counts")
+        p.add_argument("-repartition", type=int, default=-1,
+                       help="accepted for parity; batches need no repartition")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import context
+
+        with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):
+            kw = {}
+            if str(args.input).endswith((".adam", ".parquet")):
+                kw["projection"] = ["sequence", "qual"]
+            ds = context.load_alignments(args.input, **kw)
+        with ins.TIMERS.time(ins.COUNT_KMERS):
+            if args.countQmers:
+                counts = ds.count_qmers(args.kmer_length)
+            else:
+                counts = {k: float(v) for k, v in
+                          ds.count_kmers(args.kmer_length).items()}
+        if args.printHistogram:
+            hist: dict[int, int] = {}
+            for v in counts.values():
+                hist[int(v)] = hist.get(int(v), 0) + 1
+            for k in sorted(hist):
+                print((k, hist[k]))
+        with open(args.output, "w") as fh:
+            for kmer, v in counts.items():
+                fh.write(f"{kmer}, {v}\n")
+        return 0
+
+
+class CountContigKmers(Command):
+    """k-mers over reference contigs (CountContigKmers.scala:29-90)."""
+
+    name = "count_contig_kmers"
+    description = "Counts the k-mers/q-mers from a contig dataset."
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT",
+                       help="The ADAM or FASTA file to count kmers from")
+        p.add_argument("output", metavar="OUTPUT")
+        p.add_argument("kmer_length", metavar="KMER_LENGTH", type=int)
+        p.add_argument("-printHistogram", action="store_true")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.formats.fragments import count_contig_kmers
+        from adam_tpu.io import context, parquet
+
+        if str(args.input).endswith((".fa", ".fasta", ".fa.gz", ".fasta.gz")):
+            fragments, _sd, _desc = context.load_fasta(args.input)
+        else:
+            fragments, _sd, _desc = parquet.load_fragments(args.input)
+        with ins.TIMERS.time(ins.COUNT_KMERS):
+            counts = count_contig_kmers(fragments, args.kmer_length)
+        if args.printHistogram:
+            hist: dict[int, int] = {}
+            for v in counts.values():
+                hist[v] = hist.get(v, 0) + 1
+            for k in sorted(hist):
+                print((k, hist[k]))
+        with open(args.output, "w") as fh:
+            for kmer, v in counts.items():
+                fh.write(f"{kmer}, {v}\n")
+        return 0
+
+
+class Transform(Command):
+    """THE pipeline — flag-composed read preprocessing
+    (Transform.scala:101-179; same stage order)."""
+
+    name = "transform"
+    description = ("Convert SAM/BAM to ADAM format and optionally perform "
+                   "read pre-processing transformations")
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT",
+                       help="The ADAM, BAM or SAM file to apply the transforms to")
+        p.add_argument("output", metavar="OUTPUT",
+                       help="Location to write the transformed data")
+        p.add_argument("-sort_reads", action="store_true")
+        p.add_argument("-mark_duplicate_reads", action="store_true")
+        p.add_argument("-recalibrate_base_qualities", action="store_true")
+        p.add_argument("-dump_observations", default=None,
+                       help="local path to dump BQSR observations to (CSV)")
+        p.add_argument("-known_snps", default=None,
+                       help="sites-only VCF giving location of known SNPs")
+        p.add_argument("-realign_indels", action="store_true")
+        p.add_argument("-known_indels", default=None,
+                       help="VCF of known INDELs; without it the consensus-from-reads model is used")
+        p.add_argument("-max_indel_size", type=int, default=500)
+        p.add_argument("-max_consensus_number", type=int, default=30)
+        p.add_argument("-log_odds_threshold", type=float, default=5.0)
+        p.add_argument("-max_target_size", type=int, default=3000)
+        p.add_argument("-trimReads", action="store_true")
+        p.add_argument("-trimFromStart", type=int, default=0)
+        p.add_argument("-trimFromEnd", type=int, default=0)
+        p.add_argument("-trimReadGroup", default=None)
+        p.add_argument("-qualityBasedTrim", action="store_true")
+        p.add_argument("-qualityThreshold", type=int, default=20)
+        p.add_argument("-trimBeforeBQSR", action="store_true")
+        p.add_argument("-repartition", type=int, default=-1,
+                       help="accepted for parity")
+        p.add_argument("-coalesce", type=int, default=-1,
+                       help="accepted for parity")
+        p.add_argument("-sort_fastq_output", action="store_true")
+        p.add_argument("-force_load_bam", action="store_true")
+        p.add_argument("-force_load_fastq", action="store_true")
+        p.add_argument("-force_load_ifastq", action="store_true")
+        p.add_argument("-force_load_parquet", action="store_true")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.api.datasets import GenotypeDataset
+        from adam_tpu.io import context
+
+        with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):
+            if args.force_load_bam:
+                ds = context.load_bam(args.input)
+            elif args.force_load_fastq:
+                ds = context.load_fastq(args.input)
+            elif args.force_load_ifastq:
+                ds = context.load_interleaved_fastq(args.input)
+            elif args.force_load_parquet:
+                ds = context.load_parquet_alignments(args.input)
+            else:
+                ds = context.load_alignments(args.input)
+
+        if args.trimReads:
+            with ins.TIMERS.time(ins.TRIM_READS):
+                rg_idx = None
+                if args.trimReadGroup is not None:
+                    rg_idx = ds.header.read_groups.names.index(args.trimReadGroup)
+                from adam_tpu.pipelines import trim
+
+                ds = trim.trim_reads(
+                    ds, args.trimFromStart, args.trimFromEnd, rg_idx=rg_idx
+                )
+
+        if args.qualityBasedTrim and args.trimBeforeBQSR:
+            with ins.TIMERS.time(ins.TRIM_READS):
+                ds = ds.trim_low_quality_read_groups(args.qualityThreshold)
+
+        if args.mark_duplicate_reads:
+            with ins.TIMERS.time(ins.MARK_DUPLICATES):
+                ds = ds.mark_duplicates()
+
+        if args.realign_indels:
+            with ins.TIMERS.time(ins.REALIGN_INDELS):
+                kw = dict(
+                    max_indel_size=args.max_indel_size,
+                    max_consensus_number=args.max_consensus_number,
+                    lod_threshold=args.log_odds_threshold,
+                    max_target_size=args.max_target_size,
+                )
+                if args.known_indels:
+                    gt = GenotypeDataset.load(
+                        args.known_indels, contig_names=ds.seq_dict.names
+                    )
+                    ds = ds.realign_indels(
+                        consensus_model="knowns",
+                        known_indels=gt.indel_table(), **kw,
+                    )
+                else:
+                    ds = ds.realign_indels(consensus_model="reads", **kw)
+
+        if args.recalibrate_base_qualities:
+            with ins.TIMERS.time(ins.BQSR):
+                known = None
+                if args.known_snps:
+                    gt = GenotypeDataset.load(
+                        args.known_snps, contig_names=ds.seq_dict.names
+                    )
+                    known = gt.snp_table()
+                ds = ds.recalibrate_base_qualities(
+                    known_snps=known,
+                    dump_observation_table=args.dump_observations,
+                )
+
+        if args.qualityBasedTrim and not args.trimBeforeBQSR:
+            with ins.TIMERS.time(ins.TRIM_READS):
+                ds = ds.trim_low_quality_read_groups(args.qualityThreshold)
+
+        if args.sort_reads:
+            with ins.TIMERS.time(ins.SORT_READS):
+                ds = ds.sort_by_reference_position()
+
+        with ins.TIMERS.time(ins.SAVE_OUTPUT):
+            ds.save(args.output)
+        return 0
+
+
+class Adam2Fastq(Command):
+    """Export reads to FASTQ, optionally splitting pairs
+    (Adam2Fastq.scala:25-80)."""
+
+    name = "adam2fastq"
+    description = "Convert BAM to FASTQ files"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT")
+        p.add_argument("output", metavar="OUTPUT")
+        p.add_argument("output2", metavar="OUTPUT2", nargs="?", default=None,
+                       help="all second-in-pair reads go here, if provided")
+        p.add_argument("-no-projection", dest="no_projection",
+                       action="store_true")
+        p.add_argument("-repartition", type=int, default=-1)
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import context
+
+        kw = {}
+        if not args.no_projection and str(args.input).endswith(
+            (".adam", ".parquet")
+        ):
+            kw["projection"] = ["readName", "sequence", "qual", "flags"]
+        ds = context.load_alignments(args.input, **kw)
+        if args.output2:
+            ds.save_paired_fastq(args.output, args.output2)
+        else:
+            from adam_tpu.io import fastq
+
+            fastq.write_fastq(args.output, ds.batch, ds.sidecar)
+        return 0
+
+
+class PluginExecutor(Command):
+    """Load and run a user plugin (PluginExecutor.scala:41-125)."""
+
+    name = "plugin"
+    description = "Executes an AdamPlugin"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("plugin", metavar="PLUGIN",
+                       help="dotted path of the AdamPlugin to run")
+        p.add_argument("input", metavar="INPUT")
+        p.add_argument("-access_control", default=None,
+                       help="dotted path of an AccessControl class")
+        p.add_argument("-plugin_args", default="",
+                       help="string of args passed to the plugin, split on spaces")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu import plugins
+
+        plugin = plugins.load_plugin(args.plugin)
+        ac = None
+        if args.access_control:
+            cls_ = plugins.load_plugin(args.access_control,
+                                       base=plugins.AccessControl)
+            ac = cls_
+        out = plugins.execute_plugin(
+            plugin, args.input, args.plugin_args.split(), ac
+        )
+        if out is not None:
+            for row in out:
+                print(row)
+        return 0
+
+
+class Flatten(Command):
+    """Flatten nested Parquet columns for SQL engines
+    (Flatten.scala:32-90 + util/Flattener.scala)."""
+
+    name = "flatten"
+    description = ("Convert a ADAM format file to a version with a flattened "
+                   "schema, suitable for querying with tools like Impala")
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT")
+        p.add_argument("output", metavar="OUTPUT")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.utils.flattener import flatten_parquet
+
+        flatten_parquet(args.input, args.output,
+                        compression=args.parquet_compression_codec)
+        return 0
+
+
+COMMANDS = [
+    CalculateDepth,
+    CountReadKmers,
+    CountContigKmers,
+    Transform,
+    Adam2Fastq,
+    PluginExecutor,
+    Flatten,
+]

@@ -1,0 +1,282 @@
+"""CONVERSION OPERATIONS command group (ADAMMain.scala:49-60).
+
+bam2adam, vcf2adam, anno2adam, adam2vcf, fasta2adam, features2adam,
+wigfix2bed.
+"""
+
+from __future__ import annotations
+
+from adam_tpu.cli.main import Command
+from adam_tpu.utils import instrumentation as ins
+
+
+class Bam2Adam(Command):
+    """SAM/BAM -> columnar Parquet without the distributed engine — the
+    reference's non-Spark multithreaded converter (Bam2ADAM.scala:31-120,
+    htsjdk reader -> blocking queue -> N writer threads). The codec layer
+    does its own block-parallel BGZF work; -num_threads is accepted for
+    parity."""
+
+    name = "bam2adam"
+    description = "Single-node BAM to ADAM converter (Note: the 'transform' command can take SAM or BAM as input)"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("bam", metavar="BAM")
+        p.add_argument("adam", metavar="ADAM")
+        p.add_argument("-samtools_validation", default="lenient",
+                       help="accepted for parity")
+        p.add_argument("-num_threads", type=int, default=4)
+        p.add_argument("-queue_size", type=int, default=10000,
+                       help="accepted for parity")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import context, parquet
+
+        with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):
+            ds = context.load_alignments(args.bam)
+        with ins.TIMERS.time(ins.SAVE_OUTPUT):
+            parquet.save_alignments(
+                args.adam, ds.batch, ds.sidecar, ds.header,
+                compression=args.parquet_compression_codec,
+            )
+        return 0
+
+
+class Vcf2Adam(Command):
+    """VCF -> columnar genotype/variant Parquet (Vcf2ADAM.scala:28-70)."""
+
+    name = "vcf2adam"
+    description = "Convert a VCF file to the corresponding ADAM format"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("vcf", metavar="VCF")
+        p.add_argument("adam", metavar="ADAM")
+        p.add_argument("-onlyvariants", action="store_true",
+                       help="output only variants, not genotypes")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import parquet, vcf
+
+        variants, genotypes, seq_dict = vcf.read_vcf(args.vcf)
+        if args.onlyvariants:
+            import numpy as np
+
+            genotypes = genotypes.take(np.zeros(0, np.int64))
+        parquet.save_genotypes(
+            args.adam, variants, genotypes, seq_dict,
+            compression=args.parquet_compression_codec,
+        )
+        return 0
+
+
+class VcfAnnotation2Adam(Command):
+    """VCF annotation database -> ADAM variant-annotation Parquet
+    (VcfAnnotation2ADAM.scala:46-90; INFO fields ride the variant
+    sidecar as the DatabaseVariantAnnotation analog)."""
+
+    name = "anno2adam"
+    description = "Convert a annotation file (in VCF format) to the corresponding ADAM format"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("vcf", metavar="VCF")
+        p.add_argument("adam", metavar="ADAM")
+        p.add_argument("-current-db", dest="current_db", default=None,
+                       help="existing annotation store to merge with")
+
+    @classmethod
+    def run(cls, args):
+        import numpy as np
+
+        from adam_tpu.formats.variants import VariantBatch, VariantSidecar
+        from adam_tpu.io import parquet, vcf
+        from adam_tpu.models.dictionaries import (
+            SequenceDictionary,
+            SequenceRecord,
+        )
+
+        variants, genotypes, seq_dict = vcf.read_vcf(args.vcf)
+        genotypes = genotypes.take(np.zeros(0, np.int64))
+        if args.current_db:
+            # merge with the existing store on variant key; rows from the
+            # new VCF supersede old ones (the joinWithVariantAnnotation
+            # merge, VcfAnnotation2ADAM.scala:70-85)
+            old_v, _og, old_sd = parquet.load_genotypes(args.current_db)
+            names = [r.name for r in seq_dict.records]
+            old_names = [r.name for r in old_sd.records]
+            new_keys = set(variants.variant_keys(names))
+            keep = np.array(
+                [
+                    i for i, k in enumerate(old_v.variant_keys(old_names))
+                    if k not in new_keys
+                ],
+                np.int64,
+            )
+            old_v = old_v.take(keep)
+            name_idx = {n: i for i, n in enumerate(names)}
+            records = list(seq_dict.records)
+            for r in old_sd.records:
+                if r.name not in name_idx:
+                    name_idx[r.name] = len(records)
+                    records.append(SequenceRecord(r.name, r.length))
+            seq_dict = SequenceDictionary(tuple(records))
+            remap = np.array([name_idx[n] for n in old_names], np.int64)
+            s_new, s_old = variants.sidecar, old_v.sidecar
+            variants = VariantBatch(
+                contig_idx=np.concatenate(
+                    [variants.contig_idx, remap[old_v.contig_idx]]
+                ).astype(np.int32),
+                start=np.concatenate([variants.start, old_v.start]),
+                end=np.concatenate([variants.end, old_v.end]),
+                ref_len=np.concatenate([variants.ref_len, old_v.ref_len]),
+                alt_len=np.concatenate([variants.alt_len, old_v.alt_len]),
+                qual=np.concatenate([variants.qual, old_v.qual]),
+                filters_applied=np.concatenate(
+                    [variants.filters_applied, old_v.filters_applied]
+                ),
+                passing=np.concatenate([variants.passing, old_v.passing]),
+                sidecar=VariantSidecar(
+                    ref_allele=s_new.ref_allele + s_old.ref_allele,
+                    alt_allele=s_new.alt_allele + s_old.alt_allele,
+                    names=s_new.names + s_old.names,
+                    filters=s_new.filters + s_old.filters,
+                    info=s_new.info + s_old.info,
+                ),
+            )
+        parquet.save_genotypes(
+            args.adam, variants, genotypes, seq_dict,
+            compression=args.parquet_compression_codec,
+        )
+        return 0
+
+
+class Adam2Vcf(Command):
+    """ADAM genotype Parquet -> VCF (ADAM2Vcf.scala:30-76)."""
+
+    name = "adam2vcf"
+    description = "Convert an ADAM variant to the VCF ADAM format"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("adam", metavar="ADAM")
+        p.add_argument("vcf", metavar="VCF")
+        p.add_argument("-coalesce", type=int, default=-1,
+                       help="accepted for parity")
+        p.add_argument("-sort_on_save", action="store_true")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import parquet, vcf
+
+        variants, genotypes, seq_dict = parquet.load_genotypes(args.adam)
+        vcf.write_vcf(args.vcf, variants, genotypes, seq_dict,
+                      args.sort_on_save)
+        return 0
+
+
+class Fasta2Adam(Command):
+    """FASTA -> fragment Parquet (Fasta2ADAM.scala:25-76)."""
+
+    name = "fasta2adam"
+    description = "Converts a text FASTA sequence file into an ADAMNucleotideContig Parquet file which represents assembled sequences."
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("fasta", metavar="FASTA")
+        p.add_argument("adam", metavar="ADAM")
+        p.add_argument("-fragment_length", type=int, default=10000)
+        p.add_argument("-verbose", action="store_true")
+        p.add_argument("-reads", default=None,
+                       help="reads file for a sequence dictionary to use instead")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import context, parquet
+
+        fragments, seq_dict, descriptions = context.load_fasta(
+            args.fasta, args.fragment_length
+        )
+        if args.reads:
+            ds = context.load_alignments(args.reads)
+            if len(ds.seq_dict.names) > 0:
+                seq_dict = ds.seq_dict
+        if args.verbose:
+            print("Loaded dictionary:")
+            for r in seq_dict.records:
+                print(f"  {r.name}\t{r.length}")
+        parquet.save_fragments(
+            args.adam, fragments, seq_dict, descriptions,
+            compression=args.parquet_compression_codec,
+        )
+        return 0
+
+
+class Features2Adam(Command):
+    """GTF/BED/narrowPeak -> feature Parquet (Features2ADAM.scala:28-60)."""
+
+    name = "features2adam"
+    description = "Convert a file with sequence features into corresponding ADAM format"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("features", metavar="FEATURES",
+                       help="feature file (gtf/gff/bed/narrowpeak)")
+        p.add_argument("adam", metavar="ADAM")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import features as fio
+        from adam_tpu.io import parquet
+
+        feats = fio.read_features(args.features)
+        parquet.save_features(args.adam, feats,
+                              compression=args.parquet_compression_codec)
+        return 0
+
+
+class WigFix2Bed(Command):
+    """Locally convert a wigFix file to BED (Wiggle2Bed.scala:40-81;
+    non-distributed in the reference too)."""
+
+    name = "wigfix2bed"
+    description = "Locally convert a wigFix file to BED format"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("wig", metavar="WIG", nargs="?", default=None,
+                       help="input wigFix file (default: stdin)")
+        p.add_argument("-o", dest="output", default=None,
+                       help="output BED file (default: stdout)")
+
+    @classmethod
+    def run(cls, args):
+        import sys
+
+        from adam_tpu.io.features import wigfix_to_bed_lines
+
+        fin = open(args.wig) if args.wig else sys.stdin
+        fout = open(args.output, "w") if args.output else sys.stdout
+        try:
+            for row in wigfix_to_bed_lines(fin):
+                fout.write(row + "\n")
+        finally:
+            if args.wig:
+                fin.close()
+            if args.output:
+                fout.close()
+        return 0
+
+
+COMMANDS = [
+    Bam2Adam,
+    Vcf2Adam,
+    VcfAnnotation2Adam,
+    Adam2Vcf,
+    Fasta2Adam,
+    Features2Adam,
+    WigFix2Bed,
+]

@@ -1,0 +1,111 @@
+"""Command registry + lifecycle — ADAMMain / ADAMSparkCommand analog.
+
+``python -m adam_tpu.cli.main <command> [args]`` (or the ``adam-tpu``
+console script). The registry mirrors ``ADAMMain.scala:30-72`` — three
+groups, same command names. The lifecycle mirrors
+``ADAMCommand.scala:43-91``: parse args, optionally enable the metrics
+registry, run, print the timing report on ``-print_metrics``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from adam_tpu.utils import instrumentation as ins
+
+
+class Command:
+    """One CLI subcommand: subclasses set name/description and implement
+    configure/run (ADAMCommandCompanion + ADAMCommand)."""
+
+    name: str = ""
+    description: str = ""
+
+    @classmethod
+    def configure(cls, parser: argparse.ArgumentParser) -> None:
+        pass
+
+    @classmethod
+    def run(cls, args: argparse.Namespace) -> int | None:
+        raise NotImplementedError
+
+
+def add_common_args(parser: argparse.ArgumentParser) -> None:
+    """Args4jBase + ParquetArgs flags shared by every command
+    (Args4j.scala:23-28, ParquetArgs.scala:24-35)."""
+    parser.add_argument(
+        "-print_metrics", action="store_true",
+        help="print metrics to the log on completion",
+    )
+    parser.add_argument(
+        "-parquet_compression_codec", default="snappy",
+        choices=["uncompressed", "snappy", "gzip", "lzo", "zstd"],
+        help="parquet compression codec",
+    )
+    parser.add_argument(
+        "-parquet_block_size", type=int, default=128 * 1024 * 1024,
+        help="parquet block size (accepted for parity; row-group sizing)",
+    )
+    parser.add_argument(
+        "-parquet_page_size", type=int, default=1024 * 1024,
+        help="parquet page size (accepted for parity)",
+    )
+    parser.add_argument(
+        "-parquet_disable_dictionary", action="store_true",
+        help="disable parquet dictionary encoding (accepted for parity)",
+    )
+
+
+def command_groups():
+    from adam_tpu.cli import actions, conversions, printers
+
+    return [
+        ("ADAM ACTIONS", actions.COMMANDS),
+        ("CONVERSION OPERATIONS", conversions.COMMANDS),
+        ("PRINT", printers.COMMANDS),
+    ]
+
+
+def _usage() -> str:
+    out = ["", "Usage: adam-tpu COMMAND", ""]
+    for group, commands in command_groups():
+        out.append(group)
+        for cmd in commands:
+            out.append(f"{cmd.name:>20} : {cmd.description}")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    registry = {c.name: c for _, cmds in command_groups() for c in cmds}
+    if not argv or argv[0] in ("-h", "--help"):
+        print(_usage())
+        return 0
+    name, rest = argv[0], argv[1:]
+    if name not in registry:
+        print(f"unknown command: {name}", file=sys.stderr)
+        print(_usage(), file=sys.stderr)
+        return 1
+    cmd = registry[name]
+    parser = argparse.ArgumentParser(
+        prog=f"adam-tpu {name}", description=cmd.description,
+        # reference flags are single-dash long options (args4j); argparse
+        # prefix matching would make flag typos silently match — disable
+        allow_abbrev=False,
+    )
+    add_common_args(parser)
+    cmd.configure(parser)
+    args = parser.parse_args(rest)
+    ins.TIMERS.recording = bool(args.print_metrics)
+    try:
+        rc = cmd.run(args)
+    finally:
+        if args.print_metrics:
+            print(ins.TIMERS.report())
+    return int(rc or 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,305 @@
+"""PRINT command group (ADAMMain.scala:61-72).
+
+print, print_genes, flagstat, print_tags, listdict, allelecount,
+buildinfo, view.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from adam_tpu.cli.main import Command
+from adam_tpu.formats import schema
+from adam_tpu.utils import instrumentation as ins
+
+
+class PrintAdam(Command):
+    """Print parquet rows (PrintADAM.scala:31-110); -pretty emits
+    indented JSON like the reference's pretty Avro-JSON mode."""
+
+    name = "print"
+    description = "Print an ADAM formatted file"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("files", metavar="FILE(S)", nargs="+")
+        p.add_argument("-o", dest="output", default=None,
+                       help="output to a (local) file")
+        p.add_argument("-pretty", action="store_true",
+                       help="display raw, pretty-formatted JSON")
+
+    @classmethod
+    def run(cls, args):
+        import json
+
+        import pyarrow.parquet as pq
+
+        out = open(args.output, "w") if args.output else sys.stdout
+        try:
+            for path in args.files:
+                table = pq.read_table(path)
+                for row in table.to_pylist():
+                    if args.pretty:
+                        out.write(json.dumps(row, indent=2, default=str) + "\n")
+                    else:
+                        out.write(json.dumps(row, default=str) + "\n")
+        finally:
+            if args.output:
+                out.close()
+        return 0
+
+
+class PrintGenes(Command):
+    """Gene models from a GTF (PrintGenes.scala:28-70; same format)."""
+
+    name = "print_genes"
+    description = ("Load a GTF file containing gene annotations and print "
+                   "the corresponding gene models")
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("gtf", metavar="GTF")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import features as fio
+        from adam_tpu.models.genes import as_genes
+
+        feats = fio.read_features(args.gtf, fmt="gtf")
+        for gene in as_genes(feats):
+            parts = ["Gene %s (%s)" % (gene.id, ",".join(gene.names))]
+            for t in gene.transcripts:
+                parts.append(
+                    "\n\tTranscript %s %s:%d-%d:%s (%d exons)" % (
+                        t.id, t.region.referenceName, t.region.start,
+                        t.region.end, "+" if t.strand else "-", len(t.exons),
+                    )
+                )
+            print("".join(parts))
+        return 0
+
+
+class FlagStat(Command):
+    """samtools-flagstat clone (adam-cli FlagStat.scala:28-60 -> core
+    rdd/read/FlagStat.scala:84-119)."""
+
+    name = "flagstat"
+    description = "Print statistics on reads in an ADAM file (similar to samtools flagstat)"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import context
+        from adam_tpu.ops.flagstat import flagstat, format_flagstat
+
+        kw = {}
+        if str(args.input).endswith((".adam", ".parquet")):
+            kw["projection"] = [
+                "flags", "mapq", "readName", "sequence", "contig", "start",
+                "mateContig", "mateAlignmentStart",
+            ]
+        ds = context.load_alignments(args.input, **kw)
+        with ins.TIMERS.time(ins.FLAGSTAT):
+            failed, passed = flagstat(ds.batch)
+        print(format_flagstat(failed, passed))
+        return 0
+
+
+class PrintTags(Command):
+    """Values/counts of attribute tags (PrintTags.scala:28-75)."""
+
+    name = "print_tags"
+    description = "Prints the values and counts of all tags in a set of records"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT")
+        p.add_argument("-list", dest="list_n", default=None,
+                       help="also list the first N attribute fields")
+        p.add_argument("-count", dest="count", default=None,
+                       help="comma-separated tag names to print values/counts for")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import context
+
+        ds = context.load_alignments(args.input)
+        b = ds.batch.to_numpy()
+        ok = np.asarray(b.valid) & (
+            (np.asarray(b.flags) & schema.FLAG_FAILED_QC) == 0
+        )
+        rows = np.flatnonzero(ok)
+        attrs = [ds.sidecar.attrs[i] for i in rows]
+        if args.list_n is not None:
+            for a in attrs[: int(args.list_n)]:
+                print(a)
+        to_count = set(args.count.split(",")) if args.count else set()
+        tag_counts: dict[str, int] = {}
+        value_counts: dict[str, dict] = {t: {} for t in to_count}
+        for a in attrs:
+            if not a:
+                continue
+            for tag_str in a.split("\t"):
+                name = tag_str.split(":", 1)[0]
+                tag_counts[name] = tag_counts.get(name, 0) + 1
+                if name in to_count:
+                    val = tag_str.split(":", 2)[-1]
+                    value_counts[name][val] = value_counts[name].get(val, 0) + 1
+        for tag, count in sorted(tag_counts.items()):
+            print("%3s\t%d" % (tag, count))
+            if tag in to_count:
+                for value, vc in sorted(value_counts[tag].items()):
+                    print("\t%10d\t%s" % (vc, value))
+        print("Total: %d" % len(rows))
+        return 0
+
+
+class ListDict(Command):
+    """Print the sequence dictionary (ListDict.scala:27-55)."""
+
+    name = "listdict"
+    description = "Print the contents of an ADAM sequence dictionary"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import context
+
+        ds = context.load_alignments(args.input)
+        for rec in ds.seq_dict.records:
+            print("%s\t%d" % (rec.name, rec.length))
+        return 0
+
+
+class AlleleCount(Command):
+    """Allele frequencies per site (AlleleCount.scala:28-80)."""
+
+    name = "allelecount"
+    description = "Calculate Allele frequencies"
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("adam", metavar="ADAM", help="ADAM variant data or VCF")
+        p.add_argument("output", metavar="Output")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.api.datasets import GenotypeDataset
+
+        gt = GenotypeDataset.load(args.adam)
+        with open(args.output, "w") as fh:
+            for chrom, pos, allele, count in gt.allele_count():
+                fh.write("%s\t%s\t%s\t%d\n" % (chrom, pos, allele, count))
+        return 0
+
+
+class BuildInformation(Command):
+    """Build metadata (BuildInformation.scala + git-commit-id parity)."""
+
+    name = "buildinfo"
+    description = "Display build information (use this for bug reports)"
+
+    @classmethod
+    def run(cls, args):
+        import platform
+
+        import jax
+
+        import adam_tpu
+
+        print("adam-tpu version: %s" % adam_tpu.__version__)
+        print("jax version: %s" % jax.__version__)
+        print("python: %s" % platform.python_version())
+        print("backend: %s" % jax.default_backend())
+        return 0
+
+
+class View(Command):
+    """samtools-view clone: -f/-F/-g/-G bit filters, -c count, SAM to
+    stdout (View.scala:28-160)."""
+
+    name = "view"
+    description = "View certain reads from an alignment-record file."
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument("input", metavar="INPUT")
+        p.add_argument("output", metavar="OUTPUT", nargs="?", default=None)
+        p.add_argument("-f", dest="match_all", type=int, default=0,
+                       help="restrict to reads matching ALL bits in N")
+        p.add_argument("-F", dest="mismatch_all", type=int, default=0,
+                       help="restrict to reads matching NONE of the bits in N")
+        p.add_argument("-g", dest="match_some", type=int, default=0,
+                       help="restrict to reads matching ANY of the bits in N")
+        p.add_argument("-G", dest="mismatch_some", type=int, default=0,
+                       help="restrict to reads mismatching at least one bit in N")
+        p.add_argument("-c", dest="print_count", action="store_true",
+                       help="print count of matching records")
+        p.add_argument("-o", dest="output_flag", default=None)
+
+    # the twelve per-bit predicates of View.getFilters (View.scala:103-127);
+    # 0x8 requires the read to be paired, matching the reference's
+    # mate-mapped quirk
+    @staticmethod
+    def _bit_predicate(flags: np.ndarray, bit: int) -> np.ndarray:
+        if bit == 0x8:
+            return ((flags & 0x1) != 0) & ((flags & 0x8) != 0)
+        return (flags & bit) != 0
+
+    @classmethod
+    def _mask(cls, flags: np.ndarray, args) -> np.ndarray:
+        bits = [1 << i for i in range(12)]
+        keep = np.ones(len(flags), bool)
+        for bit in bits:
+            pred = cls._bit_predicate(flags, bit)
+            if args.match_all & bit:
+                keep &= pred
+            if args.mismatch_all & bit:
+                keep &= ~pred
+        for group, want in ((args.match_some, True),
+                            (args.mismatch_some, False)):
+            if group:
+                some = np.zeros(len(flags), bool)
+                for bit in bits:
+                    if group & bit:
+                        some |= cls._bit_predicate(flags, bit) == want
+                keep &= some
+        return keep
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.io import context, sam
+
+        output = args.output or args.output_flag
+        ds = context.load_alignments(args.input)
+        b = ds.batch.to_numpy()
+        keep = cls._mask(np.asarray(b.flags), args) & np.asarray(b.valid)
+        ds = ds.take_rows(np.flatnonzero(keep))
+        if output:
+            ds.save(output)
+        elif args.print_count:
+            print(len(ds))
+        else:
+            for line in sam.format_sam_records(ds.batch, ds.sidecar, ds.header):
+                sys.stdout.write(line + "\n")
+        return 0
+
+
+COMMANDS = [
+    PrintAdam,
+    PrintGenes,
+    FlagStat,
+    PrintTags,
+    ListDict,
+    AlleleCount,
+    BuildInformation,
+    View,
+]

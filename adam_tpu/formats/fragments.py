@@ -105,3 +105,56 @@ class FragmentBatch:
                 f"region {contig_idx}:{start}-{end} not fully covered by fragments"
             )
         return got
+
+
+def flank_fragments(fragments: FragmentBatch, flank: int) -> FragmentBatch:
+    """Extend each fragment with the first ``flank`` bases of its right
+    neighbor on the same contig.
+
+    Host/columnar form of the reference's flanking overlap exchange
+    (rdd/contig/FlankReferenceFragments.scala:26-70,
+    NucleotideContigFragmentRDDFunctions.flankAdjacentFragments:121) that
+    makes k-mers/windows spanning fragment boundaries correct; the
+    device-mesh form of the same idea is
+    :func:`adam_tpu.parallel.dist.halo_exchange_right`.
+    """
+    b = fragments.to_numpy()
+    n = b.n_rows
+    order = np.lexsort(
+        (np.asarray(b.start), np.asarray(b.contig_idx), ~np.asarray(b.valid))
+    )
+    new_len = np.array(b.lengths)
+    fmax = b.fmax
+    ext = {}
+    for j in range(n - 1):
+        i, nxt = order[j], order[j + 1]
+        if not (b.valid[i] and b.valid[nxt]):
+            continue
+        if int(b.contig_idx[i]) != int(b.contig_idx[nxt]):
+            continue
+        # only genome-adjacent fragments exchange flanks; a coordinate gap
+        # (subset batches) must not fabricate sequence across it
+        if int(b.start[nxt]) != int(b.start[i]) + int(b.lengths[i]):
+            continue
+        take = min(flank, int(b.lengths[nxt]))
+        if take <= 0:
+            continue
+        ext[int(i)] = b.bases[nxt][:take]
+        new_len[i] = int(b.lengths[i]) + take
+    width = max(fmax, int(new_len.max(initial=1)))
+    bases = np.full((n, width), schema.BASE_PAD, np.uint8)
+    bases[:, :fmax] = b.bases
+    for i, tail in ext.items():
+        bases[i, int(b.lengths[i]): int(new_len[i])] = tail
+    return b.replace(bases=bases, lengths=new_len)
+
+
+def count_contig_kmers(fragments: FragmentBatch, k: int) -> dict[str, int]:
+    """k-mer counts over contig fragments, boundary-spanning windows
+    included (NucleotideContigFragmentRDDFunctions.countKmers:134)."""
+    from adam_tpu.ops import kmer
+
+    flanked = flank_fragments(fragments, k - 1).to_numpy()
+    return kmer.histogram_to_dict(
+        flanked.bases, flanked.lengths, flanked.valid, k
+    )

@@ -197,7 +197,7 @@ def wigfix_to_bed_lines(lines):
             span = int(m.group(4)) if m.group(4) else span
             continue
         s = line.strip()
-        if not s:
+        if not s or s.startswith(("#", "track", "browser")):
             continue
         float(s)  # raises ValueError on malformed data lines
         yield "\t".join([contig, str(current), str(current + span), "", s])

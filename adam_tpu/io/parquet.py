@@ -238,3 +238,350 @@ def load_alignments(
     ]
     batch, side = pack_reads(records, round_rows_to=round_rows_to)
     return batch, side, header
+
+
+# ===================================================================
+# Variation storage (vcf2adam / adam2vcf round-trip target).
+#
+# The reference saves Genotype/Variant Avro records through the same
+# adamParquetSave path; here the GenotypeDataset persists as a directory
+# with two columnar tables, `variants.parquet` + `genotypes.parquet`,
+# linked by genotype.variantIdx (sites-only VCFs simply have an empty
+# genotype table).
+# ===================================================================
+
+def _seq_dict_meta(seq_dict) -> dict[bytes, bytes]:
+    meta = [
+        {"name": r.name, "length": r.length, "md5": r.md5, "url": r.url}
+        for r in seq_dict
+    ]
+    return {b"adam_tpu.seq_dict": json.dumps(meta).encode()}
+
+
+def _seq_dict_from_meta(meta) -> "SequenceDictionary":
+    if not meta or b"adam_tpu.seq_dict" not in meta:
+        return SequenceDictionary(())
+    return SequenceDictionary(
+        tuple(
+            SequenceRecord(s["name"], s["length"], md5=s.get("md5"),
+                           url=s.get("url"))
+            for s in json.loads(meta[b"adam_tpu.seq_dict"])
+        )
+    )
+
+
+def save_genotypes(path: str, variants, genotypes, seq_dict,
+                   compression: str = "snappy") -> None:
+    import os
+
+    from adam_tpu.formats import variants as vf
+
+    os.makedirs(path, exist_ok=True)
+    vside = variants.sidecar
+    vt = pa.table(
+        {
+            "contig": pa.array(
+                [seq_dict.names[c] for c in variants.contig_idx], pa.string()
+            ),
+            "start": pa.array(variants.start.tolist(), pa.int64()),
+            "end": pa.array(variants.end.tolist(), pa.int64()),
+            "referenceAllele": pa.array(vside.ref_allele, pa.string()),
+            "alternateAllele": pa.array(vside.alt_allele, pa.string()),
+            "qual": pa.array(
+                [None if np.isnan(q) else float(q) for q in variants.qual],
+                pa.float64(),
+            ),
+            "filtersApplied": pa.array(
+                variants.filters_applied.tolist(), pa.bool_()
+            ),
+            "filtersPassed": pa.array(variants.passing.tolist(), pa.bool_()),
+            "name": pa.array(vside.names, pa.string()),
+            "filters": pa.array(vside.filters, pa.list_(pa.string())),
+            "annotations": pa.array(
+                [json.dumps(d) for d in vside.info], pa.string()
+            ),
+        }
+    ).replace_schema_metadata(_seq_dict_meta(seq_dict))
+    pq.write_table(vt, os.path.join(path, "variants.parquet"),
+                   compression=compression)
+
+    gt = pa.table(
+        {
+            "variantIdx": pa.array(genotypes.variant_idx.tolist(), pa.int32()),
+            "sampleId": pa.array(
+                [genotypes.samples[s] for s in genotypes.sample_idx],
+                pa.string(),
+            ),
+            "allele0": pa.array(genotypes.alleles[:, 0].tolist(), pa.int8()),
+            "allele1": pa.array(genotypes.alleles[:, 1].tolist(), pa.int8()),
+            "genotypeQuality": pa.array(genotypes.gq.tolist(), pa.int32()),
+            "readDepth": pa.array(genotypes.dp.tolist(), pa.int32()),
+            "referenceReadDepth": pa.array(
+                genotypes.ref_depth.tolist(), pa.int32()
+            ),
+            "alternateReadDepth": pa.array(
+                genotypes.alt_depth.tolist(), pa.int32()
+            ),
+            "isPhased": pa.array(genotypes.phased.tolist(), pa.bool_()),
+            "genotypeLikelihoods": pa.array(
+                genotypes.pl.tolist(), pa.list_(pa.int32())
+            ),
+            "nonReferenceLikelihoods": pa.array(
+                genotypes.nonref_pl.tolist(), pa.list_(pa.int32())
+            ),
+            "splitFromMultiAllelic": pa.array(
+                genotypes.split_from_multiallelic.tolist(), pa.bool_()
+            ),
+            "genotypeFilters": pa.array(
+                list(genotypes.genotype_filters), pa.string()
+            ),
+        }
+    )
+    pq.write_table(gt, os.path.join(path, "genotypes.parquet"),
+                   compression=compression)
+
+
+def load_genotypes(path: str, contig_names=None):
+    """-> (VariantBatch, GenotypeBatch, SequenceDictionary).
+
+    ``contig_names`` optionally fixes the contig index space (e.g. from a
+    BAM header), as in :func:`adam_tpu.io.vcf.read_vcf`.
+    """
+    import os
+
+    from adam_tpu.formats import variants as vf
+
+    vt = pq.read_table(os.path.join(path, "variants.parquet"))
+    if contig_names is not None:
+        seq_dict = SequenceDictionary(
+            tuple(SequenceRecord(n, 0) for n in contig_names)
+        )
+    else:
+        seq_dict = _seq_dict_from_meta(vt.schema.metadata)
+    name_idx = {n: i for i, n in enumerate(seq_dict.names)}
+    contigs = vt["contig"].to_pylist()
+    for c in contigs:
+        if c not in name_idx:
+            name_idx[c] = len(name_idx)
+    names = [None] * len(name_idx)
+    for n, i in name_idx.items():
+        names[i] = n
+    if len(names) > len(seq_dict.names):
+        seq_dict = SequenceDictionary(
+            tuple(
+                list(seq_dict.records)
+                + [SequenceRecord(n, 0) for n in names[len(seq_dict.names):]]
+            )
+        )
+
+    side = vf.VariantSidecar(
+        ref_allele=vt["referenceAllele"].to_pylist(),
+        alt_allele=vt["alternateAllele"].to_pylist(),
+        names=vt["name"].to_pylist(),
+        filters=vt["filters"].to_pylist(),
+        info=[json.loads(s) for s in vt["annotations"].to_pylist()],
+    )
+    quals = [
+        np.nan if q is None else q for q in vt["qual"].to_pylist()
+    ]
+    variants = vf.VariantBatch(
+        contig_idx=np.array([name_idx[c] for c in contigs], np.int32),
+        start=np.array(vt["start"].to_pylist(), np.int64),
+        end=np.array(vt["end"].to_pylist(), np.int64),
+        ref_len=np.array([len(r) for r in side.ref_allele], np.int32),
+        alt_len=np.array(
+            [len(a) if a else 0 for a in side.alt_allele], np.int32
+        ),
+        qual=np.array(quals, np.float32),
+        filters_applied=np.array(vt["filtersApplied"].to_pylist(), bool),
+        passing=np.array(vt["filtersPassed"].to_pylist(), bool),
+        sidecar=side,
+    )
+
+    gt = pq.read_table(os.path.join(path, "genotypes.parquet"))
+    sample_names = gt["sampleId"].to_pylist()
+    samples: list = []
+    sample_idx = {}
+    si = []
+    for s in sample_names:
+        if s not in sample_idx:
+            sample_idx[s] = len(samples)
+            samples.append(s)
+        si.append(sample_idx[s])
+    m = gt.num_rows
+    genotypes = vf.GenotypeBatch(
+        variant_idx=np.array(gt["variantIdx"].to_pylist(), np.int32),
+        sample_idx=np.array(si, np.int32),
+        alleles=np.stack(
+            [
+                np.array(gt["allele0"].to_pylist(), np.int8),
+                np.array(gt["allele1"].to_pylist(), np.int8),
+            ],
+            axis=1,
+        ) if m else np.zeros((0, 2), np.int8),
+        gq=np.array(gt["genotypeQuality"].to_pylist(), np.int16),
+        dp=np.array(gt["readDepth"].to_pylist(), np.int32),
+        ref_depth=np.array(gt["referenceReadDepth"].to_pylist(), np.int32),
+        alt_depth=np.array(gt["alternateReadDepth"].to_pylist(), np.int32),
+        phased=np.array(gt["isPhased"].to_pylist(), bool),
+        pl=np.array(gt["genotypeLikelihoods"].to_pylist(), np.int32).reshape(m, 3)
+        if m else np.zeros((0, 3), np.int32),
+        nonref_pl=np.array(
+            gt["nonReferenceLikelihoods"].to_pylist(), np.int32
+        ).reshape(m, 3) if m else np.zeros((0, 3), np.int32),
+        split_from_multiallelic=np.array(
+            gt["splitFromMultiAllelic"].to_pylist(), bool
+        ),
+        samples=samples,
+        genotype_filters=gt["genotypeFilters"].to_pylist(),
+    )
+    return variants, genotypes, seq_dict
+
+
+# ===================================================================
+# Feature storage (features2adam target).
+# ===================================================================
+
+def save_features(path: str, feats, compression: str = "snappy") -> None:
+    side = feats.sidecar
+    t = pa.table(
+        {
+            "contig": pa.array(
+                [feats.contig_names[c] for c in feats.contig_idx], pa.string()
+            ),
+            "start": pa.array(feats.start.tolist(), pa.int64()),
+            "end": pa.array(feats.end.tolist(), pa.int64()),
+            "strand": pa.array(feats.strand.tolist(), pa.int8()),
+            "score": pa.array(
+                [None if np.isnan(s) else float(s) for s in feats.score],
+                pa.float64(),
+            ),
+            "featureId": pa.array(side.feature_id, pa.string()),
+            "featureType": pa.array(side.feature_type, pa.string()),
+            "source": pa.array(side.source, pa.string()),
+            "parentIds": pa.array(side.parent_ids, pa.list_(pa.string())),
+            "attributes": pa.array(
+                [json.dumps(d) for d in side.attributes], pa.string()
+            ),
+        }
+    )
+    pq.write_table(t, path, compression=compression)
+
+
+def load_features(path: str):
+    from adam_tpu.formats.features import FeatureBatch, FeatureSidecar
+
+    t = pq.read_table(path)
+    contigs = t["contig"].to_pylist()
+    names: list = []
+    idx = {}
+    ci = []
+    for c in contigs:
+        if c not in idx:
+            idx[c] = len(names)
+            names.append(c)
+        ci.append(idx[c])
+    scores = [np.nan if s is None else s for s in t["score"].to_pylist()]
+    return FeatureBatch(
+        contig_idx=np.array(ci, np.int32),
+        start=np.array(t["start"].to_pylist(), np.int64),
+        end=np.array(t["end"].to_pylist(), np.int64),
+        strand=np.array(t["strand"].to_pylist(), np.int8),
+        score=np.array(scores, np.float32),
+        contig_names=names,
+        sidecar=FeatureSidecar(
+            feature_id=t["featureId"].to_pylist(),
+            feature_type=t["featureType"].to_pylist(),
+            source=t["source"].to_pylist(),
+            parent_ids=t["parentIds"].to_pylist(),
+            attributes=[json.loads(s) for s in t["attributes"].to_pylist()],
+        ),
+    )
+
+
+# ===================================================================
+# Fragment storage (fasta2adam target).
+# ===================================================================
+
+def save_fragments(path: str, fragments, seq_dict,
+                   descriptions=None, compression: str = "snappy") -> None:
+    b = fragments.to_numpy()
+    rows = np.flatnonzero(np.asarray(b.valid))
+    # descriptions: contig_idx -> description; read_fasta hands back a
+    # per-contig list, load_fragments a dict
+    if isinstance(descriptions, (list, tuple)):
+        descriptions = {i: d for i, d in enumerate(descriptions) if d}
+    t = pa.table(
+        {
+            "contig": pa.array(
+                [seq_dict.names[int(b.contig_idx[i])] for i in rows],
+                pa.string(),
+            ),
+            "description": pa.array(
+                [
+                    (descriptions or {}).get(int(b.contig_idx[i]))
+                    for i in rows
+                ],
+                pa.string(),
+            ),
+            "fragmentSequence": pa.array(
+                [
+                    schema.decode_bases(b.bases[i], int(b.lengths[i]))
+                    for i in rows
+                ],
+                pa.string(),
+            ),
+            "fragmentStartPosition": pa.array(
+                [int(b.start[i]) for i in rows], pa.int64()
+            ),
+            "fragmentNumber": pa.array(
+                [int(b.fragment_number[i]) for i in rows], pa.int32()
+            ),
+            "numberOfFragmentsInContig": pa.array(
+                [int(b.num_fragments[i]) for i in rows], pa.int32()
+            ),
+        }
+    ).replace_schema_metadata(_seq_dict_meta(seq_dict))
+    pq.write_table(t, path, compression=compression)
+
+
+def load_fragments(path: str):
+    """-> (FragmentBatch, SequenceDictionary, descriptions dict)."""
+    from adam_tpu.formats.fragments import FragmentBatch
+
+    t = pq.read_table(path)
+    seq_dict = _seq_dict_from_meta(t.schema.metadata)
+    name_idx = {n: i for i, n in enumerate(seq_dict.names)}
+    contigs = t["contig"].to_pylist()
+    # tolerate contigs missing from the metadata dictionary (stripped by
+    # external rewrites) by extending it, as load_genotypes does
+    extra = []
+    for c in contigs:
+        if c not in name_idx:
+            name_idx[c] = len(name_idx)
+            extra.append(SequenceRecord(c, 0))
+    if extra:
+        seq_dict = SequenceDictionary(tuple(list(seq_dict.records) + extra))
+    seqs = t["fragmentSequence"].to_pylist()
+    n = t.num_rows
+    fmax = max((len(s) for s in seqs), default=1)
+    out = FragmentBatch(
+        bases=np.full((n, fmax), schema.BASE_PAD, np.uint8),
+        lengths=np.zeros(n, np.int32),
+        contig_idx=np.zeros(n, np.int32),
+        start=np.array(t["fragmentStartPosition"].to_pylist(), np.int64),
+        fragment_number=np.array(t["fragmentNumber"].to_pylist(), np.int32),
+        num_fragments=np.array(
+            t["numberOfFragmentsInContig"].to_pylist(), np.int32
+        ),
+        valid=np.ones(n, bool),
+    )
+    descriptions = {}
+    descs = t["description"].to_pylist()
+    for i in range(n):
+        out.bases[i, : len(seqs[i])] = schema.encode_bases(seqs[i])
+        out.lengths[i] = len(seqs[i])
+        out.contig_idx[i] = name_idx[contigs[i]]
+        if descs[i]:
+            descriptions[int(out.contig_idx[i])] = descs[i]
+    return out, seq_dict, descriptions

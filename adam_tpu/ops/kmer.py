@@ -82,16 +82,29 @@ def device_kmer_histogram(bases, lengths, valid, k: int):
     return s, run_counts, is_head
 
 
+def histogram_to_dict(bases, lengths, valid, k: int) -> dict[str, int]:
+    """Run the device histogram over any padded base array set and
+    decode the unique (kmer string -> count) table."""
+    import jax.numpy as jnp
+
+    s, run_counts, is_head = device_kmer_histogram(
+        jnp.asarray(bases), jnp.asarray(lengths), jnp.asarray(valid), k
+    )
+    s, run_counts, is_head = (
+        np.asarray(s), np.asarray(run_counts), np.asarray(is_head),
+    )
+    return {
+        unpack_kmer(int(key), k): int(v)
+        for key, v in zip(s[is_head], run_counts[is_head])
+    }
+
+
 def count_kmers(batch: ReadBatch, k: int) -> dict[str, int]:
     """Exact k-mer counts over all reads (sequence strings, N included)."""
     if batch.n_rows == 0:
         return {}
     b = batch.to_device()
-    s, run_counts, is_head = device_kmer_histogram(b.bases, b.lengths, b.valid, k)
-    s, run_counts, is_head = np.asarray(s), np.asarray(run_counts), np.asarray(is_head)
-    keys = s[is_head]
-    vals = run_counts[is_head]
-    return {unpack_kmer(int(key), k): int(v) for key, v in zip(keys, vals)}
+    return histogram_to_dict(b.bases, b.lengths, b.valid, k)
 
 
 @partial(jax.jit, static_argnames=("k",))

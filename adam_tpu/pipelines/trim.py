@@ -92,21 +92,37 @@ def trim_cigar(
     ops: np.ndarray, lens: np.ndarray, n: int, trim_start: int, trim_end: int,
     start: int, end: int,
 ):
-    """Trim a CIGAR, returning (elems, new_start, new_end).
+    """Trim a CIGAR, returning
+    ``(elems, new_start, new_end, aligned_front, aligned_back)``.
 
     Mirrors TrimReads.trimCigar (:255-341): D/N runs hit while trimming
     are excised whole (advancing the reference coordinate by their full
     length); trimmed segments are replaced with hard clips.
+
+    Deviations where the reference silently corrupts records: existing
+    H/P operators consume no read bases, so they never count against the
+    trim budget — leading/trailing hard clips merge into the emitted
+    clip run instead of being decremented like matches.  The returned
+    ``aligned_front``/``aligned_back`` are the number of M/=/X bases
+    actually trimmed from each end — the counts MD trimming needs (MD
+    covers aligned bases only, not soft clips or insertions).
     """
     elems = [(int(lens[i]), int(ops[i])) for i in range(n)]
 
     def trim_front(elems, trim, pos, step):
         out = list(elems)
+        h = 0  # existing hard clips on this end, merged into the new clip
+        aligned = 0
+        while out and out[0][1] == schema.CIGAR_H:
+            h += out.pop(0)[0]
         while trim > 0 and out:
             ln, op = out[0]
             if op in (schema.CIGAR_D, schema.CIGAR_N):
                 out.pop(0)
                 pos += step * ln
+                continue
+            if op in (schema.CIGAR_H, schema.CIGAR_P):
+                out.pop(0)  # consumes no read bases; budget untouched
                 continue
             if ln == 1:
                 out.pop(0)
@@ -114,17 +130,18 @@ def trim_cigar(
                 out[0] = (ln - 1, op)
             if op in (schema.CIGAR_M, schema.CIGAR_EQ, schema.CIGAR_X):
                 pos += step
+                aligned += 1
             trim -= 1
-        return out, pos
+        return out, pos, h, aligned
 
-    elems, start = trim_front(elems, trim_start, start, +1)
-    rev, end = trim_front(elems[::-1], trim_end, end, -1)
+    elems, start, h_front, al_front = trim_front(elems, trim_start, start, +1)
+    rev, end, h_back, al_back = trim_front(elems[::-1], trim_end, end, -1)
     elems = rev[::-1]
-    if trim_start > 0:
-        elems.insert(0, (trim_start, schema.CIGAR_H))
-    if trim_end > 0:
-        elems.append((trim_end, schema.CIGAR_H))
-    return elems, start, end
+    if trim_start + h_front > 0:
+        elems.insert(0, (trim_start + h_front, schema.CIGAR_H))
+    if trim_end + h_back > 0:
+        elems.append((trim_end + h_back, schema.CIGAR_H))
+    return elems, start, end, al_front, al_back
 
 
 def _md_tokens(md: str) -> list:
@@ -260,14 +277,16 @@ def trim_reads(
         i = int(i)
         if cigar_n[i] == 0:
             continue
-        elems, s, e = trim_cigar(
+        elems, s, e, al_front, al_back = trim_cigar(
             cigar_ops[i], cigar_lens[i], int(cigar_n[i]), ts, te,
             int(start[i]), int(end[i]),
         )
         new_elems[i] = elems
         start[i], end[i] = s, e
         if side.md[i] is not None:
-            new_md[i] = trim_md_tag(side.md[i], ts, te)
+            # MD covers aligned bases only — trim it by the number of
+            # M/=/X bases removed, not the raw read-base trim
+            new_md[i] = trim_md_tag(side.md[i], al_front, al_back)
         cmax = max(cmax, len(elems))
     if cmax > b.cmax:
         b = b.widen(b.lmax, cmax)

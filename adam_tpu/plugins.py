@@ -1,0 +1,101 @@
+"""User-plugin API — the ``plugins/`` package of the reference.
+
+Parity surface:
+
+* :class:`AdamPlugin` — the ``ADAMPlugin`` trait
+  (plugins/ADAMPlugin.scala:29-48): an optional column *projection*, an
+  optional row *predicate*, and a ``run`` over the loaded dataset.
+  Columnar recast: the projection is a list of ALIGNMENT_FIELDS names
+  (pushed down into the Parquet read), and the predicate is a
+  vectorized ``ReadBatch -> bool[N]`` mask instead of a per-record
+  closure.
+* :class:`AccessControl` / :class:`EmptyAccessControl` —
+  ``plugins/AccessControl.scala``: a site-policy predicate composed
+  (AND) with the plugin's own, exactly as ``PluginExecutor`` composes
+  them (adam-cli PluginExecutor.scala:98-107).
+* :func:`load_plugin` — the reflective loader
+  (PluginExecutor.scala:68-74), taking ``"pkg.module.ClassName"``.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from adam_tpu.api.datasets import AlignmentDataset
+
+
+class AdamPlugin:
+    """Base class for user plugins over read datasets."""
+
+    #: Optional list of Parquet column names to project (None = all).
+    projection: Optional[Sequence[str]] = None
+
+    def predicate(self, batch) -> Optional[np.ndarray]:
+        """Optional row mask ``bool[N]`` over a ReadBatch (None = keep all)."""
+        return None
+
+    def run(self, ds: AlignmentDataset, args: Sequence[str]):
+        """Body of the plugin; returns any sequence of printable results."""
+        raise NotImplementedError
+
+
+class AccessControl:
+    """Site access policy: a row mask composed with every plugin's own."""
+
+    def predicate(self, batch) -> Optional[np.ndarray]:
+        return None
+
+
+class EmptyAccessControl(AccessControl):
+    """The default allow-everything policy (plugins/EmptyAccessControl.scala)."""
+
+
+def load_plugin(qualname: str, base=AdamPlugin):
+    """Instantiate ``"pkg.module.ClassName"`` and type-check it against
+    ``base`` (the loadPlugin reflection, PluginExecutor.scala:68-74)."""
+    mod_name, _, cls_name = qualname.rpartition(".")
+    if not mod_name:
+        raise ValueError(f"plugin {qualname!r} must be a dotted path")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    if not (isinstance(cls, type) and issubclass(cls, base)):
+        raise TypeError(f"{qualname} is not a {base.__name__}")
+    return cls()
+
+
+def compose_predicates(batch, *sources) -> Optional[np.ndarray]:
+    """AND the non-None predicates of plugin + access control
+    (PluginExecutor.scala:98-107)."""
+    mask = None
+    for src in sources:
+        m = src.predicate(batch)
+        if m is None:
+            continue
+        m = np.asarray(m, bool)
+        mask = m if mask is None else (mask & m)
+    return mask
+
+
+def execute_plugin(
+    plugin: AdamPlugin,
+    input_path: str,
+    plugin_args: Sequence[str] = (),
+    access_control: Optional[AccessControl] = None,
+):
+    """Load (with projection pushdown), filter, run — the PluginExecutor
+    lifecycle (PluginExecutor.scala:88-119)."""
+    from adam_tpu.io import context
+
+    kw = {}
+    if plugin.projection is not None and str(input_path).endswith(
+        (".adam", ".parquet")
+    ):
+        kw["projection"] = list(plugin.projection)
+    ds = context.load_alignments(str(input_path), **kw)
+    ac = access_control or EmptyAccessControl()
+    mask = compose_predicates(ds.batch, ac, plugin)
+    if mask is not None:
+        ds = ds.take_rows(np.flatnonzero(mask & np.asarray(ds.batch.valid)))
+    return plugin.run(ds, list(plugin_args))

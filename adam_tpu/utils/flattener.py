@@ -1,0 +1,49 @@
+"""Nested-schema flattening (util/Flattener.scala + Flatten command).
+
+The reference flattens nested Avro records into dotted-name flat columns
+so SQL engines (Impala) can query them (``Flattener.flattenSchema`` /
+``flattenRecord``). The columnar port works on Arrow tables: struct
+columns expand (recursively) to ``parent__child`` columns — the
+reference uses ``__`` as its separator too (Flattener.scala NAME_SEPARATOR).
+List columns have no flat relational form and are JSON-stringified.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+SEPARATOR = "__"
+
+
+def flatten_table(table: pa.Table) -> pa.Table:
+    # expand struct columns one level at a time until none remain;
+    # pyarrow's Table.flatten already names children parent.child — rename
+    # to the reference's `__` separator afterwards
+    while any(pa.types.is_struct(f.type) for f in table.schema):
+        table = table.flatten()
+        table = table.rename_columns(
+            [c.replace(".", SEPARATOR) for c in table.column_names]
+        )
+    cols, names = [], []
+    for name, col in zip(table.column_names, table.columns):
+        if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+            col = pa.array(
+                [None if v is None else json.dumps(v) for v in col.to_pylist()],
+                pa.string(),
+            )
+        cols.append(col)
+        names.append(name)
+    return pa.table(dict(zip(names, cols)))
+
+
+def flatten_parquet(in_path: str, out_path: str,
+                    compression: str = "snappy") -> None:
+    table = pq.read_table(in_path)
+    meta = table.schema.metadata
+    flat = flatten_table(table)
+    if meta:
+        flat = flat.replace_schema_metadata(meta)
+    pq.write_table(flat, out_path, compression=compression)

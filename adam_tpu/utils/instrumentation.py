@@ -1,0 +1,101 @@
+"""Named-timer registry and metrics report.
+
+The tracing shape of the reference (``instrumentation/Timers.scala:25-81``
++ bdg-utils ``Metrics``): one named timer per pipeline stage / hot loop,
+used as ``with TIMERS.time("Sort Reads"): ...`` wherever the reference
+writes ``SortReads.time { ... }``; the CLI's ``-print_metrics`` prints
+the aggregated table at command end (``ADAMCommand.scala:56-89``).
+
+TPU additions: timers can wrap a ``jax.profiler`` trace
+(:func:`device_trace`) so a stage's XLA execution shows up in xprof, and
+:func:`block` synchronizes device work so wall times mean what they say.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Timer:
+    name: str
+    total_ns: int = 0
+    count: int = 0
+
+    @property
+    def total_s(self) -> float:
+        return self.total_ns / 1e9
+
+
+@dataclass
+class TimerRegistry:
+    timers: dict = field(default_factory=dict)
+    recording: bool = False
+
+    def timer(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    @contextlib.contextmanager
+    def time(self, name: str):
+        if not self.recording:
+            yield
+            return
+        t0 = time.monotonic_ns()
+        try:
+            yield
+        finally:
+            t = self.timer(name)
+            t.total_ns += time.monotonic_ns() - t0
+            t.count += 1
+
+    def reset(self) -> None:
+        self.timers.clear()
+
+    def report(self) -> str:
+        """Aggregated table, longest stages first (the Metrics printout)."""
+        rows = sorted(self.timers.values(), key=lambda t: -t.total_ns)
+        if not rows:
+            return "Timings\n=======\n(no timers recorded)\n"
+        w = max(len(t.name) for t in rows)
+        out = ["Timings", "======="]
+        out.append(f"{'timer'.ljust(w)}  {'count':>7}  {'total s':>10}")
+        for t in rows:
+            out.append(f"{t.name.ljust(w)}  {t.count:>7}  {t.total_s:>10.3f}")
+        return "\n".join(out) + "\n"
+
+
+#: Process-wide registry — the ``object Timers`` analog.
+TIMERS = TimerRegistry()
+
+# Named stages mirroring instrumentation/Timers.scala:25-81 (subset that
+# maps onto this framework's stages; names kept recognizable).
+LOAD_ALIGNMENTS = "Load Alignments"
+SORT_READS = "Sort Reads"
+MARK_DUPLICATES = "Mark Duplicates"
+BQSR = "Base Quality Recalibration"
+REALIGN_INDELS = "Realign Indels"
+TRIM_READS = "Trim Reads"
+FLAGSTAT = "Flag Stat"
+COUNT_KMERS = "Count Kmers"
+SAVE_OUTPUT = "Save Output"
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """jax profiler trace for a stage — the xprof face of the metrics
+    system (the reference's Spark-listener task timings analog)."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def block(x):
+    """Synchronize device values so surrounding timers measure real work."""
+    import jax
+
+    return jax.block_until_ready(x)

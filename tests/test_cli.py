@@ -1,0 +1,265 @@
+"""CLI layer tests — mirror the adam-cli suites (FlagStatTest, ViewSuite,
+FlattenSuite, PluginExecutorSuite, Features2ADAMSuite) plus smoke tests
+for every registered command group."""
+
+import json
+
+import numpy as np
+import pytest
+
+from adam_tpu.cli.main import command_groups, main
+
+
+def run_cli(*argv):
+    return main(list(argv))
+
+
+def test_registry_matches_reference():
+    """Same command names as ADAMMain.scala:30-72."""
+    names = {c.name for _, cmds in command_groups() for c in cmds}
+    assert names == {
+        "depth", "count_kmers", "count_contig_kmers", "transform",
+        "adam2fastq", "plugin", "flatten",
+        "bam2adam", "vcf2adam", "anno2adam", "adam2vcf", "fasta2adam",
+        "features2adam", "wigfix2bed",
+        "print", "print_genes", "flagstat", "print_tags", "listdict",
+        "allelecount", "buildinfo", "view",
+    }
+
+
+def test_usage_banner(capsys):
+    assert run_cli() == 0
+    out = capsys.readouterr().out
+    assert "ADAM ACTIONS" in out and "transform" in out
+
+
+def test_unknown_command():
+    assert run_cli("bogus") == 1
+
+
+def test_transform_roundtrip(ref_resources, tmp_path):
+    src = str(ref_resources / "small.sam")
+    out = str(tmp_path / "small.adam")
+    assert run_cli("transform", src, out) == 0
+    out2 = str(tmp_path / "sorted.sam")
+    assert run_cli("transform", out, out2, "-sort_reads") == 0
+    from adam_tpu.io import context
+
+    ds = context.load_alignments(out2)
+    b = ds.batch.to_numpy()
+    starts = np.asarray(b.start)[np.asarray(b.valid)]
+    assert (np.diff(starts) >= 0).all()
+
+
+def test_transform_markdup_bqsr(ref_resources, tmp_path, capsys):
+    src = str(ref_resources / "bqsr1.sam")
+    out = str(tmp_path / "out.adam")
+    obs = str(tmp_path / "obs.csv")
+    assert run_cli(
+        "transform", src, out,
+        "-recalibrate_base_qualities",
+        "-known_snps", str(ref_resources / "bqsr1.vcf"),
+        "-dump_observations", obs,
+        "-print_metrics",
+    ) == 0
+    assert "Base Quality Recalibration" in capsys.readouterr().out
+    assert open(obs).read().startswith("ReadGroup,")
+
+
+def test_flagstat_command(ref_resources, capsys):
+    assert run_cli("flagstat", str(ref_resources / "reads12.sam")) == 0
+    out = capsys.readouterr().out
+    assert "in total" in out and "200" in out
+
+
+def test_count_kmers(ref_resources, tmp_path, capsys):
+    out = str(tmp_path / "kmers.txt")
+    assert run_cli(
+        "count_kmers", str(ref_resources / "small.sam"), out, "21",
+        "-printHistogram",
+    ) == 0
+    lines = open(out).read().splitlines()
+    assert lines and all(", " in ln for ln in lines)
+
+
+def test_count_contig_kmers(ref_resources, tmp_path):
+    fa = ref_resources / "contigs.fa"
+    if not fa.exists():
+        fa = ref_resources / "artificial.fa"
+    out = str(tmp_path / "kmers.txt")
+    assert run_cli("count_contig_kmers", str(fa), out, "10") == 0
+    assert open(out).read()
+
+
+def test_view_filters(ref_resources, capsys, tmp_path):
+    src = str(ref_resources / "reads12.sam")
+    assert run_cli("view", src, "-c") == 0
+    total = int(capsys.readouterr().out.strip())
+    assert total == 200
+    # -f 16: reads on reverse strand only
+    assert run_cli("view", src, "-f", "16", "-c") == 0
+    rev = int(capsys.readouterr().out.strip())
+    assert run_cli("view", src, "-F", "16", "-c") == 0
+    fwd = int(capsys.readouterr().out.strip())
+    assert rev + fwd == total and 0 < rev < total
+    # SAM to stdout
+    assert run_cli("view", src, "-f", "16") == 0
+    sam_out = capsys.readouterr().out.splitlines()
+    assert len(sam_out) == rev
+    # save filtered output
+    out = str(tmp_path / "rev.sam")
+    assert run_cli("view", src, "-f", "16", "-o", out) == 0
+    from adam_tpu.io import context
+
+    assert len(context.load_alignments(out)) == rev
+
+
+def test_vcf_adam_roundtrip(ref_resources, tmp_path):
+    vcf_in = str(ref_resources / "small.vcf")
+    adam = str(tmp_path / "v.adam")
+    vcf_out = str(tmp_path / "out.vcf")
+    assert run_cli("vcf2adam", vcf_in, adam) == 0
+    assert run_cli("adam2vcf", adam, vcf_out) == 0
+    body = [
+        ln for ln in open(vcf_out).read().splitlines()
+        if not ln.startswith("#")
+    ]
+    orig = [
+        ln for ln in open(vcf_in).read().splitlines()
+        if not ln.startswith("#")
+    ]
+    assert len(body) >= len(orig)  # multi-allelic splits may add rows
+
+
+def test_allelecount(ref_resources, tmp_path):
+    out = str(tmp_path / "ac.txt")
+    assert run_cli("allelecount", str(ref_resources / "small.vcf"), out) == 0
+    rows = [ln.split("\t") for ln in open(out).read().splitlines()]
+    assert rows and all(len(r) == 4 for r in rows)
+
+
+def test_fasta2adam_and_print(ref_resources, tmp_path, capsys):
+    fa = ref_resources / "contigs.fa"
+    if not fa.exists():
+        fa = ref_resources / "artificial.fa"
+    adam = str(tmp_path / "contigs.adam")
+    assert run_cli("fasta2adam", str(fa), adam, "-verbose") == 0
+    capsys.readouterr()
+    assert run_cli("print", adam) == 0
+    lines = capsys.readouterr().out.splitlines()
+    assert lines and json.loads(lines[0])["fragmentSequence"]
+
+
+def test_features2adam_flatten(tmp_path):
+    bed = tmp_path / "x.bed"
+    bed.write_text("chr1\t10\t100\tpeak1\t5.5\t+\nchr2\t20\t40\tpeak2\t.\t-\n")
+    adam = str(tmp_path / "f.adam")
+    flat = str(tmp_path / "f.flat.adam")
+    assert run_cli("features2adam", str(bed), adam) == 0
+    assert run_cli("flatten", adam, flat) == 0
+    import pyarrow.parquet as pq
+
+    t = pq.read_table(flat)
+    assert t.num_rows == 2
+    assert "parentIds" in t.column_names  # JSON-stringified list column
+    from adam_tpu.io import parquet as pio
+
+    feats = pio.load_features(adam)
+    assert len(feats) == 2 and feats.contig_names == ["chr1", "chr2"]
+
+
+def test_wigfix2bed(tmp_path):
+    wig = tmp_path / "x.wigFix"
+    wig.write_text(
+        "fixedStep chrom=chr1 start=100 step=1\n0.5\n0.25\n"
+    )
+    out = str(tmp_path / "x.bed")
+    assert run_cli("wigfix2bed", str(wig), "-o", out) == 0
+    rows = [ln.split("\t") for ln in open(out).read().splitlines()]
+    assert rows[0][:3] == ["chr1", "99", "100"]
+    assert rows[1][:3] == ["chr1", "100", "101"]
+
+
+def test_adam2fastq(ref_resources, tmp_path):
+    src = str(ref_resources / "interleaved_fastq_sample1.ifq")
+    fq1 = str(tmp_path / "r1.fq")
+    fq2 = str(tmp_path / "r2.fq")
+    assert run_cli("adam2fastq", src, fq1, fq2) == 0
+    n1 = len(open(fq1).read().splitlines())
+    n2 = len(open(fq2).read().splitlines())
+    assert n1 == n2 and n1 % 4 == 0 and n1 > 0
+
+
+def test_listdict(ref_resources, capsys):
+    assert run_cli("listdict", str(ref_resources / "reads12.sam")) == 0
+    out = capsys.readouterr().out.splitlines()
+    assert out[0].split("\t")[0] == "1"
+
+
+def test_print_tags(ref_resources, capsys):
+    assert run_cli("print_tags", str(ref_resources / "reads12.sam"),
+                   "-list", "2") == 0
+    out = capsys.readouterr().out
+    assert "Total: 200" in out
+
+
+def test_print_genes(ref_resources, capsys):
+    gtf = ref_resources / "features/Homo_sapiens.GRCh37.75.trun20.gtf"
+    if not gtf.exists():
+        pytest.skip("gtf fixture not in reference tree")
+    assert run_cli("print_genes", str(gtf)) == 0
+    out = capsys.readouterr().out
+    assert "Gene " in out and "Transcript" in out
+
+
+def test_buildinfo(capsys):
+    assert run_cli("buildinfo") == 0
+    assert "adam-tpu version" in capsys.readouterr().out
+
+
+def test_depth(ref_resources, capsys):
+    assert run_cli(
+        "depth", str(ref_resources / "bqsr1.sam"),
+        str(ref_resources / "bqsr1.vcf"),
+    ) == 0
+    out = capsys.readouterr().out.splitlines()
+    assert out[0] == "location\tname\tdepth"
+    assert len(out) > 1
+
+
+def test_bam2adam(ref_resources, tmp_path):
+    src = ref_resources / "reads12.sam"
+    adam = str(tmp_path / "r.adam")
+    assert run_cli("bam2adam", str(src), adam) == 0
+    from adam_tpu.io import context
+
+    assert len(context.load_alignments(adam)) == 200
+
+
+# ------------------------------------------------------------- plugin
+
+from adam_tpu import plugins as P  # noqa: E402
+
+
+class TakeFivePlugin(P.AdamPlugin):
+    """Test plugin: mirrors the reference's Take10Plugin
+    (PluginExecutorSuite)."""
+
+    projection = ["readName", "sequence"]
+
+    def run(self, ds, args):
+        return ds.sidecar.names[:5]
+
+
+def test_plugin_execution(ref_resources, capsys):
+    assert run_cli(
+        "plugin", "tests.test_cli.TakeFivePlugin",
+        str(ref_resources / "reads12.sam"),
+    ) == 0
+    out = capsys.readouterr().out.splitlines()
+    assert len(out) == 5
+
+
+def test_plugin_rejects_non_plugin():
+    with pytest.raises(TypeError):
+        P.load_plugin("tests.test_cli.run_cli")

@@ -13,7 +13,7 @@ from adam_tpu.pipelines import trim
 
 def _trim_cigar_str(cigar, ts, te, start, end):
     ops, lens, n = schema.encode_cigar(cigar, 8)
-    elems, s, e = trim.trim_cigar(ops, lens, n, ts, te, start, end)
+    elems, s, e, _af, _ab = trim.trim_cigar(ops, lens, n, ts, te, start, end)
     return (
         "".join(f"{ln}{schema.CIGAR_CHARS[op]}" for ln, op in elems),
         s,
@@ -137,3 +137,60 @@ def test_trim_api_roundtrip(tmp_path):
     assert ds2.sidecar.trimmed_from_end == [1]
     b = ds2.batch.to_numpy()
     assert int(b.lengths[0]) == 8
+
+
+class TestReviewRegressions:
+    def test_existing_hard_clips_preserved(self):
+        """H consumes no read bases: 5H95M trimmed by 2 gives 7H93M."""
+        assert _trim_cigar_str("5H95M", 2, 0, 100, 195) == ("7H93M", 102, 195)
+        assert _trim_cigar_str("95M5H", 0, 2, 100, 195) == ("93M7H", 100, 193)
+
+    def test_soft_clip_trim_leaves_md_alone(self):
+        """Trimming only soft clips must not touch the MD tag."""
+        ds = _dataset([
+            _read("ACGTACGTACGT", "IIIIIIIIIIII", "2S10M", 50, md="10"),
+        ])
+        t = trim.trim_reads(ds, 2, 0)
+        assert t.sidecar.md[0] == "10"
+        b = t.batch.to_numpy()
+        assert int(b.start[0]) == 50
+        assert (
+            schema.decode_cigar(b.cigar_ops[0], b.cigar_lens[0],
+                                int(b.cigar_n[0]))
+            == "2H10M"
+        )
+
+    def test_aligned_trim_still_trims_md(self):
+        ds = _dataset([
+            _read("ACGTACGTACGT", "IIIIIIIIIIII", "12M", 50, md="12"),
+        ])
+        t = trim.trim_reads(ds, 2, 1)
+        assert t.sidecar.md[0] == "9"
+
+    def test_wigfix_skips_track_and_comment_lines(self):
+        from adam_tpu.io.features import wigfix_to_bed_lines
+
+        rows = list(wigfix_to_bed_lines([
+            "track type=wiggle_0 name=x",
+            "# a comment",
+            "fixedStep chrom=chr1 start=5 step=1",
+            "1.5",
+        ]))
+        assert len(rows) == 1 and rows[0].split("\t")[:3] == ["chr1", "4", "5"]
+
+    def test_flank_fragments_skips_gaps(self):
+        import numpy as np
+
+        from adam_tpu.formats.fragments import (
+            FragmentBatch,
+            count_contig_kmers,
+            flank_fragments,
+        )
+
+        fb = FragmentBatch.from_sequences([(0, "ACGTACGTAA")], 4)
+        # drop the middle fragment -> gap between [0,4) and [8,10)
+        fb = fb.take(np.array([0, 2])).to_numpy()
+        flanked = flank_fragments(fb, 2)
+        assert list(np.asarray(flanked.lengths)) == [4, 2]
+        counts = count_contig_kmers(fb, 3)
+        assert counts == {"ACG": 1, "CGT": 1}  # no fabricated GTA/TAA bridge
