@@ -216,12 +216,29 @@ class GenotypeDataset:
         else:
             from adam_tpu.io import parquet
 
+            ds = self.sorted_by_position() if sort_on_save else self
             parquet.save_genotypes(
-                p, self.variants, self.genotypes, self.seq_dict
+                p, ds.variants, ds.genotypes, ds.seq_dict
             )
 
     def __len__(self) -> int:
         return len(self.variants)
+
+    def sorted_by_position(self) -> "GenotypeDataset":
+        """Order variants by (contig, start) and remap genotype links."""
+        import numpy as np
+
+        order = np.lexsort((self.variants.start, self.variants.contig_idx))
+        inverse = np.empty(len(order), np.int32)
+        inverse[order] = np.arange(len(order), dtype=np.int32)
+        variants = self.variants.take(order)
+        from dataclasses import replace as dc_replace
+
+        genotypes = dc_replace(
+            self.genotypes,
+            variant_idx=inverse[self.genotypes.variant_idx],
+        )
+        return GenotypeDataset(variants, genotypes, self.seq_dict)
 
     @property
     def contig_names(self) -> list:

@@ -12,6 +12,21 @@ from adam_tpu.cli.main import Command
 from adam_tpu.utils import instrumentation as ins
 
 
+def _write_kmer_counts(counts: dict, output: str, print_histogram: bool):
+    """Shared '(kmer, count)' text output + optional count histogram
+    (the saveAsTextFile tail of CountReadKmers/CountContigKmers).
+    k-mer counts stay ints; q-mer weights stay floats."""
+    if print_histogram:
+        hist: dict[int, int] = {}
+        for v in counts.values():
+            hist[int(v)] = hist.get(int(v), 0) + 1
+        for k in sorted(hist):
+            print((k, hist[k]))
+    with open(output, "w") as fh:
+        for kmer, v in counts.items():
+            fh.write(f"{kmer}, {v}\n")
+
+
 class CalculateDepth(Command):
     """Read depth at each variant of a VCF via broadcast region join
     (adam-cli CalculateDepth.scala:41-120)."""
@@ -51,11 +66,14 @@ class CalculateDepth(Command):
         si, _ri = broadcast_region_join(sites, reads)
         depth = np.bincount(si, minlength=len(sites))
         names = gt.variants.sidecar.names
+        # gt.contig_names is the extended space: it includes VCF-only
+        # contigs appended past the read dictionary
+        contig_names = gt.contig_names
         print("location\tname\tdepth")
         order = np.lexsort((gt.variants.start, gt.variants.contig_idx))
         for i in order:
             loc = "%s:%d" % (
-                ds.seq_dict.names[gt.variants.contig_idx[i]],
+                contig_names[gt.variants.contig_idx[i]],
                 int(gt.variants.start[i]),
             )
             print("%20s\t%15s\t% 5d" % (loc, names[i] or ".", int(depth[i])))
@@ -94,17 +112,8 @@ class CountReadKmers(Command):
             if args.countQmers:
                 counts = ds.count_qmers(args.kmer_length)
             else:
-                counts = {k: float(v) for k, v in
-                          ds.count_kmers(args.kmer_length).items()}
-        if args.printHistogram:
-            hist: dict[int, int] = {}
-            for v in counts.values():
-                hist[int(v)] = hist.get(int(v), 0) + 1
-            for k in sorted(hist):
-                print((k, hist[k]))
-        with open(args.output, "w") as fh:
-            for kmer, v in counts.items():
-                fh.write(f"{kmer}, {v}\n")
+                counts = ds.count_kmers(args.kmer_length)
+        _write_kmer_counts(counts, args.output, args.printHistogram)
         return 0
 
 
@@ -133,15 +142,7 @@ class CountContigKmers(Command):
             fragments, _sd, _desc = parquet.load_fragments(args.input)
         with ins.TIMERS.time(ins.COUNT_KMERS):
             counts = count_contig_kmers(fragments, args.kmer_length)
-        if args.printHistogram:
-            hist: dict[int, int] = {}
-            for v in counts.values():
-                hist[v] = hist.get(v, 0) + 1
-            for k in sorted(hist):
-                print((k, hist[k]))
-        with open(args.output, "w") as fh:
-            for kmer, v in counts.items():
-                fh.write(f"{kmer}, {v}\n")
+        _write_kmer_counts(counts, args.output, args.printHistogram)
         return 0
 
 
