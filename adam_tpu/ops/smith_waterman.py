@@ -9,16 +9,26 @@ else terminate) and ``SmithWaterman.maxCoordinates`` (on score ties the
 operand on equality), and the same trackback emission
 (B -> M/M, J -> I in x / D in y, I -> D in x / I in y).
 
-TPU formulation: the O(|x|·|y|) matrix fill runs as a ``lax.scan`` over
-anti-diagonals — each step updates a whole diagonal vector-wide, and the
-pair dimension is ``vmap``-batched, so the chip fills thousands of
-matrices concurrently (the per-read-per-consensus sweep of indel
-realignment).  Trackback is O(|x|+|y|) per pair on the host, reading the
-device-produced move matrix.
+TPU formulation: the O(|x|·|y|) matrix fill runs as an anti-diagonal
+wavefront — each step updates a whole diagonal vector-wide, the pair
+dimension is batched, and the matrices are *kept in diagonal layout*
+``[B, D, lx+1]`` (``matrix[i, j] == diag[i + j, i]``) so no device-side
+gather/transpose is ever paid.  Two interchangeable fills:
+
+* :func:`_sw_fill_pallas` — Pallas TPU kernel: x/y codes and the two
+  rolling diagonals live in VMEM, the y lane is read through a dynamic
+  lane slice of the reversed-padded sequence, one fused VPU step per
+  diagonal (the GCUPS path of BASELINE.md).
+* :func:`_sw_fill_scan` — ``lax.scan`` fallback for CPU/interpret.
+
+Trackback is O(|x|+|y|) per pair on the host, reading the diagonal
+move matrix directly.
 """
 
 from __future__ import annotations
 
+import functools
+import os
 from dataclasses import dataclass
 from functools import partial
 
@@ -32,25 +42,38 @@ MOVE_B = 1  # both (diagonal)
 MOVE_J = 2  # consume x only
 MOVE_I = 3  # consume y only
 
+_LANE = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+# ------------------------------------------------------------- scan fill
+
 
 @partial(jax.jit, static_argnames=("lx", "ly"))
-def _sw_fill_diagonals(
+def _sw_fill_scan(
     x_codes, x_len, y_codes, y_len, w_match, w_mismatch, w_insert, w_delete,
     lx: int, ly: int,
 ):
-    """Fill scoring/move matrices for a batch of pairs.
+    """Diagonal-layout fill via lax.scan.
 
-    x_codes: [B, lx] u8, y_codes: [B, ly] u8 (base codes; equality is the
-    match test, so N==N matches — same as the reference's char equality).
-    Returns (scores [B, lx+1, ly+1] f32, moves [B, lx+1, ly+1] u8).
+    Returns (scores [B, D, lx+1] f32, moves [B, D, lx+1] u8) with
+    ``matrix[b, i, j] = out[b, i + j, i]``.
     """
     B = x_codes.shape[0]
-    D = lx + ly + 1  # number of anti-diagonals of the (lx+1)x(ly+1) matrix
+    D = lx + ly + 1
     ii = jnp.arange(lx + 1)
+    # f32 compute to match the Pallas kernel bit-for-bit (and the TPU VPU)
+    w_match = jnp.float32(w_match)
+    w_mismatch = jnp.float32(w_mismatch)
+    w_insert = jnp.float32(w_insert)
+    w_delete = jnp.float32(w_delete)
 
     def step(carry, d):
         d1, d2 = carry  # diagonals d-1 and d-2, each [B, lx+1] indexed by i
-        jj = d - ii  # column index per lane
+        jj = d - ii
         valid = (
             (ii >= 1)
             & (jj >= 1)
@@ -85,18 +108,166 @@ def _sw_fill_diagonals(
 
     (_, _), (diag_scores, diag_moves) = jax.lax.scan(
         step,
-        (jnp.zeros((B, lx + 1)), jnp.zeros((B, lx + 1))),
+        (
+            jnp.zeros((B, lx + 1), jnp.float32),
+            jnp.zeros((B, lx + 1), jnp.float32),
+        ),
         jnp.arange(D),
     )
-    # diag_scores: [D, B, lx+1]; matrix[b, i, j] = diag[i+j, b, i]
-    jj = jnp.arange(ly + 1)
-    dmat = ii[:, None] + jj[None, :]  # [lx+1, ly+1]
-    scores = diag_scores[dmat, :, ii[:, None]]  # [lx+1, ly+1, B]
-    moves = diag_moves[dmat, :, ii[:, None]]
+    # [D, B, L] -> [B, D, L]
     return (
-        jnp.moveaxis(scores, -1, 0).astype(jnp.float32),
-        jnp.moveaxis(moves, -1, 0),
+        jnp.moveaxis(diag_scores, 0, 1).astype(jnp.float32),
+        jnp.moveaxis(diag_moves, 0, 1),
     )
+
+
+# ----------------------------------------------------------- pallas fill
+
+
+def _sw_kernel(x_ref, ypad_ref, xlen_ref, ylen_ref, score_ref, move_ref,
+               d1_ref, d2_ref, *, lx: int, ly: int, L: int,
+               w_match: float, w_mismatch: float, w_insert: float,
+               w_delete: float):
+    """One batch-tile: fill all D diagonals of TB pairs.
+
+    ypad holds reverse(y) laid out so that the lane window for diagonal d
+    starts at ``lx + ly - d`` (lane i then reads y[d - 1 - i]).
+    """
+    from jax.experimental import pallas as pl
+
+    TB = x_ref.shape[0]
+    D = lx + ly + 1
+    ii = jax.lax.broadcasted_iota(jnp.int32, (TB, L), 1)
+    xlen = xlen_ref[:]  # [TB, 1]
+    ylen = ylen_ref[:]
+    # xc: lane i holds x[i-1] (static shift; lane 0 and lanes past lx are
+    # junk — masked by `valid`, and the -2 pad can never equal ypad's -1)
+    xc = jnp.pad(x_ref[:], ((0, 0), (1, L - 1 - lx)), constant_values=-2)
+    d1_ref[:] = jnp.zeros((TB, L), jnp.float32)
+    d2_ref[:] = jnp.zeros((TB, L), jnp.float32)
+
+    def body(d, _):
+        jj = d - ii
+        valid = (ii >= 1) & (jj >= 1) & (ii <= xlen) & (jj <= ylen)
+        yc = ypad_ref[:, pl.ds(lx + ly - d, L)]
+        sub = jnp.where(xc == yc, w_match, w_mismatch)
+        d1 = d1_ref[:]
+        d2 = d2_ref[:]
+        m = jnp.pad(d2[:, : L - 1], ((0, 0), (1, 0))) + sub
+        dd = jnp.pad(d1[:, : L - 1], ((0, 0), (1, 0))) + w_delete
+        inn = d1 + w_insert
+        take_b = (m >= dd) & (m >= inn) & (m > 0.0)
+        take_j = ~take_b & (dd >= inn) & (dd > 0.0)
+        take_i = ~take_b & ~take_j & (inn > 0.0)
+        score = jnp.where(
+            take_b, m, jnp.where(take_j, dd, jnp.where(take_i, inn, 0.0))
+        )
+        score = jnp.where(valid, score, 0.0)
+        move = jnp.where(
+            take_b,
+            MOVE_B,
+            jnp.where(take_j, MOVE_J, jnp.where(take_i, MOVE_I, MOVE_T)),
+        )
+        move = jnp.where(valid, move, MOVE_T).astype(jnp.int32)
+        score_ref[:, d, :] = score
+        move_ref[:, d, :] = move
+        d2_ref[:] = d1
+        d1_ref[:] = score
+        return 0
+
+    jax.lax.fori_loop(0, D, body, 0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "lx", "ly", "w_match", "w_mismatch", "w_insert", "w_delete",
+        "interpret",
+    ),
+)
+def _sw_fill_pallas(
+    x_codes, x_len, y_codes, y_len, lx: int, ly: int,
+    w_match: float, w_mismatch: float, w_insert: float, w_delete: float,
+    interpret: bool = False,
+):
+    """Pallas wavefront fill; same contract as :func:`_sw_fill_scan`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = x_codes.shape[0]
+    D = lx + ly + 1
+    L = _round_up(lx + 1, _LANE)
+    TB = max(1, min(B, (4 * 1024 * 1024) // (D * L * 8)))  # ~8MB of out tiles
+    Bp = _round_up(B, TB)
+
+    x = jnp.zeros((Bp, lx), jnp.int32).at[:B].set(x_codes.astype(jnp.int32))
+    # ypad[b, lx + ly - 1 - k] = y[b, k]  (reversed y after lx leading pads),
+    # so the window [lx + ly - d, +L) puts y[d - 1 - i] in lane i.
+    ypad = jnp.full((Bp, lx + ly + L), -1, jnp.int32)
+    ypad = ypad.at[:B, lx: lx + ly].set(y_codes[:, ::-1].astype(jnp.int32))
+    xl = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(x_len.astype(jnp.int32))
+    yl = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(y_len.astype(jnp.int32))
+
+    kernel = functools.partial(
+        _sw_kernel, lx=lx, ly=ly, L=L,
+        w_match=w_match, w_mismatch=w_mismatch,
+        w_insert=w_insert, w_delete=w_delete,
+    )
+    scores, moves = pl.pallas_call(
+        kernel,
+        grid=(Bp // TB,),
+        in_specs=[
+            pl.BlockSpec((TB, lx), lambda g: (g, 0)),
+            pl.BlockSpec((TB, lx + ly + L), lambda g: (g, 0)),
+            pl.BlockSpec((TB, 1), lambda g: (g, 0)),
+            pl.BlockSpec((TB, 1), lambda g: (g, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, D, L), lambda g: (g, 0, 0)),
+            pl.BlockSpec((TB, D, L), lambda g: (g, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Bp, D, L), jnp.float32),
+            jax.ShapeDtypeStruct((Bp, D, L), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TB, L), jnp.float32),
+            pltpu.VMEM((TB, L), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, ypad, xl, yl)
+    return scores[:B, :, : lx + 1], moves[:B, :, : lx + 1].astype(jnp.uint8)
+
+
+def _use_pallas() -> bool:
+    mode = os.environ.get("ADAM_TPU_SW_BACKEND", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "scan":
+        return False
+    return jax.default_backend() not in ("cpu",)
+
+
+def sw_fill(x_codes, x_len, y_codes, y_len, w_match, w_mismatch, w_insert,
+            w_delete, lx: int, ly: int):
+    """Diagonal-layout fill, Pallas on accelerators, scan elsewhere."""
+    if _use_pallas():
+        try:
+            return _sw_fill_pallas(
+                jnp.asarray(x_codes), jnp.asarray(x_len),
+                jnp.asarray(y_codes), jnp.asarray(y_len), lx, ly,
+                float(w_match), float(w_mismatch), float(w_insert),
+                float(w_delete),
+            )
+        except Exception:  # pragma: no cover - driver/kernel capability
+            pass
+    return _sw_fill_scan(
+        jnp.asarray(x_codes), jnp.asarray(x_len), jnp.asarray(y_codes),
+        jnp.asarray(y_len), w_match, w_mismatch, w_insert, w_delete, lx, ly,
+    )
+
+
+# ------------------------------------------------------------ trackback
 
 
 @dataclass(frozen=True)
@@ -110,15 +281,25 @@ class SWAlignment:
     score: float
 
 
-def _max_coordinates(score: np.ndarray, x_len: int, y_len: int) -> tuple[int, int]:
-    """Reference tie rule: per-row pick the LAST max column, then across
-    rows pick the LAST row achieving the global max."""
-    sub = score[: x_len + 1, : y_len + 1]
-    flipped = sub[:, ::-1]
-    row_arg = sub.shape[1] - 1 - np.argmax(flipped, axis=1)
-    row_max = sub[np.arange(sub.shape[0]), row_arg]
-    i = sub.shape[0] - 1 - int(np.argmax(row_max[::-1]))
-    return i, int(row_arg[i])
+def _max_coordinates_diag(
+    diag_score: np.ndarray, x_len: int, y_len: int
+) -> tuple[int, int]:
+    """Reference tie rule on the diagonal layout: the global max with the
+    LAST row i winning ties, then the LAST column j (maxCoordinates'
+    right-biased fold)."""
+    L = diag_score.shape[1]
+    ii = np.arange(L)
+    dd = np.arange(diag_score.shape[0])
+    jj = dd[:, None] - ii[None, :]
+    valid = (ii[None, :] <= x_len) & (jj >= 0) & (jj <= y_len)
+    s = np.where(valid, diag_score, -np.inf)
+    best = s.max()
+    cand = np.argwhere(s == best)
+    # lexicographic (i, j) max among candidates
+    i_arr = cand[:, 1]
+    j_arr = cand[:, 0] - cand[:, 1]
+    k = np.lexsort((j_arr, i_arr))[-1]
+    return int(i_arr[k]), int(j_arr[k])
 
 
 def _rnn_to_cigar(ops: list[str]) -> str:
@@ -138,14 +319,14 @@ def _rnn_to_cigar(ops: list[str]) -> str:
 
 
 def _trackback(
-    moves: np.ndarray, score: np.ndarray, x_len: int, y_len: int
+    diag_moves: np.ndarray, diag_score: np.ndarray, x_len: int, y_len: int
 ) -> SWAlignment:
-    i, j = _max_coordinates(score, x_len, y_len)
+    i, j = _max_coordinates_diag(diag_score, x_len, y_len)
     end_i, end_j = i, j
     cx: list[str] = []
     cy: list[str] = []
-    while moves[i, j] != MOVE_T:
-        mv = moves[i, j]
+    while diag_moves[i + j, i] != MOVE_T:
+        mv = diag_moves[i + j, i]
         if mv == MOVE_B:
             cx.append("M")
             cy.append("M")
@@ -166,7 +347,7 @@ def _trackback(
         y_start=j,
         x_end=end_i,
         y_end=end_j,
-        score=float(score[end_i, end_j]),
+        score=float(diag_score[end_i + end_j, end_i]),
     )
 
 
@@ -183,14 +364,10 @@ def smith_waterman_batch(
     """Align each x[i] against y[i]; device fill + host trackback."""
     x_codes = jnp.asarray(x_codes)
     y_codes = jnp.asarray(y_codes)
-    scores, moves = _sw_fill_diagonals(
-        x_codes,
-        jnp.asarray(x_len),
-        y_codes,
-        jnp.asarray(y_len),
+    scores, moves = sw_fill(
+        x_codes, jnp.asarray(x_len), y_codes, jnp.asarray(y_len),
         w_match, w_mismatch, w_insert, w_delete,
-        int(x_codes.shape[1]),
-        int(y_codes.shape[1]),
+        int(x_codes.shape[1]), int(y_codes.shape[1]),
     )
     scores = np.asarray(scores)
     moves = np.asarray(moves)
