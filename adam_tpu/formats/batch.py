@@ -198,45 +198,72 @@ class ReadBatch:
 
 @dataclass
 class ReadSidecar:
-    """Host-side variable-length columns, parallel to ReadBatch rows."""
+    """Host-side variable-length columns, parallel to ReadBatch rows.
 
-    names: list = field(default_factory=list)       # read names
-    attrs: list = field(default_factory=list)       # raw SAM tag strings ("NM:i:0\tAS:i:75")
-    md: list = field(default_factory=list)          # MD tag string or None
-    orig_quals: list = field(default_factory=list)  # OQ or None
+    String fields are stored columnar (:class:`StringColumn`: one flat
+    byte buffer + offsets, Arrow layout) so whole-dataset operations stay
+    vectorized; plain lists are accepted anywhere and normalized on
+    construction.  Element access (``side.md[i]``) returns str/None either
+    way.
+    """
+
+    names: Any = field(default_factory=list)       # read names
+    attrs: Any = field(default_factory=list)       # raw SAM tag strings ("NM:i:0\tAS:i:75")
+    md: Any = field(default_factory=list)          # MD tag string or None
+    orig_quals: Any = field(default_factory=list)  # OQ or None
     # basesTrimmedFromStart/End bookkeeping (AlignmentRecord fields set by
     # TrimReads.trimRead, rdd/read/correction/TrimReads.scala:363-368)
-    trimmed_from_start: list = field(default_factory=list)
-    trimmed_from_end: list = field(default_factory=list)
+    trimmed_from_start: Any = None
+    trimmed_from_end: Any = None
 
     def __post_init__(self):
-        if not self.trimmed_from_start:
-            self.trimmed_from_start = [0] * len(self.names)
-        if not self.trimmed_from_end:
-            self.trimmed_from_end = [0] * len(self.names)
+        from adam_tpu.formats.strings import StringColumn
+
+        self.names = StringColumn.of(self.names)
+        self.attrs = StringColumn.of(self.attrs)
+        self.md = StringColumn.of(self.md)
+        self.orig_quals = StringColumn.of(self.orig_quals)
+        n = len(self.names)
+        if self.trimmed_from_start is None:
+            self.trimmed_from_start = np.zeros(n, np.int32)
+        else:
+            self.trimmed_from_start = np.asarray(
+                self.trimmed_from_start, np.int32
+            )
+        if self.trimmed_from_end is None:
+            self.trimmed_from_end = np.zeros(n, np.int32)
+        else:
+            self.trimmed_from_end = np.asarray(self.trimmed_from_end, np.int32)
 
     def take(self, idx) -> "ReadSidecar":
         idx = np.asarray(idx)
         return ReadSidecar(
-            names=[self.names[i] for i in idx],
-            attrs=[self.attrs[i] for i in idx],
-            md=[self.md[i] for i in idx],
-            orig_quals=[self.orig_quals[i] for i in idx],
-            trimmed_from_start=[self.trimmed_from_start[i] for i in idx],
-            trimmed_from_end=[self.trimmed_from_end[i] for i in idx],
+            names=self.names.take(idx),
+            attrs=self.attrs.take(idx),
+            md=self.md.take(idx),
+            orig_quals=self.orig_quals.take(idx),
+            trimmed_from_start=self.trimmed_from_start[idx],
+            trimmed_from_end=self.trimmed_from_end[idx],
         )
 
     @staticmethod
     def concat(sides: Sequence["ReadSidecar"]) -> "ReadSidecar":
-        out = ReadSidecar()
-        for s in sides:
-            out.names += s.names
-            out.attrs += s.attrs
-            out.md += s.md
-            out.orig_quals += s.orig_quals
-            out.trimmed_from_start += s.trimmed_from_start
-            out.trimmed_from_end += s.trimmed_from_end
-        return out
+        from adam_tpu.formats.strings import StringColumn
+
+        if not sides:
+            return ReadSidecar()
+        return ReadSidecar(
+            names=StringColumn.concat([s.names for s in sides]),
+            attrs=StringColumn.concat([s.attrs for s in sides]),
+            md=StringColumn.concat([s.md for s in sides]),
+            orig_quals=StringColumn.concat([s.orig_quals for s in sides]),
+            trimmed_from_start=np.concatenate(
+                [np.asarray(s.trimmed_from_start, np.int32) for s in sides]
+            ),
+            trimmed_from_end=np.concatenate(
+                [np.asarray(s.trimmed_from_end, np.int32) for s in sides]
+            ),
+        )
 
     def __len__(self) -> int:
         return len(self.names)
@@ -270,7 +297,7 @@ def pack_reads(
 
     b = ReadBatch.empty(nrows, lmax, cmax)
     b = jax.tree.map(np.array, b)  # writable copies
-    side = ReadSidecar()
+    s_names, s_attrs, s_md, s_oq, s_tfs, s_tfe = [], [], [], [], [], []
 
     for i, r in enumerate(records):
         seq = r["seq"] if r["seq"] not in ("*", None) else ""
@@ -304,11 +331,21 @@ def pack_reads(
         b.read_group_idx[i] = r.get("read_group_idx", -1)
         b.valid[i] = True
 
-        side.names.append(r.get("name", ""))
-        side.attrs.append(r.get("attrs", ""))
-        side.md.append(r.get("md"))
-        side.orig_quals.append(r.get("orig_qual"))
-        side.trimmed_from_start.append(r.get("trimmed_from_start", 0))
-        side.trimmed_from_end.append(r.get("trimmed_from_end", 0))
+        s_names.append(r.get("name", ""))
+        s_attrs.append(r.get("attrs", ""))
+        s_md.append(r.get("md"))
+        s_oq.append(r.get("orig_qual"))
+        s_tfs.append(r.get("trimmed_from_start", 0))
+        s_tfe.append(r.get("trimmed_from_end", 0))
 
+    # padding rows keep empty sidecar slots so columns stay row-parallel
+    pad = nrows - n
+    side = ReadSidecar(
+        names=s_names + [""] * pad,
+        attrs=s_attrs + [""] * pad,
+        md=s_md + [None] * pad,
+        orig_quals=s_oq + [None] * pad,
+        trimmed_from_start=np.asarray(s_tfs + [0] * pad, np.int32),
+        trimmed_from_end=np.asarray(s_tfe + [0] * pad, np.int32),
+    )
     return b, side

@@ -75,18 +75,30 @@ class SamHeader:
         return out
 
 
-def _parse_tags(tag_fields: list[str]) -> tuple[str, Optional[str], Optional[str]]:
-    """Split raw SAM tag fields into (other_tags_joined, md, orig_qual)."""
-    md = oq = None
+def _parse_tags(
+    tag_fields: list[str],
+) -> tuple[str, Optional[str], Optional[str], Optional[str]]:
+    """Split raw SAM tag fields into (other_tags, md, orig_qual, rg).
+
+    MD/OQ/RG move to dedicated columns (the reference's
+    mismatchingPositions/origQual/recordGroup* record fields,
+    converters/SAMRecordConverter.scala:103-130) and are re-emitted from
+    those columns on export, so they are stripped from the attribute
+    string here.
+    """
+    md = oq = rg = None
     rest = []
     for f in tag_fields:
         if f.startswith("MD:Z:"):
             md = f[5:]
         elif f.startswith("OQ:Z:"):
             oq = f[5:]
+        elif f.startswith("RG:Z:"):
+            if rg is None:
+                rg = f[5:]
         else:
             rest.append(f)
-    return "\t".join(rest), md, oq
+    return "\t".join(rest), md, oq, rg
 
 
 def iter_sam_records(text_lines: Iterable[str], header: SamHeader) -> Iterator[dict]:
@@ -98,12 +110,8 @@ def iter_sam_records(text_lines: Iterable[str], header: SamHeader) -> Iterator[d
         f = line.rstrip("\n").split("\t")
         qname, flag, rname, pos, mapq, cigar, rnext, pnext, tlen, seq, qual = f[:11]
         flags = int(flag)
-        attrs, md, oq = _parse_tags(f[11:])
-        rg_idx = -1
-        for t in f[11:]:
-            if t.startswith("RG:Z:"):
-                rg_idx = rgd.index_or(t[5:])
-                break
+        attrs, md, oq, rg = _parse_tags(f[11:])
+        rg_idx = rgd.index_or(rg) if rg is not None else -1
         contig_idx = sd.index_or(rname) if rname != "*" else -1
         if rnext == "=":
             mate_contig_idx = contig_idx
@@ -130,13 +138,82 @@ def iter_sam_records(text_lines: Iterable[str], header: SamHeader) -> Iterator[d
         )
 
 
+def _columns_to_batch(
+    out: dict, round_rows_to: int = 1
+) -> tuple[ReadBatch, ReadSidecar]:
+    """Native tokenizer columns -> (ReadBatch, ReadSidecar)."""
+    from adam_tpu.formats.strings import StringColumn
+
+    n = out["n"]
+    if n == 0:
+        return ReadBatch.empty(), ReadSidecar()
+    batch = ReadBatch(
+        bases=out["bases"],
+        quals=out["quals"],
+        lengths=out["lengths"],
+        flags=out["flags"],
+        contig_idx=out["contig_idx"],
+        start=out["start"],
+        end=out["end"],
+        mapq=out["mapq"],
+        cigar_ops=out["cigar_ops"],
+        cigar_lens=out["cigar_lens"],
+        cigar_n=out["cigar_n"],
+        mate_contig_idx=out["mate_contig_idx"],
+        mate_start=out["mate_start"],
+        tlen=out["tlen"],
+        read_group_idx=out["rg_idx"],
+        has_qual=out["has_qual"].astype(bool),
+        valid=np.ones(n, dtype=bool),
+    )
+    side = ReadSidecar(
+        names=StringColumn(out["name_buf"], out["name_off"]),
+        attrs=StringColumn(out["attr_buf"], out["attr_off"]),
+        md=StringColumn(
+            out["md_buf"], out["md_off"], out["md_present"].astype(bool)
+        ),
+        orig_quals=StringColumn(
+            out["oq_buf"], out["oq_off"], out["oq_present"].astype(bool)
+        ),
+    )
+    nrows = ((n + round_rows_to - 1) // round_rows_to) * round_rows_to
+    if nrows != n:
+        batch = batch.pad_rows(nrows)
+        pad = nrows - n
+        side = ReadSidecar.concat(
+            [side, ReadSidecar(names=[""] * pad, attrs=[""] * pad,
+                               md=[None] * pad, orig_quals=[None] * pad)]
+        )
+    return batch, side
+
+
 def read_sam(
     path: str, round_rows_to: int = 1
 ) -> tuple[ReadBatch, ReadSidecar, SamHeader]:
     opener = gzip.open if str(path).endswith(".gz") else open
-    with opener(path, "rt") as fh:
-        lines = fh.read().splitlines()
-    header = SamHeader.parse(l for l in lines if l.startswith("@"))
+    with opener(path, "rb") as fh:
+        data = fh.read()
+    # split the header prefix off without touching the body
+    body_off = 0
+    header_lines = []
+    while body_off < len(data) and data[body_off : body_off + 1] == b"@":
+        nl = data.find(b"\n", body_off)
+        end = nl if nl >= 0 else len(data)
+        header_lines.append(data[body_off:end].decode("utf-8", "replace"))
+        body_off = end + 1
+    header = SamHeader.parse(header_lines)
+
+    from adam_tpu import native
+
+    out = native.tokenize_sam(
+        data, body_off, header.seq_dict.names, header.read_groups.names
+    )
+    if out is not None:
+        batch, side = _columns_to_batch(out, round_rows_to)
+        return batch, side, header
+
+    # pure-Python fallback (same semantics)
+    lines = data.decode("utf-8", "replace").splitlines()
     records = list(iter_sam_records(lines, header))
     batch, side = pack_reads(records, round_rows_to=round_rows_to)
     return batch, side, header
@@ -223,7 +300,16 @@ BGZF_EOF = bytes.fromhex(
 
 
 def bgzf_decompress(data: bytes) -> bytes:
-    """Decode a BGZF container (concatenated gzip members)."""
+    """Decode a BGZF container (concatenated gzip members).
+
+    Uses the native block-parallel decoder when available; plain-gzip
+    fallback handles non-BGZF gzip members too.
+    """
+    from adam_tpu import native
+
+    out = native.bgzf_decompress(data)
+    if out is not None:
+        return out
     return gzip.decompress(data)
 
 
@@ -326,6 +412,13 @@ def read_bam(
             off2 += 4 + l_name + 4
         header.seq_dict = SequenceDictionary(tuple(recs))
 
+    from adam_tpu import native
+
+    nat = native.tokenize_bam(raw, off, header.read_groups.names)
+    if nat is not None:
+        batch, side = _columns_to_batch(nat, round_rows_to)
+        return batch, side, header
+
     records = []
     n = len(raw)
     while off + 4 <= n:
@@ -360,11 +453,8 @@ def read_bam(
             schema.decode_quals(qual_raw) if l_seq and not (qual_raw == 0xFF).all() else "*"
         )
         tag_fields = _parse_bam_tags(rec[p:])
-        attrs, md, oq = _parse_tags(tag_fields)
-        rg_idx = -1
-        for t in tag_fields:
-            if t.startswith("RG:Z:"):
-                rg_idx = header.read_groups.index_or(t[5:])
+        attrs, md, oq, rg = _parse_tags(tag_fields)
+        rg_idx = header.read_groups.index_or(rg) if rg is not None else -1
         records.append(
             dict(
                 name=name,

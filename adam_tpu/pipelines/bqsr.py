@@ -182,7 +182,7 @@ def build_observation_table(
 ) -> ObservationTable:
     b = ds.batch.to_numpy()
     lmax = b.lmax
-    is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar)
+    is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar, need_ref_codes=False)
 
     flags = np.asarray(b.flags)
     read_ok = (
@@ -325,14 +325,24 @@ def recalibrate_base_qualities(
         jnp.asarray(obs.total), jnp.asarray(obs.mismatches), b.lmax,
     )
     # stash original quals in the sidecar (setOrigQual, Recalibrator.scala:36-40)
-    side = ds.sidecar
-    new_oq = list(side.orig_quals)
-    for i in range(b.n_rows):
-        if b.valid[i] and b.has_qual[i] and new_oq[i] is None:
-            new_oq[i] = schema.decode_quals(b.quals[i], int(b.lengths[i]))
+    # — vectorized: encode the pre-recalibration qual matrix as a string
+    # column and merge it into rows that had no OQ yet.
     from dataclasses import replace as dc_replace
 
-    new_side = dc_replace(side, orig_quals=new_oq)
+    from adam_tpu.formats.strings import StringColumn
+
+    side = ds.sidecar
+    old_oq = StringColumn.of(side.orig_quals)
+    set_mask = (
+        np.asarray(b.valid) & np.asarray(b.has_qual) & ~old_oq.valid
+    )
+    qmat = (np.asarray(b.quals) + schema.SANGER_OFFSET).astype(np.uint8)
+    stashed = StringColumn.from_matrix(
+        qmat, np.where(set_mask, np.asarray(b.lengths), 0), set_mask.copy()
+    )
+    new_side = dc_replace(
+        side, orig_quals=StringColumn.where(set_mask, stashed, old_oq)
+    )
     return ds.with_batch(
         b.replace(quals=np.asarray(new_quals)), new_side
     )

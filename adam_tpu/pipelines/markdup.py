@@ -17,10 +17,11 @@ Picard-style semantics matching ``rdd/read/MarkDuplicates.scala:66-128``:
    same left position; unmapped reads are never marked.
 
 TPU formulation: 5' keys and bucket scores are device kernels (fused
-CIGAR walks + masked segment sums); the group-subgroup-argmax cascade
-becomes one lexsort + run-boundary scan over the bucket table (no
-hash shuffles), vectorized in numpy on host today — the same
-sort-and-segment shape the distributed path shards by genome position.
+CIGAR walks + masked segment sums); the group-subgroup-argmax cascade is
+one lexsort + run-boundary scan over the bucket table (no hash
+shuffles), fully vectorized on host — the same sort-and-segment shape
+the distributed path shards by genome position.  No per-read Python
+anywhere.
 """
 
 from __future__ import annotations
@@ -32,6 +33,7 @@ import numpy as np
 from adam_tpu.api.datasets import AlignmentDataset
 from adam_tpu.formats import schema
 from adam_tpu.formats.batch import ReadBatch
+from adam_tpu.formats.strings import StringColumn
 from adam_tpu.ops import cigar as cigar_ops
 
 
@@ -49,16 +51,39 @@ def _device_read_columns(b: ReadBatch):
 
 
 def _bucket_ids(ds: AlignmentDataset) -> tuple[np.ndarray, int]:
-    """(rg, name) -> dense bucket id per row (-1 for invalid rows)."""
+    """(rg, name) -> dense bucket id per row (-1 for invalid rows).
+
+    Vectorized: exact fixed-width-bytes unique over names, combined with
+    the read-group index into one integer key.
+    """
     b = ds.batch.to_numpy()
+    valid = np.asarray(b.valid)
+    names = StringColumn.of(ds.sidecar.names)
+    _, name_inv = names.unique_inverse()
+    rg = np.asarray(b.read_group_idx).astype(np.int64)
+    key = (rg + 1) * (name_inv.max() + 1 if len(name_inv) else 1) + name_inv
+    key = np.where(valid, key, -1)
+    vrows = np.flatnonzero(valid)
+    uniq, inv = np.unique(key[vrows], return_inverse=True)
     ids = np.full(b.n_rows, -1, dtype=np.int64)
-    table: dict[tuple[int, str], int] = {}
-    for i in range(b.n_rows):
-        if not b.valid[i]:
-            continue
-        key = (int(b.read_group_idx[i]), ds.sidecar.names[i])
-        ids[i] = table.setdefault(key, len(table))
-    return ids, len(table)
+    ids[vrows] = inv
+    return ids, len(uniq)
+
+
+def _sequence_hashes(bases: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Deterministic per-read sequence hash (unmapped-read grouping key).
+
+    Polynomial over base codes; identical sequences (incl. length) hash
+    equal — the role of the reference's sequence hashCode key for
+    unplaced pairs (models/ReferencePositionPair.scala:43-51).
+    """
+    n, L = bases.shape
+    rng = np.random.default_rng(0xADA5)
+    w = rng.integers(1, 2**62, size=L, dtype=np.int64) | 1
+    codes = bases.astype(np.int64) + 1
+    h = (codes * w[None, :]).sum(axis=1)
+    h = h ^ (lengths.astype(np.int64) * np.int64(0x9E3779B97F4A7C15))
+    return h & 0x7FFFFFFFFFFFFFFF
 
 
 def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
@@ -82,77 +107,74 @@ def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
     second = (flags & schema.FLAG_SECOND_OF_PAIR) != 0
     reverse = (flags & schema.FLAG_REVERSE) != 0
 
-    # ----- per-bucket left/right keys (ReferencePositionPair.apply) -----
-    # Key encoding: (kind, contig_or_hash, pos, strand); kind 0 = none,
-    # 1 = mapped position, 2 = sequence-keyed (unmapped read).
-    NONE_KEY = (0, 0, 0, 0)
+    # ----- per-row candidate keys (ReferencePositionPair.apply) ---------
+    # Key encoding columns: (kind, contig_or_hash, pos, strand);
+    # kind 0 = none, 1 = mapped position, 2 = sequence-keyed (unmapped).
+    seq_hash = _sequence_hashes(np.asarray(b.bases), np.asarray(b.lengths))
+    row_key = np.zeros((n, 4), dtype=np.int64)
+    row_key[:, 0] = np.where(mapped, 1, 2)
+    row_key[:, 1] = np.where(mapped, np.asarray(b.contig_idx), seq_hash)
+    row_key[:, 2] = np.where(mapped, five_prime, 0)
+    row_key[:, 3] = np.where(mapped, reverse.astype(np.int64), 0)
 
-    def read_key(i) -> tuple[int, int, int, int]:
-        if mapped[i]:
-            return (1, int(b.contig_idx[i]), int(five_prime[i]), int(reverse[i]))
-        seq = schema.decode_bases(b.bases[i], int(b.lengths[i]))
-        return (2, hash(seq) & 0x7FFFFFFFFFFFFFFF, 0, 0)
+    in_bucket = bucket_of >= 0
+    candidate = in_bucket & (((mapped & primary)) | ~mapped)
 
-    # candidate rows per bucket, in row order (primaryMapped ++ unmapped)
-    bucket_first = [[] for _ in range(n_buckets)]
-    bucket_second = [[] for _ in range(n_buckets)]
-    bucket_frag = [[] for _ in range(n_buckets)]
+    # ordering inside a bucket: mapped-primary candidates first, then row
+    # order (the reference's primaryMapped ++ unmapped concatenation)
+    prio = (~mapped).astype(np.int64) * n + np.arange(n, dtype=np.int64)
+    BIG = np.int64(2) * n * n + n
+
+    def first_row(mask: np.ndarray) -> np.ndarray:
+        """Per-bucket row with minimal prio among masked rows (-1 none)."""
+        sel = np.full(n_buckets, BIG, dtype=np.int64)
+        rows = np.flatnonzero(mask)
+        np.minimum.at(sel, bucket_of[rows], prio[rows])
+        out = np.where(sel < BIG, sel % n, -1)
+        return out
+
+    first_sel = first_row(candidate & first)
+    second_sel = first_row(candidate & second)
+    frag_sel = first_row(candidate)
+
+    # bucket score: sum of primary-mapped read scores
     bucket_score = np.zeros(n_buckets, dtype=np.int64)
-    for i in range(n):
-        bid = bucket_of[i]
-        if bid < 0:
-            continue
-        if mapped[i] and primary[i]:
-            bucket_score[bid] += int(read_score[i])
-        candidate = (mapped[i] and primary[i]) or not mapped[i]
-        if not candidate:
-            continue
-        if first[i]:
-            bucket_first[bid].append(i)
-        elif second[i]:
-            bucket_second[bid].append(i)
-        bucket_frag[bid].append(i)  # every candidate (primaryMapped ++ unmapped)
+    sc_rows = np.flatnonzero(in_bucket & valid & mapped & primary)
+    np.add.at(bucket_score, bucket_of[sc_rows], read_score[sc_rows].astype(np.int64))
 
-    left_keys = []
-    right_keys = []
-    for bid in range(n_buckets):
-        # primaryMapped ++ unmapped ordering: mapped-primary candidates first
-        def ordered(rows):
-            return sorted(rows, key=lambda i: (not mapped[i], 0))
+    # library per bucket (library of the first read, in row order)
+    lib_ids = (
+        ds.read_groups.library_ids()
+        if len(ds.read_groups)
+        else np.array([], np.int32)
+    )
+    rgidx = np.asarray(b.read_group_idx)
+    lib_per_row = np.where(
+        rgidx >= 0,
+        lib_ids[np.clip(rgidx, 0, None)] if len(lib_ids) else -1,
+        -1,
+    ).astype(np.int64)
+    lead = first_row(in_bucket)
+    bucket_lib = np.where(lead >= 0, lib_per_row[np.clip(lead, 0, None)], -1)
 
-        firsts = ordered(bucket_first[bid])
-        seconds = ordered(bucket_second[bid])
-        if firsts or seconds:
-            lk = read_key(firsts[0]) if firsts else NONE_KEY
-            rk = read_key(seconds[0]) if seconds else NONE_KEY
-        else:
-            frags = ordered(bucket_frag[bid])
-            lk = read_key(frags[0]) if frags else NONE_KEY
-            rk = NONE_KEY
-        left_keys.append(lk)
-        right_keys.append(rk)
+    # ----- per-bucket left/right keys ----------------------------------
+    NONE = np.zeros(4, dtype=np.int64)
+    has_pair = (first_sel >= 0) | (second_sel >= 0)
+    left_arr = np.zeros((n_buckets, 4), dtype=np.int64)
+    right_arr = np.zeros((n_buckets, 4), dtype=np.int64)
+    lk_rows = np.where(has_pair, first_sel, frag_sel)
+    use_lk = lk_rows >= 0
+    left_arr[use_lk] = row_key[lk_rows[use_lk]]
+    rk_rows = np.where(has_pair, second_sel, -1)
+    use_rk = rk_rows >= 0
+    right_arr[use_rk] = row_key[rk_rows[use_rk]]
 
-    # library per bucket (library of the first read in the bucket)
-    lib_ids = ds.read_groups.library_ids() if len(ds.read_groups) else np.array([], np.int32)
-    bucket_lib = np.full(n_buckets, -1, dtype=np.int64)
-    for i in range(n):
-        bid = bucket_of[i]
-        if bid >= 0 and bucket_lib[bid] == -1:
-            rg = int(b.read_group_idx[i])
-            bucket_lib[bid] = lib_ids[rg] if rg >= 0 else -1
-
-    # ----- group by (library, left), subgroup by right, mark -----
-    left_arr = np.array(left_keys, dtype=np.int64)  # [B, 4]
-    right_arr = np.array(right_keys, dtype=np.int64)
+    # ----- group by (library, left), subgroup by right, mark -----------
     group_order = np.lexsort(
         tuple(right_arr[:, k] for k in range(3, -1, -1))
         + tuple(left_arr[:, k] for k in range(3, -1, -1))
         + (bucket_lib,)
     )
-
-    primary_dup = np.zeros(n_buckets, dtype=bool)
-    secondary_dup = np.zeros(n_buckets, dtype=bool)
-
     go = group_order
     sl = np.concatenate([bucket_lib[go, None], left_arr[go]], axis=1)
     sr = right_arr[go]
@@ -160,30 +182,46 @@ def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
     new_left[1:] = (sl[1:] != sl[:-1]).any(axis=1)
     new_right = new_left.copy()
     new_right[1:] |= (sr[1:] != sr[:-1]).any(axis=1)
-    left_starts = np.flatnonzero(new_left)
-    left_ends = np.append(left_starts[1:], len(go))
-    for s, e in zip(left_starts, left_ends):
-        rows = go[s:e]
-        if left_arr[rows[0], 0] == 0:  # left position None: never duplicates
-            continue
-        sub_starts = np.flatnonzero(new_right[s:e]) + s
-        sub_ends = np.append(sub_starts[1:], e)
-        group_count = len(sub_starts)
-        for ss, se in zip(sub_starts, sub_ends):
-            sub = go[ss:se]
-            group_is_fragments = right_arr[sub[0], 0] == 0
-            only_fragments = group_is_fragments and group_count == 1
-            if only_fragments or not group_is_fragments:
-                # keep the highest score; first wins ties (stable order)
-                best = sub[np.argmax(bucket_score[sub])]
-                primary_dup[sub] = True
-                primary_dup[best] = False
-                secondary_dup[sub] = True
-            else:
-                primary_dup[sub] = True
-                secondary_dup[sub] = True
 
-    # ----- apply to reads -----
+    left_id = np.cumsum(new_left) - 1       # per sorted bucket
+    sub_id = np.cumsum(new_right) - 1
+    n_left = int(left_id[-1]) + 1
+    n_sub = int(sub_id[-1]) + 1
+    sub_starts = np.flatnonzero(new_right)
+    # left group of each subgroup / subgroup count per left group
+    sub_left = left_id[sub_starts]
+    subs_per_left = np.bincount(sub_left, minlength=n_left)
+
+    group_skip = np.zeros(n_left, dtype=bool)
+    group_skip[left_id[new_left]] = sl[new_left, 1] == 0  # left kind None
+
+    sub_is_frag = sr[sub_starts, 0] == 0
+    sub_only_frag = sub_is_frag & (subs_per_left[sub_left] == 1)
+    sub_keep_best = (sub_only_frag | ~sub_is_frag) & ~group_skip[sub_left]
+    sub_mark_all = sub_is_frag & (subs_per_left[sub_left] > 1) & ~group_skip[sub_left]
+
+    # best bucket per subgroup: max score, first (stable order) wins
+    score_sorted = bucket_score[go]
+    max_sc = np.maximum.reduceat(score_sorted, sub_starts)
+    pos = np.arange(len(go), dtype=np.int64)
+    is_max = score_sorted == max_sc[sub_id]
+    first_best = np.full(n_sub, len(go), dtype=np.int64)
+    rows_max = np.flatnonzero(is_max)
+    np.minimum.at(first_best, sub_id[rows_max], pos[rows_max])
+
+    marked_sub = sub_keep_best | sub_mark_all
+    primary_dup_sorted = marked_sub[sub_id]
+    secondary_dup_sorted = primary_dup_sorted.copy()
+    # unmark the best bucket of keep-best subgroups (primaries only)
+    best_pos = first_best[np.flatnonzero(sub_keep_best)]
+    primary_dup_sorted[best_pos] = False
+
+    primary_dup = np.zeros(n_buckets, dtype=bool)
+    secondary_dup = np.zeros(n_buckets, dtype=bool)
+    primary_dup[go] = primary_dup_sorted
+    secondary_dup[go] = secondary_dup_sorted
+
+    # ----- apply to reads ----------------------------------------------
     row_bucket = np.clip(bucket_of, 0, None)
     dup = np.where(
         mapped & primary,

