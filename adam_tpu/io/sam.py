@@ -93,9 +93,8 @@ def _parse_tags(
             md = f[5:]
         elif f.startswith("OQ:Z:"):
             oq = f[5:]
-        elif f.startswith("RG:Z:"):
-            if rg is None:
-                rg = f[5:]
+        elif f.startswith("RG:Z:") and rg is None:
+            rg = f[5:]
         else:
             rest.append(f)
     return "\t".join(rest), md, oq, rg
@@ -112,6 +111,11 @@ def iter_sam_records(text_lines: Iterable[str], header: SamHeader) -> Iterator[d
         flags = int(flag)
         attrs, md, oq, rg = _parse_tags(f[11:])
         rg_idx = rgd.index_or(rg) if rg is not None else -1
+        if rg is not None and rg_idx < 0:
+            # RG naming a group absent from the header: keep the tag in
+            # attrs so round-trip preserves it (rg_idx stays -1).
+            tag = f"RG:Z:{rg}"
+            attrs = f"{attrs}\t{tag}" if attrs else tag
         contig_idx = sd.index_or(rname) if rname != "*" else -1
         if rnext == "=":
             mate_contig_idx = contig_idx
@@ -199,7 +203,10 @@ def read_sam(
     while body_off < len(data) and data[body_off : body_off + 1] == b"@":
         nl = data.find(b"\n", body_off)
         end = nl if nl >= 0 else len(data)
-        header_lines.append(data[body_off:end].decode("utf-8", "replace"))
+        line = data[body_off:end]
+        if line.endswith(b"\r"):
+            line = line[:-1]
+        header_lines.append(line.decode("utf-8", "replace"))
         body_off = end + 1
     header = SamHeader.parse(header_lines)
 
@@ -314,7 +321,15 @@ def bgzf_decompress(data: bytes) -> bytes:
 
 
 def bgzf_compress(data: bytes, block_size: int = 0xFF00) -> bytes:
-    """Encode bytes as BGZF blocks + EOF marker."""
+    """Encode bytes as BGZF blocks + EOF marker.
+
+    Uses the native block-parallel encoder when available.
+    """
+    from adam_tpu import native
+
+    nat = native.bgzf_compress(data, block_size=block_size)
+    if nat is not None:
+        return nat
     out = bytearray()
     for off in range(0, len(data), block_size):
         chunk = data[off : off + block_size]
@@ -455,6 +470,9 @@ def read_bam(
         tag_fields = _parse_bam_tags(rec[p:])
         attrs, md, oq, rg = _parse_tags(tag_fields)
         rg_idx = header.read_groups.index_or(rg) if rg is not None else -1
+        if rg is not None and rg_idx < 0:
+            tag = f"RG:Z:{rg}"
+            attrs = f"{attrs}\t{tag}" if attrs else tag
         records.append(
             dict(
                 name=name,

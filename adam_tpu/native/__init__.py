@@ -151,11 +151,10 @@ def _u8_ptr(a: np.ndarray):
 
 
 def _str_dict(names: Sequence[str]) -> tuple[np.ndarray, np.ndarray]:
-    bufs = [n.encode() for n in names]
-    off = np.zeros(len(bufs) + 1, np.int64)
-    np.cumsum([len(b) for b in bufs], out=off[1:])
-    buf = np.frombuffer(b"".join(bufs), np.uint8) if bufs else np.zeros(0, np.uint8)
-    return buf, off
+    from adam_tpu.formats.strings import StringColumn
+
+    c = StringColumn.from_list(list(names))
+    return c.buf, c.offsets
 
 
 def tokenize_sam(data, body_off: int, contig_names: Sequence[str],
@@ -277,14 +276,16 @@ def bgzf_decompress(data) -> Optional[bytes]:
         lib.bgzf_free(h)
 
 
-def bgzf_compress(data, level: int = 6) -> Optional[bytes]:
+def bgzf_compress(
+    data, level: int = 6, block_size: int = 0xFF00
+) -> Optional[bytes]:
     """Block-parallel BGZF encode (+EOF block); None if unavailable."""
     lib = _lib()
     if lib is None:
         return None
     buf = _as_u8(data)
     n = len(buf)
-    block = 0xFF00
+    block = min(max(1, block_size), 0xFF00)  # BSIZE is a u16 total-size field
     n_blocks = (n + block - 1) // block if n else 0
     cap = n + n_blocks * 64 + n // 512 + 1024
     out = np.empty(cap, np.uint8)

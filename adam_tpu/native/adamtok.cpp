@@ -295,6 +295,7 @@ bool sam_fill_chunk(const uint8_t* buf, SamChunk* c, const Dict& contigs,
     o->md_present[r] = 0;
     o->oq_present[r] = 0;
     int32_t rg = -1;
+    bool rg_seen = false;
     int64_t attr_start = apos;
     const uint8_t* t = tags;
     while (t <= le && t < le) {
@@ -304,6 +305,7 @@ bool sam_fill_chunk(const uint8_t* buf, SamChunk* c, const Dict& contigs,
       size_t tlen_ = size_t(te - t);
       if (tlen_ >= 5 && t[2] == ':' && t[4] == ':') {
         if (t[0] == 'M' && t[1] == 'D' && t[3] == 'Z') {
+          mpos = o->md_off[r];  // duplicate MD: last one wins (overwrite)
           memcpy(o->md_buf + mpos, t + 5, tlen_ - 5);
           mpos += tlen_ - 5;
           o->md_present[r] = 1;
@@ -311,16 +313,22 @@ bool sam_fill_chunk(const uint8_t* buf, SamChunk* c, const Dict& contigs,
           continue;
         }
         if (t[0] == 'O' && t[1] == 'Q' && t[3] == 'Z') {
+          qpos = o->oq_off[r];  // duplicate OQ: last one wins
           memcpy(o->oq_buf + qpos, t + 5, tlen_ - 5);
           qpos += tlen_ - 5;
           o->oq_present[r] = 1;
           t = te + 1;
           continue;
         }
-        if (t[0] == 'R' && t[1] == 'G' && t[3] == 'Z') {
-          if (rg < 0) rg = dict_lookup(rgs, t + 5, tlen_ - 5);
-          t = te + 1;
-          continue;
+        if (t[0] == 'R' && t[1] == 'G' && t[3] == 'Z' && !rg_seen) {
+          // First RG tag becomes the column; an RG naming a group absent
+          // from the header stays in attrs so round-trip preserves it.
+          rg_seen = true;
+          rg = dict_lookup(rgs, t + 5, tlen_ - 5);
+          if (rg >= 0) {
+            t = te + 1;
+            continue;
+          }
         }
       }
       if (apos + int64_t(tlen_) + 1 > acap) return false;
@@ -346,6 +354,7 @@ struct BgzfBlock {
   int64_t comp_len;
   int64_t out_off;
   int64_t out_len;
+  uint32_t crc;       // expected CRC32 of the decompressed payload
 };
 
 struct BgzfHandle {
@@ -395,6 +404,7 @@ int bam_tags_to_text(const uint8_t* t, const uint8_t* te, char* out,
   int64_t w = 0;
   *md_len = -1;
   *oq_len = -1;
+  bool rg_seen = false;
   auto put = [&](const char* s, int64_t len) -> bool {
     if (w + len > cap) return false;
     memcpy(out + w, s, size_t(len));
@@ -414,8 +424,16 @@ int bam_tags_to_text(const uint8_t* t, const uint8_t* te, char* out,
         memcpy(md, t, size_t(len)); *md_len = len;
       } else if (tag0 == 'O' && tag1 == 'Q' && typ == 'Z') {
         memcpy(oq, t, size_t(len)); *oq_len = len;
-      } else if (tag0 == 'R' && tag1 == 'G' && typ == 'Z') {
-        if (*rg < 0) *rg = dict_lookup(rgs, t, size_t(len));
+      } else if (tag0 == 'R' && tag1 == 'G' && typ == 'Z' && !rg_seen) {
+        // First RG tag becomes the column; keep unresolvable RG in attrs.
+        rg_seen = true;
+        *rg = dict_lookup(rgs, t, size_t(len));
+        if (*rg < 0) {
+          if (w) { if (!put("\t", 1)) return -1; }
+          if (!put("RG:Z:", 5) ||
+              !put(reinterpret_cast<const char*>(t), len))
+            return -1;
+        }
       } else {
         if (w) { if (!put("\t", 1)) return -1; }
         int n = snprintf(tmp, sizeof(tmp), "%c%c:%c:", tag0, tag1, typ);
@@ -425,6 +443,13 @@ int bam_tags_to_text(const uint8_t* t, const uint8_t* te, char* out,
       t = z + 1;
       continue;
     }
+    // fixed-width values: verify the bytes exist before reading them
+    int64_t fixed = (typ == 'A' || typ == 'c' || typ == 'C') ? 1
+                    : (typ == 's' || typ == 'S')             ? 2
+                    : (typ == 'i' || typ == 'I' || typ == 'f') ? 4
+                    : (typ == 'B')                            ? 5
+                                                              : -1;
+    if (fixed < 0 || t + fixed > te) return -1;
     if (w) { if (!put("\t", 1)) return -1; }
     int n;
     switch (typ) {
@@ -466,10 +491,16 @@ int bam_tags_to_text(const uint8_t* t, const uint8_t* te, char* out,
         uint32_t cnt;
         memcpy(&cnt, t + 1, 4);
         t += 5;
+        int size;
+        switch (sub) {
+          case 'c': case 'C': size = 1; break;
+          case 's': case 'S': size = 2; break;
+          case 'i': case 'I': case 'f': size = 4; break;
+          default: return -1;  // unknown array subtype
+        }
+        if (t + int64_t(cnt) * size > te) return -1;  // corrupt count
         n = snprintf(tmp, sizeof(tmp), "%c%c:B:%c", tag0, tag1, sub);
         if (!put(tmp, n)) return -1;
-        int size = (sub == 'c' || sub == 'C') ? 1
-                   : (sub == 's' || sub == 'S') ? 2 : 4;
         for (uint32_t k = 0; k < cnt; ++k) {
           const uint8_t* e = t + k * size;
           if (sub == 'f') {
@@ -519,6 +550,8 @@ void* samtok_scan(const uint8_t* buf, int64_t n, int64_t body_off,
   h->buf = buf;
   h->n = n;
   if (nthreads < 1) nthreads = 1;
+  if (body_off < 0) body_off = 0;
+  if (body_off > n) body_off = n;  // header-only file without trailing \n
   // chunk at line boundaries
   std::vector<int64_t> cuts{body_off};
   for (int i = 1; i < nthreads; ++i) {
@@ -641,15 +674,16 @@ void* bgzf_scan(const uint8_t* buf, int64_t n) {
   while (off < n) {
     int64_t bsize = 0;
     int64_t hl = bgzf_block_header(buf + off, n - off, &bsize);
-    if (hl < 0 || off + bsize > n) {
+    if (hl < 0 || bsize < hl + 8 || off + bsize > n) {
       delete h;
       return nullptr;
     }
-    uint32_t isize;
+    uint32_t crc, isize;
+    memcpy(&crc, buf + off + bsize - 8, 4);
     memcpy(&isize, buf + off + bsize - 4, 4);
     if (isize) {
       h->blocks.push_back(
-          {off + hl, bsize - hl - 8, out, int64_t(isize)});
+          {off + hl, bsize - hl - 8, out, int64_t(isize), crc});
       out += isize;
     }
     off += bsize;
@@ -684,7 +718,9 @@ int bgzf_fill(void* vh, uint8_t* out, int nthreads) {
         zs.avail_out = uInt(blk.out_len);
         int rc = inflate(&zs, Z_FINISH);
         inflateEnd(&zs);
-        if (rc != Z_STREAM_END || zs.total_out != uLong(blk.out_len)) {
+        if (rc != Z_STREAM_END || zs.total_out != uLong(blk.out_len) ||
+            uint32_t(crc32(0, out + blk.out_off, uInt(blk.out_len))) !=
+                blk.crc) {
           oks[size_t(t)] = 0;
           return;
         }
@@ -789,18 +825,24 @@ void* bamtok_scan(const uint8_t* buf, int64_t n, int64_t records_off) {
       delete h;
       return nullptr;
     }
-    h->rec_off.push_back(off);
     const uint8_t* rec = buf + off + 4;
     int32_t l_read_name = rec[8];
     uint16_t n_cigar;
     memcpy(&n_cigar, rec + 12, 2);
     int32_t l_seq;
     memcpy(&l_seq, rec + 16, 4);
+    int64_t tag_bin =
+        bs - 32 - l_read_name - 4 * int64_t(n_cigar) - (l_seq + 1) / 2 - l_seq;
+    // Reject malformed records here so bamtok_fill never reads out of
+    // bounds; the caller falls back to the pure-Python parser.
+    if (l_read_name < 1 || l_seq < 0 || tag_bin < 0) {
+      delete h;
+      return nullptr;
+    }
+    h->rec_off.push_back(off);
     h->name_bytes += l_read_name - 1;
     if (l_seq > h->lmax) h->lmax = l_seq;
     if (n_cigar > h->cmax) h->cmax = n_cigar;
-    int64_t tag_bin =
-        bs - 32 - l_read_name - 4 * int64_t(n_cigar) - (l_seq + 1) / 2 - l_seq;
     h->tag_bytes += tag_bin * 6 + 48;
     off += 4 + bs;
   }

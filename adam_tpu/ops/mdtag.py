@@ -374,6 +374,9 @@ def batch_md_arrays(
 
     b = batch.to_numpy()
     N, L = b.bases.shape
+    if N == 0 or b.cigar_ops.shape[1] == 0:
+        ref = np.full((N, L), schema.BASE_PAD, np.uint8) if need_ref_codes else None
+        return np.zeros((N, L), bool), ref, np.zeros(N, bool)
     md_col = StringColumn.of(sidecar.md)
     valid = np.asarray(b.valid)
     has_md = md_col.valid[:N] & valid if len(md_col) >= N else np.zeros(N, bool)

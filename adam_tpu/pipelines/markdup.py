@@ -82,7 +82,7 @@ def _sequence_hashes(bases: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     w = rng.integers(1, 2**62, size=L, dtype=np.int64) | 1
     codes = bases.astype(np.int64) + 1
     h = (codes * w[None, :]).sum(axis=1)
-    h = h ^ (lengths.astype(np.int64) * np.int64(0x9E3779B97F4A7C15))
+    h = h ^ (lengths.astype(np.int64) * np.int64(0x9E3779B97F4A7C15 - (1 << 64)))
     return h & 0x7FFFFFFFFFFFFFFF
 
 
@@ -110,7 +110,14 @@ def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
     # ----- per-row candidate keys (ReferencePositionPair.apply) ---------
     # Key encoding columns: (kind, contig_or_hash, pos, strand);
     # kind 0 = none, 1 = mapped position, 2 = sequence-keyed (unmapped).
-    seq_hash = _sequence_hashes(np.asarray(b.bases), np.asarray(b.lengths))
+    # Only unmapped rows consume the sequence hash — skip the O(N*L)
+    # polynomial for the (typical) mostly-mapped batch.
+    seq_hash = np.zeros(n, dtype=np.int64)
+    um = np.flatnonzero(~mapped)
+    if len(um):
+        seq_hash[um] = _sequence_hashes(
+            np.asarray(b.bases)[um], np.asarray(b.lengths)[um]
+        )
     row_key = np.zeros((n, 4), dtype=np.int64)
     row_key[:, 0] = np.where(mapped, 1, 2)
     row_key[:, 1] = np.where(mapped, np.asarray(b.contig_idx), seq_hash)
@@ -158,7 +165,6 @@ def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
     bucket_lib = np.where(lead >= 0, lib_per_row[np.clip(lead, 0, None)], -1)
 
     # ----- per-bucket left/right keys ----------------------------------
-    NONE = np.zeros(4, dtype=np.int64)
     has_pair = (first_sel >= 0) | (second_sel >= 0)
     left_arr = np.zeros((n_buckets, 4), dtype=np.int64)
     right_arr = np.zeros((n_buckets, 4), dtype=np.int64)

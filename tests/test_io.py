@@ -214,3 +214,86 @@ def test_missing_qual_roundtrip(tmp_path):
     line = [l for l in (tmp_path / "nq.sam").read_text().splitlines()
             if not l.startswith("@")][0]
     assert line.split("\t")[10] == "*"
+
+
+def test_crlf_sam_header(tmp_path):
+    """CRLF line endings must not leak \\r into header names."""
+    p = tmp_path / "crlf.sam"
+    p.write_bytes(
+        b"@HD\tVN:1.6\r\n@SQ\tSN:chr1\tLN:1000\r\n"
+        b"r1\t0\tchr1\t10\t60\t4M\t*\t0\t0\tACGT\tIIII\r\n"
+    )
+    ds = ctx.load_alignments(str(p))
+    assert ds.header.seq_dict.names == ["chr1"]
+    assert np.asarray(ds.batch.contig_idx)[0] == 0
+
+
+def test_unknown_rg_tag_roundtrips(tmp_path):
+    """An RG tag naming a group absent from the header survives save."""
+    p = tmp_path / "ghostrg.sam"
+    p.write_bytes(
+        b"@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000\n"
+        b"r1\t0\tchr1\t10\t60\t4M\t*\t0\t0\tACGT\tIIII\tRG:Z:ghost\tNM:i:0\n"
+    )
+    ds = ctx.load_alignments(str(p))
+    assert np.asarray(ds.batch.read_group_idx)[0] == -1
+    assert "RG:Z:ghost" in ds.sidecar.attrs.to_list()[0]
+    out = tmp_path / "ghostrg_out.sam"
+    ds.save(str(out))
+    body = [l for l in out.read_text().splitlines() if not l.startswith("@")]
+    assert "RG:Z:ghost" in body[0]
+
+
+def test_malformed_bam_no_crash(tmp_path):
+    """A corrupt BAM record must raise/fall back, never crash the process."""
+    import struct
+
+    from adam_tpu import native
+
+    rec = bytearray(32)
+    struct.pack_into("<i", rec, 0, 28)
+    rec[12] = 0  # l_read_name = 0 -> invalid
+    assert native.tokenize_bam(bytes(rec), 0, []) is None
+
+
+def test_corrupt_bgzf_rejected():
+    """Bit-rot in a BGZF payload or a bad BSIZE must not be accepted."""
+    from adam_tpu import native
+    from adam_tpu.io.sam import bgzf_compress
+
+    if native.bgzf_compress(b"") is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    data = b"payload " * 5000
+    enc = bytearray(bgzf_compress(data))
+    enc[30] ^= 0x01  # flip a bit inside the first deflate payload
+    assert native.bgzf_decompress(bytes(enc)) is None  # CRC catches it
+    small = bytearray(bgzf_compress(b"abc"))
+    small[16], small[17] = 19, 0  # BSIZE-1 = 19 -> total 20 < header+trailer
+    assert native.bgzf_decompress(bytes(small)) is None
+
+
+def test_corrupt_bam_array_tag_no_crash():
+    """A B-array tag with a bogus element count must not read OOB."""
+    import struct
+
+    from adam_tpu import native
+
+    body = bytearray()
+    body += struct.pack("<iiBBHHHiiii", -1, -1, 2, 0, 0, 0, 4, 0, -1, -1, 0)
+    body += b"r\x00"
+    body += b"XXBi" + struct.pack("<I", 0x0FFFFFFF)  # count with no elements
+    rec = struct.pack("<i", len(body)) + bytes(body)
+    assert native.tokenize_bam(rec, 0, []) is None
+
+
+def test_duplicate_md_tag_last_wins(tmp_path):
+    """Duplicate MD tags: the last one wins on every parse path."""
+    p = tmp_path / "dupmd.sam"
+    p.write_bytes(
+        b"@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000\n"
+        b"r1\t0\tchr1\t10\t60\t4M\t*\t0\t0\tACGT\tIIII\tMD:Z:4\tMD:Z:2A1\n"
+    )
+    ds = ctx.load_alignments(str(p))
+    assert ds.sidecar.md[0] == "2A1"

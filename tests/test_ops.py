@@ -301,3 +301,51 @@ def test_batch_md_arrays():
     # insertion positions have no reference base
     np.testing.assert_array_equal(ref_codes[1, 2:4], [schema.BASE_PAD] * 2)
     assert has_md.all()
+
+
+def test_batch_md_arrays_matches_oracle():
+    """Differential: vectorized MD path == per-read oracle on tricky MDs."""
+    import numpy as np
+
+    from adam_tpu.formats.batch import pack_reads
+    from adam_tpu.ops.mdtag import batch_md_arrays_reference
+
+    rng = np.random.default_rng(7)
+    recs = []
+    cases = [
+        ("4M", "ACGT", "4"),                    # all match
+        ("4M", "ACGT", "0A3"),                  # leading 0 run
+        ("4M", "ACGT", "3A0"),                  # trailing 0 run
+        ("4M", "ACGT", "1A0C1"),                # adjacent mismatches
+        ("2M2D2M", "ACGT", "2^TT2"),            # deletion
+        ("2S4M", "TTACGT", "2A1"),              # soft clip
+        ("2M3I2M", "ACTTTGT", "1G2"),           # insertion
+        ("1S2M1D2M1S", "AACGTC", "2^G0A1"),     # everything at once
+        ("6M", "ACGTAC", "0A0C0G0T0A0C0"),      # all mismatch
+    ]
+    for i, (cig, seq, md) in enumerate(cases):
+        recs.append(dict(name=f"r{i}", flags=0, contig_idx=0, start=10 + i,
+                         mapq=60, cigar=cig, seq=seq, qual="I" * len(seq),
+                         md=md))
+    # plus random simple reads, some without MD
+    for i in range(40):
+        L = int(rng.integers(3, 12))
+        seq = "".join(rng.choice(list("ACGT"), L))
+        md = None if i % 5 == 0 else str(L)
+        recs.append(dict(name=f"q{i}", flags=0, contig_idx=0, start=i,
+                         mapq=60, cigar=f"{L}M", seq=seq, qual="I" * L,
+                         md=md))
+    b, side = pack_reads(recs)
+    got = batch_md_arrays(b, side)
+    want = batch_md_arrays_reference(b, side)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+    np.testing.assert_array_equal(got[2], want[2])
+
+
+def test_batch_md_arrays_empty_batch():
+    from adam_tpu.formats.batch import ReadBatch, ReadSidecar
+
+    b = ReadBatch.empty()
+    is_mm, ref_codes, has_md = batch_md_arrays(b, ReadSidecar())
+    assert is_mm.shape[0] == 0 and has_md.shape[0] == 0
