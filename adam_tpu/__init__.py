@@ -41,6 +41,21 @@ import jax
 if not os.environ.get("ADAM_TPU_NO_X64"):
     jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: CLI-style invocations pay first-compile
+# once per (kernel, shape) across *processes*, not per run — the analog of
+# the JVM's warmed JIT staying resident in the Spark executor. Opt out with
+# ADAM_TPU_NO_COMPILE_CACHE=1; override location with ADAM_TPU_COMPILE_CACHE.
+if not os.environ.get("ADAM_TPU_NO_COMPILE_CACHE"):
+    _cache_dir = os.environ.get("ADAM_TPU_COMPILE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "adam_tpu", "xla"
+    )
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # unwritable FS — run without the cache
+        pass
+
 __version__ = "0.1.0"
 
 from adam_tpu.formats.batch import ReadBatch  # noqa: E402,F401

@@ -37,6 +37,46 @@ from adam_tpu.formats import schema
 Array = Any  # jnp.ndarray or np.ndarray
 
 
+def grid_rows(n: int, minimum: int = 1024) -> int:
+    """Device-friendly row count: the next power of two, floored at
+    ``minimum``.
+
+    Two reasons to quantize row counts before a device call: (1) the
+    persistent compilation cache then sees a handful of shapes instead of
+    one per input file, and (2) the TPU compiler's gather/scatter
+    strategies have a pathological compile-time hump for mid-size
+    irregular row counts (measured: ~50 s at N=98304 vs ~1.5 s at
+    N=131072 for the same gather); power-of-two rows stay on the fast
+    path.  Padding rows carry valid=False and are masked out by every
+    kernel.
+    """
+    n = max(int(n), 1)
+    g = max(minimum, 1 << (n - 1).bit_length())
+    return g
+
+
+def grid_cols(n: int, mult: int = 32) -> int:
+    """Device-friendly lane count: next multiple of ``mult``.
+
+    Unaligned minor dims also hurt *transfers*: fetching a u8
+    [131072, 100] through the TPU tunnel measured 7.6 MB/s vs 27 MB/s
+    for [131072, 104] (sublane-aligned)."""
+    return _round_up(max(int(n), 1), mult)
+
+
+def pad_rows_np(arr, n: int, fill=0, cols: int | None = None):
+    """Pad a numpy array's leading axis up to ``n`` rows (and, for 2-d
+    arrays when ``cols`` is given, the second axis up to ``cols``) with
+    ``fill``."""
+    arr = np.asarray(arr)
+    extra_rows = n - arr.shape[0]
+    extra_cols = (cols - arr.shape[1]) if (cols is not None and arr.ndim > 1) else 0
+    if extra_rows == 0 and extra_cols == 0:
+        return arr
+    pad_width = [(0, extra_rows), (0, extra_cols)] + [(0, 0)] * (arr.ndim - 2)
+    return np.pad(arr, pad_width[: arr.ndim], constant_values=fill)
+
+
 def _round_up(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
 

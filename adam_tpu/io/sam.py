@@ -327,6 +327,9 @@ def bgzf_compress(data: bytes, block_size: int = 0xFF00) -> bytes:
     """
     from adam_tpu import native
 
+    # BSIZE is a u16 (total block size - 1), so blocks can never exceed
+    # 0x10000 bytes; clamp like the native encoder does
+    block_size = min(max(1, block_size), 0xFF00)
     nat = native.bgzf_compress(data, block_size=block_size)
     if nat is not None:
         return nat

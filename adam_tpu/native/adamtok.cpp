@@ -832,7 +832,7 @@ void* bamtok_scan(const uint8_t* buf, int64_t n, int64_t records_off) {
     int32_t l_seq;
     memcpy(&l_seq, rec + 16, 4);
     int64_t tag_bin =
-        bs - 32 - l_read_name - 4 * int64_t(n_cigar) - (l_seq + 1) / 2 - l_seq;
+        bs - 32 - l_read_name - 4 * int64_t(n_cigar) - (int64_t(l_seq) + 1) / 2 - l_seq;
     // Reject malformed records here so bamtok_fill never reads out of
     // bounds; the caller falls back to the pure-Python parser.
     if (l_read_name < 1 || l_seq < 0 || tag_bin < 0) {
@@ -901,7 +901,7 @@ int bamtok_fill(
       memcpy(&l_seq, rec + 16, 4);
       nb += l_read_name - 1;
       int64_t tag_bin = bs - 32 - l_read_name - 4 * int64_t(n_cigar) -
-                        (l_seq + 1) / 2 - l_seq;
+                        (int64_t(l_seq) + 1) / 2 - l_seq;
       tb += tag_bin * 6 + 48;
     }
   }
@@ -969,7 +969,7 @@ int bamtok_fill(
           brow[k] = LUT.bam_seq[nib];
         }
         lengths[r] = l_seq;
-        p += (l_seq + 1) / 2;
+        p += (int64_t(l_seq) + 1) / 2;
         bool all_ff = l_seq > 0;
         for (int32_t k = 0; k < l_seq; ++k)
           if (p[k] != 0xff) { all_ff = false; break; }

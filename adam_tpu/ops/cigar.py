@@ -9,6 +9,7 @@ the ``[N, C]`` cigar columns, so one XLA fusion covers the whole batch.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from adam_tpu.formats import schema
@@ -99,6 +100,56 @@ def first_real_op(cigar_ops, cigar_n):
     return jnp.where(any_real, got, schema.CIGAR_PAD)
 
 
+def reference_positions_np(cigar_ops, cigar_lens, cigar_n, start, lmax):
+    """Host (numpy) twin of :func:`reference_positions` -> i64[N, lmax].
+
+    Pipelines that need per-base reference positions as a *host-side*
+    filter input (e.g. BQSR's known-SNP masking) use this to avoid
+    round-tripping an int64 [N, L] array through the device — on a
+    tunneled TPU that fetch alone costs more than the whole pass.
+    """
+    import numpy as np
+
+    ops = np.asarray(cigar_ops)
+    lens = np.asarray(cigar_lens).astype(np.int64)
+    n_ops = np.asarray(cigar_n)
+    start = np.asarray(start)
+    N, C = ops.shape
+    if C == 0:
+        return np.full((N, lmax), -1, np.int64)
+    v = (np.arange(C)[None, :] < n_ops[:, None]).astype(np.int64)
+    consumes_q = schema.CIGAR_CONSUMES_QUERY[np.minimum(ops, 15)].astype(np.int64)
+    consumes_r = schema.CIGAR_CONSUMES_REF[np.minimum(ops, 15)].astype(np.int64)
+    qlen = lens * consumes_q * v
+    rlen = lens * consumes_r * v
+    q_end = np.cumsum(qlen, axis=1)
+    q0 = q_end - qlen
+    r0 = np.cumsum(rlen, axis=1) - rlen
+    aligned = (consumes_q * consumes_r * v).astype(bool)
+
+    j = np.arange(lmax, dtype=np.int64)
+    # first op whose query span ends after j: vectorized binary search
+    # (side='right') over the non-decreasing q_end lanes, [N, L] working set
+    lo = np.zeros((N, lmax), np.int64)
+    hi = np.full((N, lmax), C, np.int64)
+    while (lo < hi).any():
+        mid = (lo + hi) // 2
+        ge = np.take_along_axis(q_end, np.minimum(mid, C - 1), axis=1) <= j[None, :]
+        adv = lo < hi
+        lo = np.where(adv & ge, mid + 1, lo)
+        hi = np.where(adv & ~ge, mid, hi)
+    op_idx = lo
+    in_read = op_idx < C
+    op_clip = np.minimum(op_idx, C - 1)
+    hit = np.take_along_axis(aligned, op_clip, axis=1) & in_read
+    pos = (
+        start[:, None]
+        + np.take_along_axis(r0, op_clip, axis=1)
+        + (j[None, :] - np.take_along_axis(q0, op_clip, axis=1))
+    )
+    return np.where(hit, pos, -1)
+
+
 def reference_positions(cigar_ops, cigar_lens, cigar_n, start, lmax):
     """Per-base reference position for each read -> i64[N, lmax].
 
@@ -106,22 +157,32 @@ def reference_positions(cigar_ops, cigar_lens, cigar_n, start, lmax):
     and for padding lanes — the role of
     RichAlignmentRecord.referencePositions (:200-229).
 
-    Implemented as a scan-free gather: for each cigar op we know the query
-    span [q0, q1) and the reference offset at q0; a base at query index j
-    inside an M/=/X op maps to start + refoff + (j - q0).
+    Implemented as a per-base binary search over the cigar's cumulative
+    query spans (searchsorted over the [C] lane axis), so the working set
+    stays [N, L] — no [N, C, L] blow-up, and the fusion compiles in
+    milliseconds even under x64.
     """
     consumes_q = _op_table(schema.CIGAR_CONSUMES_QUERY)[cigar_ops]
     consumes_r = _op_table(schema.CIGAR_CONSUMES_REF)[cigar_ops]
     v = _valid_mask(cigar_ops, cigar_n).astype(jnp.int64)
     qlen = cigar_lens * consumes_q * v  # query span per op
     rlen = cigar_lens * consumes_r * v
-    q0 = jnp.cumsum(qlen, axis=-1) - qlen  # query offset at op start
+    q_end = jnp.cumsum(qlen, axis=-1)  # query offset at op end
+    q0 = q_end - qlen
     r0 = jnp.cumsum(rlen, axis=-1) - rlen  # ref offset at op start
     aligned = (consumes_q * consumes_r * v).astype(bool)  # M/=/X
 
-    j = jnp.arange(lmax)[None, None, :]  # [1, 1, L]
-    in_op = (j >= q0[..., None]) & (j < (q0 + qlen)[..., None]) & aligned[..., None]
-    pos = start[..., None, None] + r0[..., None] + (j - q0[..., None])
-    out = jnp.sum(jnp.where(in_op, pos, 0), axis=-2)
-    hit = jnp.any(in_op, axis=-2)
-    return jnp.where(hit, out, -1)
+    j = jnp.arange(lmax, dtype=q_end.dtype)  # [L]
+    # first op whose query span ends after j (ops with qlen==0 share q_end
+    # with their predecessor, so side='right' skips them)
+    op_idx = jax.vmap(lambda qe: jnp.searchsorted(qe, j, side="right"))(q_end)
+    C = cigar_ops.shape[-1]
+    in_read = op_idx < C
+    op_idx = jnp.minimum(op_idx, C - 1)
+    hit = jnp.take_along_axis(aligned, op_idx, axis=-1) & in_read
+    pos = (
+        start[..., None]
+        + jnp.take_along_axis(r0, op_idx, axis=-1)
+        + (j[None, :] - jnp.take_along_axis(q0, op_idx, axis=-1))
+    )
+    return jnp.where(hit, pos, -1)

@@ -177,9 +177,13 @@ class ObservationTable:
         return "\n".join(lines)
 
 
-def build_observation_table(
+def _observe_device(
     ds: AlignmentDataset, known_snps: Optional[SnpTable] = None
-) -> ObservationTable:
+):
+    """Run the observation pass; returns (total, mism) left ON DEVICE plus
+    (rg_names, lmax).  Host work is only mask-building; the histograms are
+    fetched lazily by callers that need them host-side (CSV dump), so the
+    recalibration pass can consume them without a device round-trip."""
     b = ds.batch.to_numpy()
     lmax = b.lmax
     is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar, need_ref_codes=False)
@@ -197,12 +201,11 @@ def build_observation_table(
         & has_md
     )
 
-    # residue filter: q>0, ACGT base, aligned to reference, not a known SNP
-    ref_pos = np.asarray(
-        cigar_ops.reference_positions(
-            jnp.asarray(b.cigar_ops), jnp.asarray(b.cigar_lens),
-            jnp.asarray(b.cigar_n), jnp.asarray(b.start), lmax,
-        )
+    # residue filter: q>0, ACGT base, aligned to reference, not a known SNP.
+    # Positions are computed host-side: they only feed host filters, and an
+    # int64 [N, L] device fetch would dwarf the pass on a tunneled TPU.
+    ref_pos = cigar_ops.reference_positions_np(
+        b.cigar_ops, b.cigar_lens, b.cigar_n, b.start, lmax
     )
     has_ref = ref_pos >= 0
     quals = np.asarray(b.quals)
@@ -215,30 +218,58 @@ def build_observation_table(
 
     # one extra bin for RG-less reads (the reference's null readGroup)
     n_rg = len(ds.read_groups) + 1
+    # grid-pad rows+lanes so the device sees a cache-stable, aligned
+    # shape; the padded rows have read_ok=False so they contribute nothing
+    from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+
+    g = grid_rows(b.n_rows)
+    gl = grid_cols(lmax)
+    # keep the padded device arrays around so the recalibration pass can
+    # reuse them instead of paying the host->device transfer twice
+    dev = {
+        "bases": jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
+        "quals": jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+        "lengths": jnp.asarray(pad_rows_np(b.lengths, g, 0)),
+        "flags": jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+        "read_group_idx": jnp.asarray(pad_rows_np(b.read_group_idx, g, -1)),
+    }
     total, mism = observe_kernel(
-        jnp.asarray(b.bases), jnp.asarray(b.quals), jnp.asarray(b.lengths),
-        jnp.asarray(flags), jnp.asarray(b.read_group_idx),
-        jnp.asarray(residue_ok), jnp.asarray(is_mm), jnp.asarray(read_ok),
-        n_rg, lmax,
+        dev["bases"], dev["quals"], dev["lengths"],
+        dev["flags"], dev["read_group_idx"],
+        jnp.asarray(pad_rows_np(residue_ok, g, False, cols=gl)),
+        jnp.asarray(pad_rows_np(is_mm, g, False, cols=gl)),
+        jnp.asarray(pad_rows_np(read_ok, g, False)),
+        n_rg, gl,
     )
     rg_names = ds.read_groups.names + ["null"]
+    return total, mism, rg_names, gl, dev
+
+
+def build_observation_table(
+    ds: AlignmentDataset, known_snps: Optional[SnpTable] = None
+) -> ObservationTable:
+    total, mism, rg_names, lmax, _ = _observe_device(ds, known_snps)
     return ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
 
 
 # --------------------------------------------------------------------------
 # Recalibration pass
 # --------------------------------------------------------------------------
-@partial(jax.jit, static_argnames=("lmax",))
-def recalibrate_kernel(
-    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
-    total, mismatches, lmax: int,
-):
-    """Apply the log-space delta stack to every residue -> new quals u8[N, L].
+@jax.jit
+def recalibration_phred_table(total, mismatches):
+    """Materialize the recalibrated quality for every covariate combination
+    -> i32[RG, Q, C, D].
 
-    Table semantics (Recalibrator.scala:79-127): with E = empirical error
-    (Bayes (1+mm)/(2+total)) and offsets accumulating residue logP +
-    previous deltas, missing entries (total==0) contribute delta 0; the
-    per-cycle and per-dinuc deltas share the same offset.
+    The log-space delta stack (Recalibrator.scala:79-127) is a pure
+    function of the covariate key, so it is evaluated once per *table
+    cell* rather than per residue — the device analog of the reference
+    building a RecalibrationTable on the driver and applying it as a
+    lookup.  With E = empirical error (Bayes (1+mm)/(2+total)) and offsets
+    accumulating residue logP + previous deltas, missing entries
+    (total==0) contribute delta 0; the per-cycle and per-dinuc deltas
+    share the same offset.  All transcendentals live on table shapes
+    (~1e6 cells), which keeps the x64 XLA fusion tiny — compiling the old
+    per-residue [N, L] f64 log stack took minutes on CPU.
     """
     err = jnp.asarray(PHRED_TO_ERROR)
 
@@ -249,14 +280,64 @@ def recalibrate_kernel(
     g_t = total.sum(axis=(1, 2, 3))  # [RG]
     g_m = mismatches.sum(axis=(1, 2, 3))
     q_levels = jnp.arange(N_QUAL)
-    exp_by_q = err[q_levels][None, :] * total.sum(axis=(2, 3))  # [RG, Q]
-    g_exp = exp_by_q.sum(axis=1)  # [RG] expected mismatches
     q_t = total.sum(axis=(2, 3))  # [RG, Q]
     q_m = mismatches.sum(axis=(2, 3))
+    g_exp = (err[q_levels][None, :] * q_t).sum(axis=1)  # [RG] expected mismatches
     c_t = total.sum(axis=3)  # [RG, Q, C]
     c_m = mismatches.sum(axis=3)
     d_t = total.sum(axis=2)  # [RG, Q, D]
     d_m = mismatches.sum(axis=2)
+
+    residue_logp = jnp.log(err[q_levels])  # [Q]
+
+    g_present = g_t > 0  # [RG]
+    global_delta = jnp.where(
+        g_present,
+        emp_log(g_t, g_m) - jnp.log(g_exp / jnp.maximum(g_t, 1)),
+        0.0,
+    )
+
+    q_present = g_present[:, None] & (q_t > 0)  # [RG, Q]
+    offset1 = residue_logp[None, :] + global_delta[:, None]  # [RG, Q]
+    quality_delta = jnp.where(q_present, emp_log(q_t, q_m) - offset1, 0.0)
+
+    offset2 = offset1 + quality_delta  # [RG, Q]
+    cyc_delta = jnp.where(
+        q_present[:, :, None] & (c_t > 0),
+        emp_log(c_t, c_m) - offset2[:, :, None],
+        0.0,
+    )
+    din_delta = jnp.where(
+        q_present[:, :, None] & (d_t > 0),
+        emp_log(d_t, d_m) - offset2[:, :, None],
+        0.0,
+    )
+
+    log_p = (
+        offset2[:, :, None, None]
+        + cyc_delta[:, :, :, None]
+        + din_delta[:, :, None, :]
+    )
+    max_logp = jnp.log(err[MAX_QUAL])
+    bounded = jnp.minimum(0.0, jnp.maximum(max_logp, log_p))
+    # QualityScore.fromErrorProbability(exp(boundedLogP)) — shared rounding
+    from adam_tpu.ops.phred import error_probability_to_phred
+
+    return error_probability_to_phred(jnp.exp(bounded))
+
+
+@partial(jax.jit, static_argnames=("lmax",))
+def recalibrate_kernel(
+    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+    total, mismatches, lmax: int,
+):
+    """Apply the recalibration table to every residue -> new quals u8[N, L].
+
+    Per-residue work is a single 4-d table gather keyed on
+    (rg, reported qual, cycle, dinuc) plus the apply-mask
+    (minAcceptableQuality Q5 floor, BaseQualityRecalibration.scala:50).
+    """
+    phred_table = recalibration_phred_table(total, mismatches)
 
     n_rg = total.shape[0]
     # RG-less reads use the dedicated last bin, symmetric with observe
@@ -265,37 +346,7 @@ def recalibrate_kernel(
     cycles = compute_cycles(lengths, flags, lmax) + lmax
     dinucs = compute_dinucs(bases, lengths, flags, lmax)
 
-    residue_logp = jnp.log(err[q])
-
-    gt = g_t[rg][:, None] * jnp.ones_like(q)  # broadcast [N, L]
-    gm = g_m[rg][:, None] * jnp.ones_like(q)
-    gexp = g_exp[rg][:, None] * jnp.ones_like(residue_logp)
-    g_present = gt > 0
-    global_delta = jnp.where(
-        g_present, emp_log(gt, gm) - jnp.log(gexp / jnp.maximum(gt, 1)), 0.0
-    )
-
-    qt = q_t[rg[:, None], q]
-    qm = q_m[rg[:, None], q]
-    q_present = g_present & (qt > 0)
-    offset1 = residue_logp + global_delta
-    quality_delta = jnp.where(q_present, emp_log(qt, qm) - offset1, 0.0)
-
-    offset2 = offset1 + quality_delta
-    ct = c_t[rg[:, None], q, cycles]
-    cm = c_m[rg[:, None], q, cycles]
-    cyc_delta = jnp.where(q_present & (ct > 0), emp_log(ct, cm) - offset2, 0.0)
-    dt = d_t[rg[:, None], q, dinucs]
-    dm = d_m[rg[:, None], q, dinucs]
-    din_delta = jnp.where(q_present & (dt > 0), emp_log(dt, dm) - offset2, 0.0)
-
-    log_p = residue_logp + global_delta + quality_delta + cyc_delta + din_delta
-    max_logp = jnp.log(err[MAX_QUAL])
-    bounded = jnp.minimum(0.0, jnp.maximum(max_logp, log_p))
-    # QualityScore.fromErrorProbability(exp(boundedLogP)) — shared rounding
-    from adam_tpu.ops.phred import error_probability_to_phred
-
-    new_q = error_probability_to_phred(jnp.exp(bounded))
+    new_q = phred_table[rg[:, None], q, cycles, dinucs]
 
     in_read = jnp.arange(lmax)[None, :] < lengths[:, None]
     apply_mask = (
@@ -313,17 +364,25 @@ def recalibrate_base_qualities(
     known_snps: Optional[SnpTable] = None,
     dump_observation_table: Optional[str] = None,
 ) -> AlignmentDataset:
-    obs = build_observation_table(ds, known_snps)
+    total, mism, rg_names, lmax, dev = _observe_device(ds, known_snps)
     if dump_observation_table:
+        obs = ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
         with open(dump_observation_table, "w") as fh:
             fh.write(obs.to_csv())
     b = ds.batch.to_numpy()
-    new_quals = recalibrate_kernel(
-        jnp.asarray(b.bases), jnp.asarray(b.quals), jnp.asarray(b.lengths),
-        jnp.asarray(b.flags), jnp.asarray(b.read_group_idx),
-        jnp.asarray(b.has_qual), jnp.asarray(b.valid),
-        jnp.asarray(obs.total), jnp.asarray(obs.mismatches), b.lmax,
-    )
+    from adam_tpu.formats.batch import grid_rows, pad_rows_np
+
+    g = grid_rows(b.n_rows)
+    gl = lmax  # _observe_device already grid-aligned the lane count
+    new_quals = np.asarray(
+        recalibrate_kernel(
+            dev["bases"], dev["quals"], dev["lengths"],
+            dev["flags"], dev["read_group_idx"],
+            jnp.asarray(pad_rows_np(b.has_qual, g, False)),
+            jnp.asarray(pad_rows_np(b.valid, g, False)),
+            total, mism, gl,
+        )
+    )[: b.n_rows, : b.lmax]
     # stash original quals in the sidecar (setOrigQual, Recalibrator.scala:36-40)
     # — vectorized: encode the pre-recalibration qual matrix as a string
     # column and merge it into rows that had no OQ yet.
