@@ -125,57 +125,82 @@ def _sw_fill_scan(
 
 
 def _sw_kernel(x_ref, ypad_ref, xlen_ref, ylen_ref, score_ref, move_ref,
-               d1_ref, d2_ref, *, lx: int, ly: int, L: int,
+               d1_ref, d2_ref, y_ref, *, lx: int, ly: int, L: int,
                w_match: float, w_mismatch: float, w_insert: float,
                w_delete: float):
-    """One batch-tile: fill all D diagonals of TB pairs.
+    """One grid-less call fills all D diagonals of one TB-row batch tile.
 
-    ypad holds reverse(y) laid out so that the lane window for diagonal d
-    starts at ``lx + ly - d`` (lane i then reads y[d - 1 - i]).
+    Two Mosaic constraints shape this kernel (both verified against the
+    real TPU compile service):
+
+    * No Pallas *grid* is used: this toolchain fails to legalize grids
+      whose block index maps revisit a block (any spec that ignores a
+      grid dimension), which a diagonal-in-grid layout would need for x
+      and y.  Instead the diagonal loop is a ``fori_loop`` and the
+      outputs are (D, TB, L) so the per-diagonal store indexes the
+      *untiled* leading dimension, which lowers fine.
+    * No unaligned dynamic lane slice: ypad holds reverse(y)
+      *pre-rotated* so the y window always reads the static, aligned
+      ``[:, :L]`` slice of a scratch that is circularly rolled right by
+      one lane after each diagonal (at diagonal d, lane i holds
+      y[d - 1 - i]).
     """
-    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     TB = x_ref.shape[0]
     D = lx + ly + 1
+    # all in-kernel scalars are pinned to i32/f32: under jax_enable_x64 a
+    # bare Python literal becomes an i64/f64 constant, and Mosaic's
+    # convert-element-type lowering recurses forever on 64-bit casts
     ii = jax.lax.broadcasted_iota(jnp.int32, (TB, L), 1)
+    one = jnp.int32(1)
+    zf = jnp.float32(0.0)
+    wm = jnp.float32(w_match)
+    wx = jnp.float32(w_mismatch)
+    wi = jnp.float32(w_insert)
+    wd = jnp.float32(w_delete)
+    mv_b, mv_j, mv_i, mv_t = (
+        jnp.int32(MOVE_B), jnp.int32(MOVE_J), jnp.int32(MOVE_I), jnp.int32(MOVE_T),
+    )
     xlen = xlen_ref[:]  # [TB, 1]
     ylen = ylen_ref[:]
     # xc: lane i holds x[i-1] (static shift; lane 0 and lanes past lx are
     # junk — masked by `valid`, and the -2 pad can never equal ypad's -1)
-    xc = jnp.pad(x_ref[:], ((0, 0), (1, L - 1 - lx)), constant_values=-2)
+    xc = jnp.pad(x_ref[:], ((0, 0), (1, L - 1 - lx)),
+                 constant_values=jnp.int32(-2))
     d1_ref[:] = jnp.zeros((TB, L), jnp.float32)
     d2_ref[:] = jnp.zeros((TB, L), jnp.float32)
+    y_ref[:] = ypad_ref[:]
 
-    def body(d, _):
+    def body(d, c):
         jj = d - ii
-        valid = (ii >= 1) & (jj >= 1) & (ii <= xlen) & (jj <= ylen)
-        yc = ypad_ref[:, pl.ds(lx + ly - d, L)]
-        sub = jnp.where(xc == yc, w_match, w_mismatch)
+        valid = (ii >= one) & (jj >= one) & (ii <= xlen) & (jj <= ylen)
+        yc = y_ref[:, :L]
+        sub = jnp.where(xc == yc, wm, wx)
         d1 = d1_ref[:]
         d2 = d2_ref[:]
         m = jnp.pad(d2[:, : L - 1], ((0, 0), (1, 0))) + sub
-        dd = jnp.pad(d1[:, : L - 1], ((0, 0), (1, 0))) + w_delete
-        inn = d1 + w_insert
-        take_b = (m >= dd) & (m >= inn) & (m > 0.0)
-        take_j = ~take_b & (dd >= inn) & (dd > 0.0)
-        take_i = ~take_b & ~take_j & (inn > 0.0)
+        dd = jnp.pad(d1[:, : L - 1], ((0, 0), (1, 0))) + wd
+        inn = d1 + wi
+        take_b = (m >= dd) & (m >= inn) & (m > zf)
+        take_j = ~take_b & (dd >= inn) & (dd > zf)
+        take_i = ~take_b & ~take_j & (inn > zf)
         score = jnp.where(
-            take_b, m, jnp.where(take_j, dd, jnp.where(take_i, inn, 0.0))
+            take_b, m, jnp.where(take_j, dd, jnp.where(take_i, inn, zf))
         )
-        score = jnp.where(valid, score, 0.0)
+        score = jnp.where(valid, score, zf)
         move = jnp.where(
-            take_b,
-            MOVE_B,
-            jnp.where(take_j, MOVE_J, jnp.where(take_i, MOVE_I, MOVE_T)),
+            take_b, mv_b, jnp.where(take_j, mv_j, jnp.where(take_i, mv_i, mv_t))
         )
-        move = jnp.where(valid, move, MOVE_T).astype(jnp.int32)
-        score_ref[:, d, :] = score
-        move_ref[:, d, :] = move
+        move = jnp.where(valid, move, mv_t)
+        score_ref[d, :, :] = score
+        move_ref[d, :, :] = move
         d2_ref[:] = d1
         d1_ref[:] = score
-        return 0
+        y_ref[:] = pltpu.roll(y_ref[:], shift=jnp.int32(1), axis=1)
+        return c
 
-    jax.lax.fori_loop(0, D, body, 0)
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(D), body, jnp.int32(0))
 
 
 @partial(
@@ -197,14 +222,21 @@ def _sw_fill_pallas(
     B = x_codes.shape[0]
     D = lx + ly + 1
     L = _round_up(lx + 1, _LANE)
-    TB = max(1, min(B, (4 * 1024 * 1024) // (D * L * 8)))  # ~8MB of out tiles
+    # tile so the (D, TB, L) f32+i32 outputs fit comfortably in VMEM
+    TB = max(1, min(B, (8 * 1024 * 1024) // (D * L * 8)))
+    TB = _round_up(TB, 8)  # sublane-divisible batch tile
     Bp = _round_up(B, TB)
 
     x = jnp.zeros((Bp, lx), jnp.int32).at[:B].set(x_codes.astype(jnp.int32))
-    # ypad[b, lx + ly - 1 - k] = y[b, k]  (reversed y after lx leading pads),
-    # so the window [lx + ly - d, +L) puts y[d - 1 - i] in lane i.
-    ypad = jnp.full((Bp, lx + ly + L), -1, jnp.int32)
+    # ypad[b, lx + ly - 1 - k] = y[b, k]  (reversed y after lx leading
+    # pads) would put y[d - 1 - i] in lane i of window [lx + ly - d, +L);
+    # pre-rotate left by lx + ly over the lane-aligned width Wp so the
+    # kernel's rolling scratch starts at the d=0 window and only ever
+    # reads the static [:, :L] slice.
+    Wp = _round_up(lx + ly + L, _LANE)
+    ypad = jnp.full((Bp, Wp), -1, jnp.int32)
     ypad = ypad.at[:B, lx: lx + ly].set(y_codes[:, ::-1].astype(jnp.int32))
+    ypad = jnp.roll(ypad, -(lx + ly), axis=1)
     xl = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(x_len.astype(jnp.int32))
     yl = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(y_len.astype(jnp.int32))
 
@@ -213,29 +245,38 @@ def _sw_fill_pallas(
         w_match=w_match, w_mismatch=w_mismatch,
         w_insert=w_insert, w_delete=w_delete,
     )
-    scores, moves = pl.pallas_call(
+    fill = pl.pallas_call(
         kernel,
-        grid=(Bp // TB,),
-        in_specs=[
-            pl.BlockSpec((TB, lx), lambda g: (g, 0)),
-            pl.BlockSpec((TB, lx + ly + L), lambda g: (g, 0)),
-            pl.BlockSpec((TB, 1), lambda g: (g, 0)),
-            pl.BlockSpec((TB, 1), lambda g: (g, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((TB, D, L), lambda g: (g, 0, 0)),
-            pl.BlockSpec((TB, D, L), lambda g: (g, 0, 0)),
-        ],
         out_shape=[
-            jax.ShapeDtypeStruct((Bp, D, L), jnp.float32),
-            jax.ShapeDtypeStruct((Bp, D, L), jnp.int32),
+            jax.ShapeDtypeStruct((D, TB, L), jnp.float32),
+            jax.ShapeDtypeStruct((D, TB, L), jnp.int32),
         ],
         scratch_shapes=[
             pltpu.VMEM((TB, L), jnp.float32),
             pltpu.VMEM((TB, L), jnp.float32),
+            pltpu.VMEM((TB, Wp), jnp.int32),
         ],
         interpret=interpret,
-    )(x, ypad, xl, yl)
+    )
+
+    nt = Bp // TB
+    if nt == 1:
+        s, m = fill(x, ypad, xl, yl)  # [D, TB, L]
+        scores = jnp.transpose(s, (1, 0, 2))  # [TB, D, L]
+        moves = jnp.transpose(m, (1, 0, 2))
+    else:
+        # one compiled kernel, sequential over batch tiles
+        s, m = jax.lax.map(
+            lambda t: fill(*t),
+            (
+                x.reshape(nt, TB, lx),
+                ypad.reshape(nt, TB, Wp),
+                xl.reshape(nt, TB, 1),
+                yl.reshape(nt, TB, 1),
+            ),
+        )  # [nt, D, TB, L]
+        scores = jnp.transpose(s, (0, 2, 1, 3)).reshape(Bp, D, L)
+        moves = jnp.transpose(m, (0, 2, 1, 3)).reshape(Bp, D, L)
     return scores[:B, :, : lx + 1], moves[:B, :, : lx + 1].astype(jnp.uint8)
 
 
@@ -248,9 +289,17 @@ def _use_pallas() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
+_warned_pallas_fallback = False
+
+
 def sw_fill(x_codes, x_len, y_codes, y_len, w_match, w_mismatch, w_insert,
             w_delete, lx: int, ly: int):
-    """Diagonal-layout fill, Pallas on accelerators, scan elsewhere."""
+    """Diagonal-layout fill, Pallas on accelerators, scan elsewhere.
+
+    A Pallas failure falls back to the scan fill with a warn-once log
+    (never silently), so a TPU-side kernel regression is observable;
+    force a backend with ADAM_TPU_SW_BACKEND={pallas,scan}.
+    """
     if _use_pallas():
         try:
             return _sw_fill_pallas(
@@ -259,8 +308,19 @@ def sw_fill(x_codes, x_len, y_codes, y_len, w_match, w_mismatch, w_insert,
                 float(w_match), float(w_mismatch), float(w_insert),
                 float(w_delete),
             )
-        except Exception:  # pragma: no cover - driver/kernel capability
-            pass
+        except Exception as e:  # pragma: no cover - driver/kernel capability
+            if os.environ.get("ADAM_TPU_SW_BACKEND") == "pallas":
+                raise  # explicitly requested: never mask a kernel failure
+            global _warned_pallas_fallback
+            if not _warned_pallas_fallback:
+                _warned_pallas_fallback = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "Pallas Smith-Waterman kernel failed (%s: %s); "
+                    "falling back to the lax.scan fill for this process",
+                    type(e).__name__, e,
+                )
     return _sw_fill_scan(
         jnp.asarray(x_codes), jnp.asarray(x_len), jnp.asarray(y_codes),
         jnp.asarray(y_len), w_match, w_mismatch, w_insert, w_delete, lx, ly,

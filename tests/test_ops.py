@@ -1,5 +1,6 @@
 import numpy as np
 import pytest
+import jax.numpy as jnp
 
 from adam_tpu.formats import schema
 from adam_tpu.formats.batch import pack_reads
@@ -349,3 +350,26 @@ def test_batch_md_arrays_empty_batch():
     b = ReadBatch.empty()
     is_mm, ref_codes, has_md = batch_md_arrays(b, ReadSidecar())
     assert is_mm.shape[0] == 0 and has_md.shape[0] == 0
+
+
+def test_sw_pallas_interpret_parity():
+    """The Pallas wavefront kernel must produce the scan fill's scores and
+    moves bit-for-bit (interpret mode runs the kernel on CPU)."""
+    rng = np.random.default_rng(7)
+    B, lx, ly = 9, 37, 29
+    xc = rng.integers(0, 4, (B, lx)).astype(np.int32)
+    yc = rng.integers(0, 4, (B, ly)).astype(np.int32)
+    xl = rng.integers(1, lx + 1, B).astype(np.int32)
+    yl = rng.integers(1, ly + 1, B).astype(np.int32)
+    args = (1.0, -0.333, -0.5, -0.5)
+
+    s_scan, m_scan = sw._sw_fill_scan(
+        jnp.asarray(xc), jnp.asarray(xl), jnp.asarray(yc), jnp.asarray(yl),
+        *args, lx, ly,
+    )
+    s_pl, m_pl = sw._sw_fill_pallas(
+        jnp.asarray(xc), jnp.asarray(xl), jnp.asarray(yc), jnp.asarray(yl),
+        lx, ly, *args, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(s_pl), np.asarray(s_scan))
+    np.testing.assert_array_equal(np.asarray(m_pl), np.asarray(m_scan))
