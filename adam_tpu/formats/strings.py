@@ -237,6 +237,15 @@ def _span_gather_indices(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
     total = int(lens.sum())
     if total == 0:
         return np.zeros(0, dtype=np.int64)
+    nz = lens > 0
+    u = np.unique(lens[nz])
+    if len(u) == 1:
+        # uniform span width (the common case for fixed-length reads):
+        # one broadcasted add instead of repeat+cumsum index machinery
+        w = int(u[0])
+        return (
+            starts[nz][:, None] + np.arange(w, dtype=np.int64)[None, :]
+        ).ravel()
     # index = repeat(starts) + (arange within each span)
     out = np.repeat(starts, lens)
     out += _span_local_positions(lens)

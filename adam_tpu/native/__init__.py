@@ -118,6 +118,10 @@ def _lib() -> Optional[ct.CDLL]:
                 + [ct.c_int]
             )
             lib.bamtok_free.argtypes = [ct.c_void_p]
+            lib.ref_positions.argtypes = [
+                _u8p, _i32p, _i32p, _i64p,
+                ct.c_int64, ct.c_int64, ct.c_int64, _i64p, ct.c_int,
+            ]
             _LIB = lib
         except Exception:
             _LOAD_FAILED = True
@@ -361,3 +365,28 @@ def tokenize_bam(raw, records_off: int,
         return out
     finally:
         lib.bamtok_free(h)
+
+
+def ref_positions(cigar_ops, cigar_lens, cigar_n, start, lmax: int):
+    """Per-base reference positions -> i64[N, lmax]; None if native
+    unavailable.
+
+    Threaded C++ CIGAR walk; the fallback is
+    :func:`adam_tpu.ops.cigar.reference_positions_np`.
+    """
+    lib = _lib()
+    if lib is None:
+        return None
+    ops = np.ascontiguousarray(cigar_ops, np.uint8)
+    lens = np.ascontiguousarray(cigar_lens, np.int32)
+    n_ops = np.ascontiguousarray(cigar_n, np.int32)
+    st = np.ascontiguousarray(start, np.int64)
+    N, C = ops.shape
+    out = np.empty((N, lmax), np.int64)
+    lib.ref_positions(
+        _u8_ptr(ops), lens.ctypes.data_as(_i32p), n_ops.ctypes.data_as(_i32p),
+        st.ctypes.data_as(_i64p),
+        ct.c_int64(N), ct.c_int64(C), ct.c_int64(lmax),
+        out.ctypes.data_as(_i64p), ct.c_int(_nthreads()),
+    )
+    return out

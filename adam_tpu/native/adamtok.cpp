@@ -540,7 +540,58 @@ int bam_tags_to_text(const uint8_t* t, const uint8_t* te, char* out,
 
 extern "C" {
 
-int adamtok_version() { return 3; }
+int adamtok_version() { return 4; }
+
+// ------------------------------------------------------- CIGAR walks ----
+
+// Per-base reference positions from columnar CIGARs: out[i, j] = reference
+// position of query base j of read i, or -1 when the base is not aligned
+// (insertion / soft clip / padding).  The host twin of the device kernel in
+// ops/cigar.py (RichAlignmentRecord.referencePositions semantics,
+// rich/RichAlignmentRecord.scala:200-229); a straight nested walk per read,
+// threaded over rows.
+void ref_positions(const uint8_t* ops, const int32_t* lens,
+                   const int32_t* n_ops, const int64_t* start,
+                   int64_t N, int64_t C, int64_t L, int64_t* out,
+                   int nthreads) {
+  // consumes-query / consumes-ref tables for op codes 0..15 (M I D N S H P = X)
+  static const uint8_t kQ[16] = {1, 1, 0, 0, 1, 0, 0, 1, 1, 0, 0, 0, 0, 0, 0, 0};
+  static const uint8_t kR[16] = {1, 0, 1, 1, 0, 0, 0, 1, 1, 0, 0, 0, 0, 0, 0, 0};
+  if (nthreads < 1) nthreads = 1;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t* row = out + i * L;
+      for (int64_t j = 0; j < L; ++j) row[j] = -1;
+      int64_t q = 0;
+      int64_t r = start[i];
+      int nc = n_ops[i];
+      if (nc > C) nc = int(C);
+      for (int k = 0; k < nc && q < L; ++k) {
+        uint8_t op = ops[i * C + k] & 15;
+        int64_t len = lens[i * C + k];
+        if (len < 0) len = 0;
+        bool cq = kQ[op], cr = kR[op];
+        if (cq && cr) {
+          int64_t stop = q + len;
+          if (stop > L) stop = L;
+          for (int64_t j = q; j < stop; ++j) row[j] = r + (j - q);
+        }
+        if (cq) q += len;
+        if (cr) r += len;
+      }
+    }
+  };
+  if (nthreads == 1 || N < 4096) {
+    work(0, N);
+    return;
+  }
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = N * t / nthreads, hi = N * (t + 1) / nthreads;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
 
 // ------------------------------------------------------------------ SAM --
 

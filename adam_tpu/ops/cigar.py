@@ -107,8 +107,15 @@ def reference_positions_np(cigar_ops, cigar_lens, cigar_n, start, lmax):
     filter input (e.g. BQSR's known-SNP masking) use this to avoid
     round-tripping an int64 [N, L] array through the device — on a
     tunneled TPU that fetch alone costs more than the whole pass.
+    Delegates to the threaded native CIGAR walk when available.
     """
     import numpy as np
+
+    from adam_tpu import native
+
+    nat = native.ref_positions(cigar_ops, cigar_lens, cigar_n, start, lmax)
+    if nat is not None:
+        return nat
 
     ops = np.asarray(cigar_ops)
     lens = np.asarray(cigar_lens).astype(np.int64)

@@ -399,9 +399,11 @@ def recalibrate_base_qualities(
     stashed = StringColumn.from_matrix(
         qmat, np.where(set_mask, np.asarray(b.lengths), 0), set_mask.copy()
     )
-    new_side = dc_replace(
-        side, orig_quals=StringColumn.where(set_mask, stashed, old_oq)
-    )
+    if not old_oq.valid.any():
+        merged = stashed  # no pre-existing OQ anywhere: stash wholesale
+    else:
+        merged = StringColumn.where(set_mask, stashed, old_oq)
+    new_side = dc_replace(side, orig_quals=merged)
     return ds.with_batch(
         b.replace(quals=np.asarray(new_quals)), new_side
     )

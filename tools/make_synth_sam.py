@@ -50,8 +50,16 @@ def make_sam(path: str, n_reads: int, read_len: int = 100, seed: int = 0,
             off = (p * 37) % (read_len - 2) + 1
             md1 = f"{off}A{read_len - off - 1}"
             md2 = str(read_len)
+            # ~3% of pairs carry a 2bp insertion in read1 (realignment
+            # target material: IndelRealignmentTarget from CIGAR I ops)
+            if p % 33 == 0:
+                ins_at = (p * 13) % (read_len - 10) + 4
+                cig1 = f"{ins_at}M2I{read_len - ins_at - 2}M"
+                md1 = str(read_len - 2)
+            else:
+                cig1 = f"{read_len}M"
             lines.append(
-                f"{name}\t99\tchr20\t{s1 + 1}\t60\t{read_len}M\t=\t{s2 + 1}\t{tl}"
+                f"{name}\t99\tchr20\t{s1 + 1}\t60\t{cig1}\t=\t{s2 + 1}\t{tl}"
                 f"\t{seq1}\t{q1}\tRG:Z:{rg}\tMD:Z:{md1}\tNM:i:1\n"
             )
             lines.append(
