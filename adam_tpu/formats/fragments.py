@@ -158,3 +158,55 @@ def count_contig_kmers(fragments: FragmentBatch, k: int) -> dict[str, int]:
     return kmer.histogram_to_dict(
         flanked.bases, flanked.lengths, flanked.valid, k
     )
+
+
+def to_read_records(fragments: FragmentBatch, contig_names) -> list[dict]:
+    """Merge adjacent fragments into synthetic read records.
+
+    The columnar recast of FragmentConverter.convertRdd
+    (converters/FragmentConverter.scala:100): per contig, fragments are
+    sorted by start and maximal *adjacent* runs (next.start == prev.end)
+    are concatenated; each run becomes one AlignmentRecord-shaped dict
+    (contig, start, sequence — FragmentConverter.convertFragment).
+    Non-adjacent fragments stay separate reads.
+    """
+    b = fragments.to_numpy()
+    rows = np.flatnonzero(np.asarray(b.valid))
+    if not len(rows):
+        return []
+    contig = np.asarray(b.contig_idx)[rows]
+    start = np.asarray(b.start)[rows]
+    lens = np.asarray(b.lengths)[rows].astype(np.int64)
+    order = np.lexsort((start, contig))
+    contig, start, lens, rows = (
+        contig[order], start[order], lens[order], rows[order],
+    )
+    # run breaks: new contig, or a gap before this fragment
+    prev_end = start + lens
+    brk = np.ones(len(rows), bool)
+    brk[1:] = (contig[1:] != contig[:-1]) | (start[1:] != prev_end[:-1])
+
+    records: list[dict] = []
+    heads = np.flatnonzero(brk)
+    bounds = np.append(heads, len(rows))
+    bases = np.asarray(b.bases)
+    for r in range(len(heads)):
+        lo, hi = bounds[r], bounds[r + 1]
+        seq = "".join(
+            schema.decode_bases(bases[rows[k]][: int(lens[k])])
+            for k in range(lo, hi)
+        )
+        c = int(contig[lo])
+        records.append(
+            dict(
+                name=contig_names[c] if 0 <= c < len(contig_names) else str(c),
+                flags=0,
+                contig_idx=c,
+                start=int(start[lo]),
+                mapq=255,
+                cigar=f"{len(seq)}M",
+                seq=seq,
+                qual="*",
+            )
+        )
+    return records

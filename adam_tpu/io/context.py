@@ -62,33 +62,27 @@ def load_fasta(path: str, fragment_length: int = 10_000):
     return fasta.read_fasta(path, fragment_length)
 
 
-def load_fasta_reads(path: str) -> AlignmentDataset:
-    """FASTA contigs as synthetic unaligned reads (loadAlignments .fa branch,
-    via FragmentConverter semantics)."""
-    from adam_tpu.io import fasta
+def fragments_to_alignments(fragments, seq_dict) -> AlignmentDataset:
+    """FragmentBatch -> synthetic reads dataset (the `toReads` role,
+    rdd/contig/NucleotideContigFragmentRDDFunctions.scala:49, merging
+    adjacent fragments per FragmentConverter.scala:100)."""
+    from adam_tpu.formats.fragments import to_read_records
 
-    fragments, seq_dict, _ = fasta.read_fasta(path, fragment_length=2**31 - 1)
-    b = fragments.to_numpy()
-    records = []
-    for i in range(b.n_rows):
-        if not b.valid[i]:
-            continue
-        seq = schema.decode_bases(b.bases[i][: int(b.lengths[i])])
-        records.append(
-            dict(
-                name=seq_dict.names[int(b.contig_idx[i])],
-                flags=0,
-                contig_idx=int(b.contig_idx[i]),
-                start=int(b.start[i]),
-                mapq=255,
-                cigar=f"{len(seq)}M",
-                seq=seq,
-                qual="*",
-            )
-        )
+    records = to_read_records(fragments, seq_dict.names)
     batch, side = pack_reads(records)
     header = SamHeader(seq_dict=seq_dict)
     return AlignmentDataset(batch, side, header)
+
+
+def load_fasta_reads(path: str, fragment_length: int = 10_000) -> AlignmentDataset:
+    """FASTA contigs as synthetic reads (loadAlignments .fa branch,
+    rdd/ADAMContext.scala:497-500: loadFasta(...).toReads)."""
+    from adam_tpu.io import fasta
+
+    fragments, seq_dict, _ = fasta.read_fasta(
+        path, fragment_length=fragment_length
+    )
+    return fragments_to_alignments(fragments, seq_dict)
 
 
 def load_parquet_alignments(
@@ -130,4 +124,18 @@ def load_alignments(path: str, **kw) -> AlignmentDataset:
         return load_fastq(path, **kw)
     if base.endswith((".fa", ".fasta")):
         return load_fasta_reads(path)
+    # Parquet: contig-fragment stores become synthetic reads
+    # (rdd/ADAMContext.scala:501-505 `*contig.adam` branch) — sniffed by
+    # schema instead of filename so renamed stores still dispatch right
+    try:
+        import pyarrow.parquet as _pq
+
+        names = set(_pq.read_schema(path).names)
+    except Exception:
+        names = set()
+    if "fragmentSequence" in names:
+        from adam_tpu.io import parquet as _parquet
+
+        fragments, seq_dict, _ = _parquet.load_fragments(path)
+        return fragments_to_alignments(fragments, seq_dict)
     return load_parquet_alignments(path, **kw)
