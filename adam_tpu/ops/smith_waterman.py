@@ -124,29 +124,67 @@ def _sw_fill_scan(
 # ----------------------------------------------------------- pallas fill
 
 
-def _sw_kernel(x_ref, ypad_ref, xlen_ref, ylen_ref, score_ref, move_ref,
-               d1_ref, d2_ref, y_ref, *, lx: int, ly: int, L: int,
+def _per_lane_best(scores, x_len, y_len):
+    """Per-lane (matrix row) running max over diagonals, ties -> last d.
+
+    -> (best_sc f32[B, L] with -inf outside the valid region,
+        best_d i32[B, L] diagonal index of the winning cell).
+    Reducing over lanes with ties -> last lane reproduces the reference's
+    maxCoordinates lexicographic-(i, j)-max rule (the right-biased fold
+    in SmithWaterman.maxCoordinates, SmithWaterman.scala:50-83).
+    """
+    B, D, L = scores.shape
+    ii = jnp.arange(L)[None, None, :]
+    dd = jnp.arange(D)[None, :, None]
+    jj = dd - ii
+    valid = (
+        (ii <= x_len[:, None, None])
+        & (jj >= 0)
+        & (jj <= y_len[:, None, None])
+    )
+    masked = jnp.where(valid, scores, -jnp.inf)
+    amax_rev = jnp.argmax(masked[:, ::-1, :], axis=1)  # first max = last d
+    best_d = (D - 1 - amax_rev).astype(jnp.int32)
+    best_sc = jnp.max(masked, axis=1).astype(jnp.float32)
+    return best_sc, best_d
+
+
+@partial(jax.jit, static_argnames=("lx", "ly"))
+def _sw_fill_scan_best(
+    x_codes, x_len, y_codes, y_len, w_match, w_mismatch, w_insert, w_delete,
+    lx: int, ly: int,
+):
+    """Scan fill + per-lane best, fused under one jit so the full f32
+    score matrix never leaves the device."""
+    scores, moves = _sw_fill_scan.__wrapped__(
+        x_codes, x_len, y_codes, y_len,
+        w_match, w_mismatch, w_insert, w_delete, lx, ly,
+    )
+    best_sc, best_d = _per_lane_best(scores, x_len, y_len)
+    return moves, best_sc, best_d
+
+
+def _sw_kernel(x_ref, ydiag_ref, xlen_ref, ylen_ref, move_ref,
+               best_sc_ref, best_d_ref,
+               d1_ref, d2_ref, *, lx: int, ly: int, L: int,
                w_match: float, w_mismatch: float, w_insert: float,
                w_delete: float):
     """One grid-less call fills all D diagonals of one TB-row batch tile.
 
-    Two Mosaic constraints shape this kernel (both verified against the
-    real TPU compile service):
+    Mosaic constraints shape this kernel (all verified against the real
+    TPU compile service):
 
     * No Pallas *grid* is used: this toolchain fails to legalize grids
       whose block index maps revisit a block (any spec that ignores a
       grid dimension), which a diagonal-in-grid layout would need for x
       and y.  Instead the diagonal loop is a ``fori_loop`` and the
-      outputs are (D, TB, L) so the per-diagonal store indexes the
-      *untiled* leading dimension, which lowers fine.
-    * No unaligned dynamic lane slice: ypad holds reverse(y)
-      *pre-rotated* so the y window always reads the static, aligned
-      ``[:, :L]`` slice of a scratch that is circularly rolled right by
-      one lane after each diagonal (at diagonal d, lane i holds
-      y[d - 1 - i]).
+      (D, TB, L) arrays are indexed on the *untiled* leading dimension,
+      which lowers fine.
+    * No unaligned dynamic lane slice — and a per-step ``pltpu.roll``
+      measured ~0.3 ms/step — so the y lane windows for every diagonal
+      are pre-gathered in XLA into ``ydiag[d, :, i] = y[d - 1 - i]``
+      (i8) and the kernel just reads ``ydiag_ref[d]``.
     """
-    from jax.experimental.pallas import tpu as pltpu
-
     TB = x_ref.shape[0]
     D = lx + ly + 1
     # all in-kernel scalars are pinned to i32/f32: under jax_enable_x64 a
@@ -162,20 +200,25 @@ def _sw_kernel(x_ref, ypad_ref, xlen_ref, ylen_ref, score_ref, move_ref,
     mv_b, mv_j, mv_i, mv_t = (
         jnp.int32(MOVE_B), jnp.int32(MOVE_J), jnp.int32(MOVE_I), jnp.int32(MOVE_T),
     )
+    zero = jnp.int32(0)
+    ninf = jnp.float32(-jnp.inf)
     xlen = xlen_ref[:]  # [TB, 1]
     ylen = ylen_ref[:]
     # xc: lane i holds x[i-1] (static shift; lane 0 and lanes past lx are
-    # junk — masked by `valid`, and the -2 pad can never equal ypad's -1)
+    # junk — masked by `valid`, and the -2 pad can never equal ydiag's -1).
+    # Codes live as i32: i8 vectors carry (32, 128) tiling whose compare
+    # masks Mosaic cannot relayout against the f32 selects.
     xc = jnp.pad(x_ref[:], ((0, 0), (1, L - 1 - lx)),
                  constant_values=jnp.int32(-2))
     d1_ref[:] = jnp.zeros((TB, L), jnp.float32)
     d2_ref[:] = jnp.zeros((TB, L), jnp.float32)
-    y_ref[:] = ypad_ref[:]
+    best_sc_ref[:] = jnp.full((TB, L), ninf, jnp.float32)
+    best_d_ref[:] = jnp.zeros((TB, L), jnp.int32)
 
     def body(d, c):
         jj = d - ii
         valid = (ii >= one) & (jj >= one) & (ii <= xlen) & (jj <= ylen)
-        yc = y_ref[:, :L]
+        yc = ydiag_ref[d, :, :]
         sub = jnp.where(xc == yc, wm, wx)
         d1 = d1_ref[:]
         d2 = d2_ref[:]
@@ -193,11 +236,16 @@ def _sw_kernel(x_ref, ypad_ref, xlen_ref, ylen_ref, score_ref, move_ref,
             take_b, mv_b, jnp.where(take_j, mv_j, jnp.where(take_i, mv_i, mv_t))
         )
         move = jnp.where(valid, move, mv_t)
-        score_ref[d, :, :] = score
-        move_ref[d, :, :] = move
+        move_ref[d, :, :] = move.astype(jnp.int8)
+        # running per-lane max over the valid region (incl. the zero
+        # borders i==0 / j==0); ties -> later diagonal (larger j)
+        in_region = (ii <= xlen) & (jj >= zero) & (jj <= ylen)
+        cur = jnp.where(in_region, score, ninf)
+        upd = cur >= best_sc_ref[:]
+        best_sc_ref[:] = jnp.where(upd, cur, best_sc_ref[:])
+        best_d_ref[:] = jnp.where(upd, d, best_d_ref[:])
         d2_ref[:] = d1
         d1_ref[:] = score
-        y_ref[:] = pltpu.roll(y_ref[:], shift=jnp.int32(1), axis=1)
         return c
 
     jax.lax.fori_loop(jnp.int32(0), jnp.int32(D), body, jnp.int32(0))
@@ -215,28 +263,32 @@ def _sw_fill_pallas(
     w_match: float, w_mismatch: float, w_insert: float, w_delete: float,
     interpret: bool = False,
 ):
-    """Pallas wavefront fill; same contract as :func:`_sw_fill_scan`."""
+    """Pallas wavefront fill.
+
+    -> (moves u8[B, D, lx+1], best_sc f32[B, lx+1], best_d i32[B, lx+1]),
+    matching :func:`_sw_fill_scan_best` bit-for-bit.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B = x_codes.shape[0]
     D = lx + ly + 1
     L = _round_up(lx + 1, _LANE)
-    # tile so the (D, TB, L) f32+i32 outputs fit comfortably in VMEM
-    TB = max(1, min(B, (8 * 1024 * 1024) // (D * L * 8)))
-    TB = _round_up(TB, 8)  # sublane-divisible batch tile
+    # tile so the (D, TB, L) i8 move matrix + pre-gathered i32 y
+    # diagonals fit comfortably in VMEM (~16MB/core); scores are never
+    # materialized — the kernel tracks the per-lane running max instead
+    TB = max(1, min(B, (2 * 1024 * 1024) // (D * L)))
+    TB = _round_up(TB, 32)  # (32, 128) i8-tile-divisible batch tile
     Bp = _round_up(B, TB)
 
-    x = jnp.zeros((Bp, lx), jnp.int32).at[:B].set(x_codes.astype(jnp.int32))
-    # ypad[b, lx + ly - 1 - k] = y[b, k]  (reversed y after lx leading
-    # pads) would put y[d - 1 - i] in lane i of window [lx + ly - d, +L);
-    # pre-rotate left by lx + ly over the lane-aligned width Wp so the
-    # kernel's rolling scratch starts at the d=0 window and only ever
-    # reads the static [:, :L] slice.
-    Wp = _round_up(lx + ly + L, _LANE)
-    ypad = jnp.full((Bp, Wp), -1, jnp.int32)
+    x = jnp.full((Bp, lx), -2, jnp.int32).at[:B].set(x_codes.astype(jnp.int32))
+    # ydiag[b, d, i] = y[b, d - 1 - i] (-1 outside the read): the
+    # per-diagonal y lane windows, gathered once in XLA so the kernel
+    # never needs an unaligned dynamic lane slice (or a per-step roll)
+    ypad = jnp.full((Bp, lx + ly + L), -1, jnp.int32)
     ypad = ypad.at[:B, lx: lx + ly].set(y_codes[:, ::-1].astype(jnp.int32))
-    ypad = jnp.roll(ypad, -(lx + ly), axis=1)
+    widx = (lx + ly - jnp.arange(D))[:, None] + jnp.arange(L)[None, :]
+    ydiag = ypad[:, widx]  # [Bp, D, L]
     xl = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(x_len.astype(jnp.int32))
     yl = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(y_len.astype(jnp.int32))
 
@@ -248,45 +300,60 @@ def _sw_fill_pallas(
     fill = pl.pallas_call(
         kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((D, TB, L), jnp.float32),
-            jax.ShapeDtypeStruct((D, TB, L), jnp.int32),
+            jax.ShapeDtypeStruct((D, TB, L), jnp.int8),
+            jax.ShapeDtypeStruct((TB, L), jnp.float32),
+            jax.ShapeDtypeStruct((TB, L), jnp.int32),
         ],
         scratch_shapes=[
             pltpu.VMEM((TB, L), jnp.float32),
             pltpu.VMEM((TB, L), jnp.float32),
-            pltpu.VMEM((TB, Wp), jnp.int32),
         ],
         interpret=interpret,
     )
 
     nt = Bp // TB
     if nt == 1:
-        s, m = fill(x, ypad, xl, yl)  # [D, TB, L]
-        scores = jnp.transpose(s, (1, 0, 2))  # [TB, D, L]
+        m, bs, bd = fill(
+            x, jnp.transpose(ydiag, (1, 0, 2)), xl, yl
+        )  # [D, TB, L], [TB, L] x2
         moves = jnp.transpose(m, (1, 0, 2))
     else:
         # one compiled kernel, sequential over batch tiles
-        s, m = jax.lax.map(
+        m, bs, bd = jax.lax.map(
             lambda t: fill(*t),
             (
                 x.reshape(nt, TB, lx),
-                ypad.reshape(nt, TB, Wp),
+                jnp.transpose(
+                    ydiag.reshape(nt, TB, D, L), (0, 2, 1, 3)
+                ),
                 xl.reshape(nt, TB, 1),
                 yl.reshape(nt, TB, 1),
             ),
-        )  # [nt, D, TB, L]
-        scores = jnp.transpose(s, (0, 2, 1, 3)).reshape(Bp, D, L)
+        )  # [nt, D, TB, L], [nt, TB, L] x2
         moves = jnp.transpose(m, (0, 2, 1, 3)).reshape(Bp, D, L)
-    return scores[:B, :, : lx + 1], moves[:B, :, : lx + 1].astype(jnp.uint8)
+        bs = bs.reshape(Bp, L)
+        bd = bd.reshape(Bp, L)
+    return (
+        moves[:B, :, : lx + 1].astype(jnp.uint8),
+        bs[:B, : lx + 1],
+        bd[:B, : lx + 1],
+    )
 
 
 def _use_pallas() -> bool:
-    mode = os.environ.get("ADAM_TPU_SW_BACKEND", "auto")
-    if mode == "pallas":
-        return True
-    if mode == "scan":
-        return False
-    return jax.default_backend() not in ("cpu",)
+    """Whether to run the hand-written Pallas fill.
+
+    Default is the lax.scan fill on every backend: measured on the v5e
+    chip (data-dependency-chained timing, axon result-memoization
+    defeated), the scan fill sustains ~12.4 GCUPS at B=512/127x127 while
+    the Pallas kernel reaches only ~0.9 — this toolchain fails to
+    legalize Pallas grids with revisited blocks (see _sw_kernel), which
+    forces the whole fill into one grid-less kernel whose fori_loop the
+    Mosaic scheduler pipelines far worse than XLA pipelines the scan.
+    The kernel stays available (ADAM_TPU_SW_BACKEND=pallas) and
+    bit-for-bit parity-tested for toolchains where grids work.
+    """
+    return os.environ.get("ADAM_TPU_SW_BACKEND", "scan") == "pallas"
 
 
 _warned_pallas_fallback = False
@@ -295,6 +362,8 @@ _warned_pallas_fallback = False
 def sw_fill(x_codes, x_len, y_codes, y_len, w_match, w_mismatch, w_insert,
             w_delete, lx: int, ly: int):
     """Diagonal-layout fill, Pallas on accelerators, scan elsewhere.
+
+    -> (moves u8[B, D, lx+1], best_sc f32[B, lx+1], best_d i32[B, lx+1]).
 
     A Pallas failure falls back to the scan fill with a warn-once log
     (never silently), so a TPU-side kernel regression is observable;
@@ -321,7 +390,7 @@ def sw_fill(x_codes, x_len, y_codes, y_len, w_match, w_mismatch, w_insert,
                     "falling back to the lax.scan fill for this process",
                     type(e).__name__, e,
                 )
-    return _sw_fill_scan(
+    return _sw_fill_scan_best(
         jnp.asarray(x_codes), jnp.asarray(x_len), jnp.asarray(y_codes),
         jnp.asarray(y_len), w_match, w_mismatch, w_insert, w_delete, lx, ly,
     )
@@ -341,25 +410,18 @@ class SWAlignment:
     score: float
 
 
-def _max_coordinates_diag(
-    diag_score: np.ndarray, x_len: int, y_len: int
-) -> tuple[int, int]:
-    """Reference tie rule on the diagonal layout: the global max with the
-    LAST row i winning ties, then the LAST column j (maxCoordinates'
-    right-biased fold)."""
-    L = diag_score.shape[1]
-    ii = np.arange(L)
-    dd = np.arange(diag_score.shape[0])
-    jj = dd[:, None] - ii[None, :]
-    valid = (ii[None, :] <= x_len) & (jj >= 0) & (jj <= y_len)
-    s = np.where(valid, diag_score, -np.inf)
-    best = s.max()
-    cand = np.argwhere(s == best)
-    # lexicographic (i, j) max among candidates
-    i_arr = cand[:, 1]
-    j_arr = cand[:, 0] - cand[:, 1]
-    k = np.lexsort((j_arr, i_arr))[-1]
-    return int(i_arr[k]), int(j_arr[k])
+def _max_coordinates(
+    best_sc: np.ndarray, best_d: np.ndarray, x_len: int
+) -> tuple[int, int, float]:
+    """Reference tie rule from the per-lane best arrays: the global max
+    with the LAST row i winning ties, then the LAST column j
+    (maxCoordinates' right-biased fold; the per-lane max already kept
+    the largest diagonal = largest j within each row)."""
+    lanes = best_sc[: x_len + 1]
+    best = lanes.max()
+    i = int(np.flatnonzero(lanes == best).max())
+    j = int(best_d[i]) - i
+    return i, j, float(best)
 
 
 def _rnn_to_cigar(ops: list[str]) -> str:
@@ -379,9 +441,10 @@ def _rnn_to_cigar(ops: list[str]) -> str:
 
 
 def _trackback(
-    diag_moves: np.ndarray, diag_score: np.ndarray, x_len: int, y_len: int
+    diag_moves: np.ndarray, best_sc: np.ndarray, best_d: np.ndarray,
+    x_len: int,
 ) -> SWAlignment:
-    i, j = _max_coordinates_diag(diag_score, x_len, y_len)
+    i, j, score = _max_coordinates(best_sc, best_d, x_len)
     end_i, end_j = i, j
     cx: list[str] = []
     cy: list[str] = []
@@ -407,7 +470,7 @@ def _trackback(
         y_start=j,
         x_end=end_i,
         y_end=end_j,
-        score=float(diag_score[end_i + end_j, end_i]),
+        score=score,
     )
 
 
@@ -424,17 +487,17 @@ def smith_waterman_batch(
     """Align each x[i] against y[i]; device fill + host trackback."""
     x_codes = jnp.asarray(x_codes)
     y_codes = jnp.asarray(y_codes)
-    scores, moves = sw_fill(
+    moves, best_sc, best_d = sw_fill(
         x_codes, jnp.asarray(x_len), y_codes, jnp.asarray(y_len),
         w_match, w_mismatch, w_insert, w_delete,
         int(x_codes.shape[1]), int(y_codes.shape[1]),
     )
-    scores = np.asarray(scores)
     moves = np.asarray(moves)
+    best_sc = np.asarray(best_sc)
+    best_d = np.asarray(best_d)
     xl = np.asarray(x_len)
-    yl = np.asarray(y_len)
     return [
-        _trackback(moves[b], scores[b], int(xl[b]), int(yl[b]))
+        _trackback(moves[b], best_sc[b], best_d[b], int(xl[b]))
         for b in range(x_codes.shape[0])
     ]
 

@@ -363,13 +363,18 @@ def test_sw_pallas_interpret_parity():
     yl = rng.integers(1, ly + 1, B).astype(np.int32)
     args = (1.0, -0.333, -0.5, -0.5)
 
-    s_scan, m_scan = sw._sw_fill_scan(
+    m_scan, bs_scan, bd_scan = sw._sw_fill_scan_best(
         jnp.asarray(xc), jnp.asarray(xl), jnp.asarray(yc), jnp.asarray(yl),
         *args, lx, ly,
     )
-    s_pl, m_pl = sw._sw_fill_pallas(
+    m_pl, bs_pl, bd_pl = sw._sw_fill_pallas(
         jnp.asarray(xc), jnp.asarray(xl), jnp.asarray(yc), jnp.asarray(yl),
         lx, ly, *args, interpret=True,
     )
-    np.testing.assert_array_equal(np.asarray(s_pl), np.asarray(s_scan))
     np.testing.assert_array_equal(np.asarray(m_pl), np.asarray(m_scan))
+    np.testing.assert_array_equal(np.asarray(bs_pl), np.asarray(bs_scan))
+    # the winning diagonal only matters where a real (finite) best exists
+    finite = np.isfinite(np.asarray(bs_scan))
+    np.testing.assert_array_equal(
+        np.asarray(bd_pl)[finite], np.asarray(bd_scan)[finite]
+    )
