@@ -57,10 +57,14 @@ class AlignmentDataset:
 
             parquet.save_alignments(p, self.batch, self.sidecar, self.header)
 
-    def save_paired_fastq(self, path1: str, path2: str) -> None:
+    def save_paired_fastq(
+        self, path1: str, path2: str, stringency="lenient"
+    ) -> None:
         from adam_tpu.io import fastq
 
-        fastq.write_paired_fastq(path1, path2, self.batch, self.sidecar)
+        fastq.write_paired_fastq(
+            path1, path2, self.batch, self.sidecar, stringency=stringency
+        )
 
     # ------------------------------------------------------------- helpers
     def __len__(self) -> int:

@@ -39,6 +39,18 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         help="print metrics to the log on completion",
     )
     parser.add_argument(
+        "-log_level", default="warning",
+        choices=["debug", "info", "warning", "error"],
+        help="adam_tpu logging verbosity (debug shows per-target "
+        "realignment LOD decisions and BQSR visit accounting)",
+    )
+    parser.add_argument(
+        "-stringency", default="lenient",
+        choices=["strict", "lenient", "silent"],
+        help="validation stringency for malformed-input handling "
+        "(FASTQ pairing/export paths)",
+    )
+    parser.add_argument(
         "-parquet_compression_codec", default="snappy",
         choices=["uncompressed", "snappy", "gzip", "lzo", "zstd"],
         help="parquet compression codec",
@@ -98,6 +110,12 @@ def main(argv=None) -> int:
     add_common_args(parser)
     cmd.configure(parser)
     args = parser.parse_args(rest)
+    import logging
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+    )
     ins.TIMERS.recording = bool(args.print_metrics)
     try:
         rc = cmd.run(args)

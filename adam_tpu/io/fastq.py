@@ -146,26 +146,34 @@ def read_fastq(
 
 
 def read_interleaved_fastq(
-    path: str, round_rows_to: int = 1
+    path: str, round_rows_to: int = 1, stringency="strict"
 ) -> tuple[ReadBatch, ReadSidecar, SamHeader]:
     """Interleaved paired FASTQ: records alternate mate1/mate2.
 
     Pairing is validated by name (after stripping /1 /2), matching
-    FastqRecordConverter.convertPair's check.
+    FastqRecordConverter.convertPair's check; ``stringency`` softens the
+    failure to a warning (LENIENT) or nothing (SILENT), keeping the pair.
     """
+    from adam_tpu.utils.validation import handle
+
     with _open(path) as fh:
         lines = fh.read().splitlines()
     recs = list(split_fastq_records(lines, resync=True, interleaved=True))
     if len(recs) % 2:
-        raise ValueError(f"{path}: odd number of FASTQ records in interleaved file")
+        handle(
+            stringency,
+            f"{path}: odd number of FASTQ records in interleaved file",
+        )
+        recs = recs[:-1]
     records = []
     for k in range(0, len(recs), 2):
         (n1, s1, q1), (n2, s2, q2) = recs[k], recs[k + 1]
         name1, _ = _strip_pair_suffix(n1)
         name2, _ = _strip_pair_suffix(n2)
         if name1 != name2:
-            raise ValueError(
-                f"interleaved FASTQ pair mismatch: {name1!r} vs {name2!r}"
+            handle(
+                stringency,
+                f"interleaved FASTQ pair mismatch: {name1!r} vs {name2!r}",
             )
         base = schema.FLAG_PAIRED | schema.FLAG_UNMAPPED | schema.FLAG_MATE_UNMAPPED
         records.append(
@@ -215,6 +223,7 @@ def write_fastq(
     side: ReadSidecar,
     add_suffix: bool = True,
     predicate=None,
+    row_mask=None,
 ) -> None:
     import numpy as np
 
@@ -222,6 +231,8 @@ def write_fastq(
     with _open(path, "wt") as fh:
         for i in range(b.n_rows):
             if not b.valid[i]:
+                continue
+            if row_mask is not None and not row_mask[i]:
                 continue
             if predicate is not None and not predicate(int(b.flags[i])):
                 continue
@@ -235,14 +246,76 @@ def write_fastq(
 
 
 def write_paired_fastq(
-    path1: str, path2: str, batch: ReadBatch, side: ReadSidecar
+    path1: str, path2: str, batch: ReadBatch, side: ReadSidecar,
+    stringency="lenient",
 ) -> None:
-    """Split pairs into two files (adamSaveAsPairedFastq's core behavior)."""
+    """Split pairs into two files (adamSaveAsPairedFastq,
+    AlignmentRecordRDDFunctions.scala:386-464).
+
+    Pairing validation follows the reference's ValidationStringency:
+    read names must occur exactly twice (suffix-stripped) and no read may
+    carry both first- and second-of-pair — STRICT raises with the
+    reference's "don't occur exactly twice" report, LENIENT logs and
+    writes only the properly paired records, SILENT just filters.
+    """
+    import logging
+
+    import numpy as np
+
+    from adam_tpu.formats.strings import StringColumn
+    from adam_tpu.utils.validation import handle
+
+    b = batch.to_numpy()
+    flags = np.asarray(b.flags)
+    valid = np.asarray(b.valid)
+    names = StringColumn.of(side.names)
+    fixed = names.to_fixed_bytes()
+    # suffix-stripped grouping key (readNameHasPairedSuffix drop of /1 /2)
+    keys = np.array(
+        [
+            k[:-2] if k.endswith((b"/1", b"/2")) else k
+            for k in fixed
+        ]
+    )
+    keys = np.where(valid, keys, b"")
+    uniq, inv, counts = np.unique(keys, return_inverse=True, return_counts=True)
+    n_per_read = counts[inv]
+    bad = valid & (n_per_read != 2)
+    if bad.any():
+        bad_names = np.unique(keys[bad])[:100]
+        handle(
+            stringency,
+            "Found %d read names that don't occur exactly twice\n\nSamples:\n\t%s"
+            % (
+                len(np.unique(keys[bad])),
+                "\n\t".join(x.decode("utf-8", "replace") for x in bad_names),
+            ),
+        )
+    both = (
+        valid
+        & ((flags & schema.FLAG_FIRST_OF_PAIR) != 0)
+        & ((flags & schema.FLAG_SECOND_OF_PAIR) != 0)
+    )
+    if both.any():
+        handle(
+            stringency,
+            "Read %s found with first- and second-of-pair set"
+            % fixed[both.argmax()].decode("utf-8", "replace"),
+        )
+    paired = valid & (n_per_read == 2) & ~both
+    n_first = int((paired & ((flags & schema.FLAG_FIRST_OF_PAIR) != 0)).sum())
+    n_second = int((paired & ((flags & schema.FLAG_SECOND_OF_PAIR) != 0)).sum())
+    logging.getLogger("adam_tpu.io.fastq").info(
+        "%d/%d records are properly paired: %d firsts, %d seconds",
+        int(paired.sum()), int(valid.sum()), n_first, n_second,
+    )
     write_fastq(
         path1, batch, side,
         predicate=lambda f: bool(f & schema.FLAG_FIRST_OF_PAIR),
+        row_mask=paired,
     )
     write_fastq(
         path2, batch, side,
         predicate=lambda f: bool(f & schema.FLAG_SECOND_OF_PAIR),
+        row_mask=paired,
     )

@@ -242,6 +242,16 @@ def _observe_device(
         n_rg, gl,
     )
     rg_names = ds.read_groups.names + ["null"]
+    # visit accounting (BaseQualityRecalibration.scala:99-123's logging)
+    import logging
+
+    logging.getLogger(__name__).info(
+        "BQSR observe: %d reads eligible of %d; %d residues visited, "
+        "%d residues filtered",
+        int(read_ok.sum()), int(np.asarray(b.valid).sum()),
+        int((residue_ok & read_ok[:, None]).sum()),
+        int((~residue_ok & read_ok[:, None]).sum()),
+    )
     return total, mism, rg_names, gl, dev
 
 

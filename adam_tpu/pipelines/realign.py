@@ -29,6 +29,7 @@ Faithful semantics of the reference's ``rdd/read/realignment/`` +
 
 from __future__ import annotations
 
+import logging
 import random
 from dataclasses import dataclass, replace as dc_replace
 from functools import partial
@@ -633,6 +634,14 @@ def realign_indels(
             reversed(outcomes), key=lambda x: x[0]
         )
         lod = (pre_total - best_total) / 10.0
+        # per-target decision logs, the RealignIndels.scala:317-379 trail
+        _log = logging.getLogger(__name__)
+        _log.debug(
+            "On target %d [%d, %d), before realignment, sum was %d; "
+            "best consensus %d has sum %d (LOD %.2f)",
+            t, ref_start, ref_start + len(reference), pre_total,
+            best_ci, best_total, lod,
+        )
         realigned = {}
         if lod > lod_threshold:
             cons = consensuses[best_ci]

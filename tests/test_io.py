@@ -297,3 +297,46 @@ def test_duplicate_md_tag_last_wins(tmp_path):
     )
     ds = ctx.load_alignments(str(p))
     assert ds.sidecar.md[0] == "2A1"
+
+
+def test_paired_fastq_stringency(tmp_path):
+    """ValidationStringency on paired export (adamSaveAsPairedFastq,
+    AlignmentRecordRDDFunctions.scala:386-464): STRICT raises on
+    unpaired names, LENIENT writes only the proper pairs."""
+    from adam_tpu.formats.batch import pack_reads
+    from adam_tpu.io import fastq
+
+    base = schema.FLAG_PAIRED
+    records = [
+        dict(name="p1", flags=base | schema.FLAG_FIRST_OF_PAIR, seq="ACGT",
+             qual="IIII", cigar="*", contig_idx=-1, start=-1, mapq=255),
+        dict(name="p1", flags=base | schema.FLAG_SECOND_OF_PAIR, seq="TTTT",
+             qual="IIII", cigar="*", contig_idx=-1, start=-1, mapq=255),
+        dict(name="orphan", flags=base | schema.FLAG_FIRST_OF_PAIR, seq="GGGG",
+             qual="IIII", cigar="*", contig_idx=-1, start=-1, mapq=255),
+    ]
+    batch, side = pack_reads(records)
+    p1, p2 = tmp_path / "r1.fq", tmp_path / "r2.fq"
+
+    with pytest.raises(ValueError, match="exactly twice"):
+        fastq.write_paired_fastq(str(p1), str(p2), batch, side,
+                                 stringency="strict")
+
+    fastq.write_paired_fastq(str(p1), str(p2), batch, side,
+                             stringency="lenient")
+    assert p1.read_text().count("@") == 1  # orphan dropped
+    assert "GGGG" not in p1.read_text()
+    assert p2.read_text().count("@") == 1
+
+
+def test_interleaved_fastq_stringency(tmp_path):
+    bad = tmp_path / "bad.ifq"
+    bad.write_text(
+        "@a/1\nACGT\n+\nIIII\n@b/2\nTTTT\n+\nIIII\n"
+    )
+    from adam_tpu.io import fastq
+
+    with pytest.raises(ValueError, match="pair mismatch"):
+        fastq.read_interleaved_fastq(str(bad), stringency="strict")
+    batch, side, _ = fastq.read_interleaved_fastq(str(bad), stringency="lenient")
+    assert int(np.asarray(batch.valid).sum()) == 2
