@@ -37,7 +37,8 @@ class AlignmentDataset:
 
         return context.load_alignments(path, **kw)
 
-    def save(self, path: str, sort_order: Optional[str] = None) -> None:
+    def save(self, path: str, sort_order: Optional[str] = None,
+             compression: str = "snappy") -> None:
         """Dispatch on extension like adamSave/adamSAMSave."""
         p = str(path)
         if p.endswith(".sam"):
@@ -55,7 +56,8 @@ class AlignmentDataset:
         else:
             from adam_tpu.io import parquet
 
-            parquet.save_alignments(p, self.batch, self.sidecar, self.header)
+            parquet.save_alignments(p, self.batch, self.sidecar, self.header,
+                                    compression=compression)
 
     def save_paired_fastq(
         self, path1: str, path2: str, stringency="lenient"

@@ -268,7 +268,18 @@ class Transform(Command):
                 ds = ds.sort_by_reference_position()
 
         with ins.TIMERS.time(ins.SAVE_OUTPUT):
-            ds.save(args.output)
+            if args.sort_fastq_output and str(args.output).endswith(
+                (".fq", ".fastq")
+            ):
+                # adamSaveAsFastq(sort=true): name-sorted FASTQ export
+                import numpy as np
+
+                from adam_tpu.formats.strings import StringColumn
+
+                names = StringColumn.of(ds.sidecar.names).to_fixed_bytes()
+                order = np.argsort(names, kind="stable")
+                ds = ds.take_rows(order)
+            ds.save(args.output, compression=args.parquet_compression_codec)
         return 0
 
 

@@ -354,6 +354,28 @@ def save_genotypes(path: str, variants, genotypes, seq_dict,
                    compression=compression)
 
 
+def _likelihood_matrix(col, m: int, what: str) -> np.ndarray:
+    """Genotype likelihood lists -> i32[m, 3], tolerating externally
+    produced files whose lists are not exactly length 3 (padded with 0 /
+    truncated, with a clear warning) instead of an opaque reshape error."""
+    if not m:
+        return np.zeros((0, 3), np.int32)
+    rows = col.to_pylist()
+    if all(r is not None and len(r) == 3 for r in rows):
+        return np.array(rows, np.int32).reshape(m, 3)
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "%s: lists are not uniformly length 3; padding/truncating "
+        "(bi-allelic PL layout expected)", what,
+    )
+    out = np.zeros((m, 3), np.int32)
+    for i, r in enumerate(rows):
+        if r:
+            out[i, : min(3, len(r))] = r[:3]
+    return out
+
+
 def load_genotypes(path: str, contig_names=None):
     """-> (VariantBatch, GenotypeBatch, SequenceDictionary).
 
@@ -432,16 +454,17 @@ def load_genotypes(path: str, contig_names=None):
             ],
             axis=1,
         ) if m else np.zeros((0, 2), np.int8),
-        gq=np.array(gt["genotypeQuality"].to_pylist(), np.int16),
+        gq=np.clip(
+            np.array(gt["genotypeQuality"].to_pylist(), np.int32), 0, 32767
+        ).astype(np.int16),
         dp=np.array(gt["readDepth"].to_pylist(), np.int32),
         ref_depth=np.array(gt["referenceReadDepth"].to_pylist(), np.int32),
         alt_depth=np.array(gt["alternateReadDepth"].to_pylist(), np.int32),
         phased=np.array(gt["isPhased"].to_pylist(), bool),
-        pl=np.array(gt["genotypeLikelihoods"].to_pylist(), np.int32).reshape(m, 3)
-        if m else np.zeros((0, 3), np.int32),
-        nonref_pl=np.array(
-            gt["nonReferenceLikelihoods"].to_pylist(), np.int32
-        ).reshape(m, 3) if m else np.zeros((0, 3), np.int32),
+        pl=_likelihood_matrix(gt["genotypeLikelihoods"], m,
+                              "genotypeLikelihoods"),
+        nonref_pl=_likelihood_matrix(gt["nonReferenceLikelihoods"], m,
+                                     "nonReferenceLikelihoods"),
         split_from_multiallelic=np.array(
             gt["splitFromMultiAllelic"].to_pylist(), bool
         ),

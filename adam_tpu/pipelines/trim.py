@@ -80,7 +80,16 @@ def trim_lengths(mean_quals: np.ndarray, counts: np.ndarray, threshold: int):
     quals = mean_quals[idx]
     below = quals < threshold
     if below.all():
-        # every cycle fails the threshold: the whole read would go
+        # every cycle fails the threshold: the whole read would go —
+        # callers with strict=False then skip the group entirely, so
+        # surface the silent no-op (deviation from pure takeWhile ends)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "trim: every cycle of a read group's quality profile is below "
+            "threshold %d; reads in this group will be left untrimmed "
+            "unless strict", threshold,
+        )
         return len(quals), 0
     return int(np.argmin(below)), int(np.argmin(below[::-1]))
 

@@ -19,14 +19,30 @@ SEPARATOR = "__"
 
 
 def flatten_table(table: pa.Table) -> pa.Table:
-    # expand struct columns one level at a time until none remain;
-    # pyarrow's Table.flatten already names children parent.child — rename
-    # to the reference's `__` separator afterwards
+    # expand struct columns one level at a time until none remain; only
+    # the child columns produced by the expansion get the `__` separator
+    # (literal dots in pre-existing column names are left alone), and a
+    # flattened name colliding with an existing column is an error rather
+    # than a silently dropped column
     while any(pa.types.is_struct(f.type) for f in table.schema):
-        table = table.flatten()
-        table = table.rename_columns(
-            [c.replace(".", SEPARATOR) for c in table.column_names]
-        )
+        cols, names = [], []
+        for field, col in zip(table.schema, table.columns):
+            if pa.types.is_struct(field.type):
+                chunked = col.combine_chunks()
+                for child_field, child in zip(
+                    field.type, chunked.flatten()
+                ):
+                    cols.append(child)
+                    names.append(f"{field.name}{SEPARATOR}{child_field.name}")
+            else:
+                cols.append(col)
+                names.append(field.name)
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(
+                f"flattening collides with existing columns: {sorted(dupes)}"
+            )
+        table = pa.Table.from_arrays(cols, names=names)
     cols, names = [], []
     for name, col in zip(table.column_names, table.columns):
         if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
@@ -36,7 +52,11 @@ def flatten_table(table: pa.Table) -> pa.Table:
             )
         cols.append(col)
         names.append(name)
-    return pa.table(dict(zip(names, cols)))
+    return pa.Table.from_arrays(
+        [pa.array(c) if not isinstance(c, (pa.Array, pa.ChunkedArray)) else c
+         for c in cols],
+        names=names,
+    )
 
 
 def flatten_parquet(in_path: str, out_path: str,
