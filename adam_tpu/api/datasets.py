@@ -59,6 +59,31 @@ class AlignmentDataset:
             parquet.save_alignments(p, self.batch, self.sidecar, self.header,
                                     compression=compression)
 
+    def to_arrow(self):
+        """-> pyarrow Table (AlignmentRecord layout, header in metadata).
+
+        The Spark-embedding seam (BASELINE north star): record batches
+        of this table can cross a py4j/mapPartitions boundary and be
+        reconstructed with :meth:`from_arrow` on either side."""
+        from adam_tpu.io import parquet
+
+        return parquet.to_arrow_alignments(self.batch, self.sidecar, self.header)
+
+    @staticmethod
+    def from_arrow(table_or_batches) -> "AlignmentDataset":
+        """pyarrow Table / RecordBatch(es) -> AlignmentDataset."""
+        import pyarrow as pa
+
+        from adam_tpu.io import parquet
+
+        t = table_or_batches
+        if isinstance(t, pa.RecordBatch):
+            t = pa.Table.from_batches([t])
+        elif isinstance(t, (list, tuple)):
+            t = pa.Table.from_batches(list(t))
+        batch, side, header = parquet.from_arrow_alignments(t)
+        return AlignmentDataset(batch, side, header)
+
     def save_paired_fastq(
         self, path1: str, path2: str, stringency="lenient"
     ) -> None:

@@ -186,6 +186,14 @@ class Transform(Command):
         p.add_argument("-coalesce", type=int, default=-1,
                        help="accepted for parity")
         p.add_argument("-sort_fastq_output", action="store_true")
+        p.add_argument(
+            "-backend", default="tpu", choices=["tpu", "spark"],
+            help="execution backend: 'tpu' runs the pipeline here; "
+            "'spark' is the embedding mode — the caller (a Spark "
+            "mapPartitions closure) ships Arrow record batches through "
+            "AlignmentDataset.from_arrow/to_arrow and this process acts "
+            "as the per-partition kernel executor",
+        )
         p.add_argument("-force_load_bam", action="store_true")
         p.add_argument("-force_load_fastq", action="store_true")
         p.add_argument("-force_load_ifastq", action="store_true")
@@ -195,6 +203,18 @@ class Transform(Command):
     def run(cls, args):
         from adam_tpu.api.datasets import GenotypeDataset
         from adam_tpu.io import context
+
+        if args.backend == "spark":
+            # embedding mode: this process is the per-partition executor;
+            # the Spark driver moves data through the Arrow seam
+            # (AlignmentDataset.from_arrow / to_arrow), not through files
+            print(
+                "transform -backend spark: drive this process from Spark "
+                "mapPartitions via AlignmentDataset.from_arrow(record_batches)"
+                " -> (transforms) -> .to_arrow(); the file-path CLI mode "
+                "only runs with -backend tpu",
+            )
+            return 2
 
         with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):
             if args.force_load_bam:

@@ -120,10 +120,16 @@ def _index_name_array(idx: np.ndarray, names: list[str]) -> "pa.Array":
     return pa.array(lut[np.where(idx >= 0, idx, len(names))], pa.string())
 
 
-def save_alignments(
-    path: str, batch: ReadBatch, side: ReadSidecar, header: SamHeader,
-    compression: str = "snappy",
-) -> None:
+def to_arrow_alignments(
+    batch: ReadBatch, side: ReadSidecar, header: SamHeader,
+) -> "pa.Table":
+    """Columnar batch -> arrow Table in the AlignmentRecord field layout.
+
+    This is the Spark-embedding seam (BASELINE north star): the table's
+    RecordBatches can cross a py4j/mapPartitions boundary, and
+    :func:`from_arrow_alignments` reconstructs the batch on the other
+    side.  Header dictionaries ride along as schema metadata.
+    """
     from adam_tpu.formats.strings import StringColumn
 
     b = batch.to_numpy()
@@ -180,7 +186,14 @@ def save_alignments(
             ),
         }
     )
-    table = table.replace_schema_metadata(_header_meta(header))
+    return table.replace_schema_metadata(_header_meta(header))
+
+
+def save_alignments(
+    path: str, batch: ReadBatch, side: ReadSidecar, header: SamHeader,
+    compression: str = "snappy",
+) -> None:
+    table = to_arrow_alignments(batch, side, header)
     pq.write_table(table, path, compression=compression)
 
 
@@ -201,55 +214,200 @@ def load_alignments(
         essential = {"sequence", "qual", "flags", "cigar", "start", "contig"}
         cols = sorted(set(projection) | essential)
     table = pq.read_table(path, columns=cols, filters=predicate)
+    return from_arrow_alignments(table, round_rows_to=round_rows_to)
+
+
+def _string_column_or(table, name: str, n: int, default=None):
+    from adam_tpu.formats.strings import StringColumn
+
+    if name in table.column_names:
+        return StringColumn.from_arrow(table[name])
+    return StringColumn.from_list([default] * n)
+
+
+def _int_col(table, name: str, n: int, default, dtype):
+    import pyarrow.compute as pc
+
+    if name not in table.column_names:
+        return np.full(n, default, dtype)
+    return np.asarray(
+        pc.fill_null(table[name], default).combine_chunks()
+    ).astype(dtype)
+
+
+def _name_index_col(col, lookup) -> np.ndarray:
+    """Dictionary-index a string column: unique names -> lookup() once."""
+    fixed = col.to_fixed_bytes()
+    uniq, inv = np.unique(fixed, return_inverse=True)
+    idx = np.array(
+        [lookup(u.decode("utf-8", "replace")) if u else -1 for u in uniq],
+        np.int32,
+    )
+    out = idx[inv]
+    return np.where(col.valid, out, -1).astype(np.int32)
+
+
+def _codes_matrix(col, lut: np.ndarray, pad: int):
+    """StringColumn -> (codes u8[N, W], lengths i32[N]) via one LUT pass.
+
+    Fixed-length reads are the overwhelmingly common case, so two fast
+    paths: all-rows-uniform-and-contiguous is a single reshape (zero
+    gathers); uniform-but-sparse is one broadcasted gather.  The generic
+    ragged path falls back to the span machinery.
+    """
+    from adam_tpu.formats.strings import (
+        _span_gather_indices,
+        _span_local_positions,
+    )
+
+    lens = np.where(col.valid, col.lengths(), 0)
+    n = len(lens)
+    w = max(1, int(lens.max()) if n else 1)
+    if n and lens.sum():
+        nz = np.flatnonzero(lens > 0)
+        u0 = lens[nz[0]]
+        uniform = (lens[nz] == u0).all()
+        if uniform and len(nz) == n and int(col.offsets[-1]) == n * int(u0) \
+                and int(u0) == w:
+            vals = col.buf[: n * w].reshape(n, w)
+            mat = lut[vals] if lut is not None else vals.copy()
+            return mat, lens.astype(np.int32)
+        mat = np.full((n, w), pad, np.uint8)
+        if uniform:
+            w0 = int(u0)
+            src = (
+                col.offsets[nz][:, None] + np.arange(w0, dtype=np.int64)
+            ).ravel()
+            vals = col.buf[src].reshape(len(nz), w0)
+            mat[nz, :w0] = lut[vals] if lut is not None else vals
+        else:
+            src = _span_gather_indices(col.offsets[:-1], lens)
+            rows = np.repeat(np.arange(n), lens)
+            pos = _span_local_positions(lens)
+            mat[rows, pos] = (
+                lut[col.buf[src]] if lut is not None else col.buf[src]
+            )
+        return mat, lens.astype(np.int32)
+    return np.full((n, w), pad, np.uint8), lens.astype(np.int32)
+
+
+def from_arrow_alignments(
+    table, round_rows_to: int = 1
+) -> tuple[ReadBatch, ReadSidecar, SamHeader]:
+    """Arrow Table (AlignmentRecord layout) -> columnar batch — fully
+    vectorized: LUT passes for sequences/quals, native (or numpy-loop
+    fallback) CIGAR column parse, dictionary-indexed name columns.  The
+    inverse of :func:`to_arrow_alignments` and the import half of the
+    Spark/Arrow embedding seam."""
+    from adam_tpu import native
+    from adam_tpu.formats.strings import StringColumn
+
     header = _header_from_meta(table.schema.metadata)
     sd, rgd = header.seq_dict, header.read_groups
+    n = table.num_rows
 
-    def col(name, default=None):
-        if name in table.column_names:
-            return table[name].to_pylist()
-        return [default] * table.num_rows
+    seq_col = _string_column_or(table, "sequence", n)
+    qual_col = _string_column_or(table, "qual", n)
+    bases, lengths = _codes_matrix(seq_col, schema.BASE_ENCODE_LUT,
+                                   schema.BASE_PAD)
+    lmax = bases.shape[1]
+    quals_mat, qlens = _codes_matrix(qual_col, None, 0)
+    has_qual = qual_col.valid & (qlens > 0) & ~(
+        (qlens == 1) & (quals_mat[:, 0] == ord("*"))
+    )
+    quals = np.full((n, lmax), schema.QUAL_PAD, np.uint8)
+    w = min(lmax, quals_mat.shape[1])
+    qmask = (np.arange(w)[None, :] < qlens[:, None]) & has_qual[:, None]
+    quals[:, :w][qmask] = (quals_mat[:, :w][qmask] - schema.SANGER_OFFSET)
+    # reads with sequence but no qual get 0-quals over their length
+    noq = ~has_qual
+    inlen = np.arange(lmax)[None, :] < lengths[:, None]
+    quals[noq[:, None] & inlen] = 0
 
-    names_ = col("readName", "")
-    seqs = col("sequence", "")
-    quals = col("qual", "")
-    flags = col("flags", 4)
-    contigs = col("contig")
-    starts = col("start")
-    mapqs = col("mapq", 255)
-    cigars = col("cigar", "*")
-    mate_contigs = col("mateContig")
-    mate_starts = col("mateAlignmentStart")
-    tlens = col("inferredInsertSize", 0)
-    rgs = col("recordGroupName")
-    attrs = col("attributes", "")
-    mds = col("mismatchingPositions")
-    oqs = col("origQual")
-    tfs = col("basesTrimmedFromStart", 0)
-    tfe = col("basesTrimmedFromEnd", 0)
-
-    records = [
-        dict(
-            name=names_[i],
-            flags=flags[i] if flags[i] is not None else 4,
-            contig_idx=sd.index_or(contigs[i]) if contigs[i] else -1,
-            start=starts[i] if starts[i] is not None else -1,
-            mapq=mapqs[i] if mapqs[i] is not None else 255,
-            cigar=cigars[i] or "*",
-            seq=seqs[i] or "",
-            qual=quals[i] or "*",
-            mate_contig_idx=sd.index_or(mate_contigs[i]) if mate_contigs[i] else -1,
-            mate_start=mate_starts[i] if mate_starts[i] is not None else -1,
-            tlen=tlens[i] or 0,
-            read_group_idx=rgd.index_or(rgs[i]) if rgs[i] else -1,
-            attrs=attrs[i] or "",
-            md=mds[i],
-            orig_qual=oqs[i],
-            trimmed_from_start=tfs[i] or 0,
-            trimmed_from_end=tfe[i] or 0,
+    cig_col = _string_column_or(table, "cigar", n)
+    cig_lens_b = np.where(cig_col.valid, cig_col.lengths(), 0)
+    is_digit = (cig_col.buf >= ord("0")) & (cig_col.buf <= ord("9"))
+    n_ops_cap = (
+        np.add.reduceat(
+            (~is_digit).astype(np.int64),
+            np.minimum(cig_col.offsets[:-1], max(len(cig_col.buf) - 1, 0)),
         )
-        for i in range(table.num_rows)
-    ]
-    batch, side = pack_reads(records, round_rows_to=round_rows_to)
+        if len(cig_col.buf) and n
+        else np.zeros(n, np.int64)
+    )
+    # rows with empty spans get garbage from reduceat; zero them
+    n_ops_cap = np.where(cig_lens_b > 0, n_ops_cap, 0)
+    cmax = max(1, int(n_ops_cap.max()) if n else 1)
+    offsets = cig_col.offsets.copy()
+    # invalid rows: collapse their span so the parser sees empty
+    if not cig_col.valid.all():
+        pass  # offsets describe the buffer; invalid rows parse as-is
+    nat = native.cigar_cols(cig_col.buf, offsets, cmax)
+    if nat is not None:
+        cigar_ops, cigar_lens, cigar_n = nat
+        cigar_n = np.where(cig_col.valid, cigar_n, 0).astype(np.int32)
+    else:  # pure-python fallback
+        cigar_ops = np.full((n, cmax), schema.CIGAR_PAD, np.uint8)
+        cigar_lens = np.zeros((n, cmax), np.int32)
+        cigar_n = np.zeros(n, np.int32)
+        for i in range(n):
+            c = cig_col[i]
+            if not c or c == "*":
+                continue
+            o, l, k = schema.encode_cigar(c, cmax)
+            cigar_ops[i], cigar_lens[i], cigar_n[i] = o, l, k
+
+    start = _int_col(table, "start", n, -1, np.int64)
+    flags = _int_col(table, "flags", n, 4, np.int32)
+    # end: prefer the stored column; else start + reference span
+    if "end" in table.column_names:
+        end = _int_col(table, "end", n, -1, np.int64)
+    else:
+        r_consume = schema.CIGAR_CONSUMES_REF[
+            np.minimum(cigar_ops, 15)
+        ].astype(np.int64)
+        rlen = (cigar_lens * r_consume).sum(axis=1)
+        end = np.where(start >= 0, start + rlen, -1)
+
+    batch = ReadBatch(
+        bases=bases,
+        quals=quals,
+        lengths=lengths,
+        flags=flags,
+        contig_idx=_name_index_col(
+            _string_column_or(table, "contig", n), sd.index_or
+        ),
+        start=start,
+        end=end,
+        mapq=_int_col(table, "mapq", n, 255, np.int32),
+        cigar_ops=cigar_ops,
+        cigar_lens=cigar_lens,
+        cigar_n=cigar_n,
+        mate_contig_idx=_name_index_col(
+            _string_column_or(table, "mateContig", n), sd.index_or
+        ),
+        mate_start=_int_col(table, "mateAlignmentStart", n, -1, np.int64),
+        tlen=_int_col(table, "inferredInsertSize", n, 0, np.int32),
+        read_group_idx=_name_index_col(
+            _string_column_or(table, "recordGroupName", n), rgd.index_or
+        ),
+        has_qual=has_qual,
+        valid=np.ones(n, bool),
+    )
+    side = ReadSidecar(
+        names=_string_column_or(table, "readName", n, default=""),
+        attrs=_string_column_or(table, "attributes", n, default=""),
+        md=_string_column_or(table, "mismatchingPositions", n),
+        orig_quals=_string_column_or(table, "origQual", n),
+        trimmed_from_start=_int_col(
+            table, "basesTrimmedFromStart", n, 0, np.int32
+        ),
+        trimmed_from_end=_int_col(table, "basesTrimmedFromEnd", n, 0, np.int32),
+    )
+    if round_rows_to > 1:
+        g = ((n + round_rows_to - 1) // round_rows_to) * round_rows_to
+        if g != n:
+            batch = batch.pad_rows(g)
     return batch, side, header
 
 

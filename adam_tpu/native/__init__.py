@@ -122,6 +122,11 @@ def _lib() -> Optional[ct.CDLL]:
                 _u8p, _i32p, _i32p, _i64p,
                 ct.c_int64, ct.c_int64, ct.c_int64, _i64p, ct.c_int,
             ]
+            lib.cigar_cols.restype = ct.c_int
+            lib.cigar_cols.argtypes = [
+                _u8p, _i64p, ct.c_int64, ct.c_int64,
+                _u8p, _i32p, _i32p, ct.c_int,
+            ]
             _LIB = lib
         except Exception:
             _LOAD_FAILED = True
@@ -390,3 +395,28 @@ def ref_positions(cigar_ops, cigar_lens, cigar_n, start, lmax: int):
         out.ctypes.data_as(_i64p), ct.c_int(_nthreads()),
     )
     return out
+
+
+def cigar_cols(buf: np.ndarray, offsets: np.ndarray, cmax: int):
+    """CIGAR strings (flat u8 buffer + offsets) -> (ops u8[N, C],
+    lens i32[N, C], n_ops i32[N]); None if native unavailable or any row
+    overflows ``cmax``."""
+    lib = _lib()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, np.uint8)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    n = len(offsets) - 1
+    C = max(1, int(cmax))
+    ops = np.empty((n, C), np.uint8)
+    lens = np.empty((n, C), np.int32)
+    n_ops = np.empty(n, np.int32)
+    rc = lib.cigar_cols(
+        _u8_ptr(buf), offsets.ctypes.data_as(_i64p),
+        ct.c_int64(n), ct.c_int64(C),
+        _u8_ptr(ops.reshape(-1)), lens.ctypes.data_as(_i32p),
+        n_ops.ctypes.data_as(_i32p), ct.c_int(_nthreads()),
+    )
+    if rc != 0:
+        return None
+    return ops, lens, n_ops

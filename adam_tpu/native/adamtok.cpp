@@ -14,6 +14,7 @@
 // buffers (attrs/MD/OQ, which can shrink vs. their scan-pass capacity)
 // are compacted serially.
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -543,6 +544,70 @@ extern "C" {
 int adamtok_version() { return 4; }
 
 // ------------------------------------------------------- CIGAR walks ----
+
+// Parse CIGAR strings (flat byte buffer + row offsets, Arrow string
+// layout) into columnar (ops u8[N, C], lens i32[N, C], n_ops i32[N]).
+// '*' or empty rows get n_ops 0.  Returns -1 if any row has more than C
+// ops (caller sized C from a host-side count) — never writes OOB.
+int cigar_cols(const uint8_t* buf, const int64_t* offsets, int64_t N,
+               int64_t C, uint8_t* ops, int32_t* lens, int32_t* n_ops,
+               int nthreads) {
+  static int8_t code[256];
+  static bool init = false;
+  if (!init) {
+    for (int i = 0; i < 256; ++i) code[i] = -1;
+    const char* cs = "MIDNSHP=X";
+    for (int i = 0; cs[i]; ++i) code[uint8_t(cs[i])] = int8_t(i);
+    init = true;
+  }
+  if (nthreads < 1) nthreads = 1;
+  std::atomic<int> bad{0};
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      uint8_t* row_ops = ops + i * C;
+      int32_t* row_lens = lens + i * C;
+      for (int64_t k = 0; k < C; ++k) {
+        row_ops[k] = 15;  // CIGAR_PAD
+        row_lens[k] = 0;
+      }
+      int64_t s = offsets[i], e = offsets[i + 1];
+      int n = 0;
+      if (e - s == 1 && buf[s] == '*') {
+        n_ops[i] = 0;
+        continue;
+      }
+      int64_t num = 0;
+      bool ok = true;
+      for (int64_t p = s; p < e; ++p) {
+        uint8_t ch = buf[p];
+        if (ch >= '0' && ch <= '9') {
+          num = num * 10 + (ch - '0');
+          if (num > INT32_MAX) { ok = false; break; }
+        } else {
+          int8_t c = code[ch];
+          if (c < 0 || n >= C) { ok = false; break; }
+          row_ops[n] = uint8_t(c);
+          row_lens[n] = int32_t(num);
+          num = 0;
+          ++n;
+        }
+      }
+      if (!ok) { bad.store(1); n = 0; }
+      n_ops[i] = n;
+    }
+  };
+  if (nthreads == 1 || N < 4096) {
+    work(0, N);
+  } else {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < nthreads; ++t) {
+      int64_t lo = N * t / nthreads, hi = N * (t + 1) / nthreads;
+      ts.emplace_back(work, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+  }
+  return bad.load() ? -1 : 0;
+}
 
 // Per-base reference positions from columnar CIGARs: out[i, j] = reference
 // position of query base j of read i, or -1 when the base is not aligned

@@ -340,3 +340,28 @@ def test_interleaved_fastq_stringency(tmp_path):
         fastq.read_interleaved_fastq(str(bad), stringency="strict")
     batch, side, _ = fastq.read_interleaved_fastq(str(bad), stringency="lenient")
     assert int(np.asarray(batch.valid).sum()) == 2
+
+
+def test_arrow_roundtrip(ref_resources):
+    """AlignmentDataset <-> pyarrow RecordBatch round-trip (the Spark
+    embedding seam, BASELINE north star)."""
+    import pyarrow as pa
+
+    from adam_tpu.api.datasets import AlignmentDataset
+
+    ds = ctx.load_alignments(str(ref_resources / "small.sam"))
+    table = ds.to_arrow()
+    assert isinstance(table, pa.Table)
+    batches = table.to_batches()
+    ds2 = AlignmentDataset.from_arrow(batches)
+    b1, b2 = ds.batch.to_numpy(), ds2.batch.to_numpy()
+    assert len(ds2) == len(ds)
+    np.testing.assert_array_equal(b1.bases, b2.bases)
+    np.testing.assert_array_equal(b1.quals, b2.quals)
+    np.testing.assert_array_equal(b1.start, b2.start)
+    np.testing.assert_array_equal(b1.flags, b2.flags)
+    np.testing.assert_array_equal(b1.cigar_ops, b2.cigar_ops)
+    np.testing.assert_array_equal(b1.cigar_lens, b2.cigar_lens)
+    assert ds2.seq_dict.names == ds.seq_dict.names
+    assert ds2.sidecar.names == ds.sidecar.names
+    assert ds2.sidecar.md == ds.sidecar.md
