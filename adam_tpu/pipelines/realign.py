@@ -578,31 +578,45 @@ def realign_indels(
             for ri, r in enumerate(to_clean):
                 sweep_tasks.append((t, ri, ci, r, cons_seq))
 
-    # ---- phase 2 (device): one batched sweep over all pairs ----
+    # ---- phase 2 (device): batched sweeps, length-bucketed ----
+    # tasks are grouped into power-of-two (read, consensus) length
+    # buckets so a single max_target_size consensus doesn't inflate
+    # every (read x consensus) pair in the batch (SURVEY §7's
+    # length-bucketed/padded/masked stance), and so the compiled sweep
+    # shapes are stable across inputs for the persistent compile cache
     sweep_results = {}
     if sweep_tasks:
-        lr = max(len(task[3].seq) for task in sweep_tasks)
-        lc = max(len(task[4]) for task in sweep_tasks)
-        lc = max(lc, lr + 1)
-        B = len(sweep_tasks)
-        rc = np.full((B, lr), schema.BASE_PAD, np.uint8)
-        rq = np.zeros((B, lr), np.int32)
-        rl = np.zeros(B, np.int32)
-        cc = np.full((B, lc), schema.BASE_PAD, np.uint8)
-        cl = np.zeros(B, np.int32)
-        for k, (t, ri, ci, r, cons_seq) in enumerate(sweep_tasks):
-            rc[k, : len(r.seq)] = schema.encode_bases(r.seq)
-            rq[k, : len(r.quals)] = r.quals
-            rl[k] = len(r.seq)
-            cc[k, : len(cons_seq)] = schema.encode_bases(cons_seq)
-            cl[k] = len(cons_seq)
-        best_q, best_o = jax.tree.map(
-            np.asarray,
-            sweep_kernel(jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
-                         jnp.asarray(cc), jnp.asarray(cl), lr, lc),
-        )
-        for k, (t, ri, ci, _, _) in enumerate(sweep_tasks):
-            sweep_results[(t, ri, ci)] = (float(best_q[k]), int(best_o[k]))
+        def _pow2(n: int, minimum: int) -> int:
+            return max(minimum, 1 << (max(int(n), 1) - 1).bit_length())
+
+        buckets: dict[tuple[int, int], list] = {}
+        for task in sweep_tasks:
+            lr_b = _pow2(len(task[3].seq), 32)
+            lc_b = _pow2(max(len(task[4]), len(task[3].seq) + 1), 64)
+            buckets.setdefault((lr_b, lc_b), []).append(task)
+
+        for (lr, lc), tasks in buckets.items():
+            B = _pow2(len(tasks), 64)  # stable row counts too
+            rc = np.full((B, lr), schema.BASE_PAD, np.uint8)
+            rq = np.zeros((B, lr), np.int32)
+            rl = np.zeros(B, np.int32)
+            cc = np.full((B, lc), schema.BASE_PAD, np.uint8)
+            cl = np.zeros(B, np.int32)
+            for k, (t, ri, ci, r, cons_seq) in enumerate(tasks):
+                rc[k, : len(r.seq)] = schema.encode_bases(r.seq)
+                rq[k, : len(r.quals)] = r.quals
+                rl[k] = len(r.seq)
+                cc[k, : len(cons_seq)] = schema.encode_bases(cons_seq)
+                cl[k] = len(cons_seq)
+            best_q, best_o = jax.tree.map(
+                np.asarray,
+                sweep_kernel(
+                    jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
+                    jnp.asarray(cc), jnp.asarray(cl), lr, lc,
+                ),
+            )
+            for k, (t, ri, ci, _, _) in enumerate(tasks):
+                sweep_results[(t, ri, ci)] = (float(best_q[k]), int(best_o[k]))
 
     # ---- phase 3 (host): consensus choice + rewrite ----
     for t, (to_clean, consensuses, reference, ref_start, ref_end) in group_ctx.items():
