@@ -32,7 +32,34 @@ class Bam2Adam(Command):
 
     @classmethod
     def run(cls, args):
+        from adam_tpu import native
         from adam_tpu.io import context, parquet
+
+        if str(args.bam).endswith(".bam") and native.available():
+            # streaming path: WGS-scale BAMs never fit in memory; windowed
+            # BGZF decode -> record tokenize -> parquet row groups
+            import pyarrow.parquet as pq
+
+            from adam_tpu.io import sam as sam_io
+
+            writer = None
+            n = 0
+            with ins.TIMERS.time(ins.SAVE_OUTPUT):
+                for batch, side, header in sam_io.iter_bam_batches(args.bam):
+                    table = parquet.to_arrow_alignments(batch, side, header)
+                    if writer is None:
+                        writer = pq.ParquetWriter(
+                            args.adam, table.schema,
+                            compression=args.parquet_compression_codec,
+                        )
+                    writer.write_table(table)
+                    n += table.num_rows
+                if writer is not None:
+                    writer.close()
+            if writer is not None:
+                print(f"bam2adam: streamed {n} reads")
+                return 0
+            # empty BAM: fall through to the whole-file path for the header
 
         with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):
             ds = context.load_alignments(args.bam)

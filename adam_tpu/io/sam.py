@@ -395,6 +395,127 @@ def _parse_bam_tags(buf: bytes) -> list[str]:
     return tags
 
 
+def _parse_bam_header_blob(raw: bytes) -> tuple[SamHeader, int]:
+    """Parse the BAM preamble (magic, header text, reference list) from a
+    decompressed prefix -> (header, records offset).  Raises ValueError
+    when ``raw`` is too short to contain the whole preamble."""
+    if raw[:4] != b"BAM\x01":
+        raise ValueError("not a BAM stream")
+    if len(raw) < 8:
+        raise ValueError("truncated BAM preamble")
+    (l_text,) = struct.unpack_from("<i", raw, 4)
+    if len(raw) < 8 + l_text + 4:
+        raise ValueError("truncated BAM preamble")
+    text = raw[8 : 8 + l_text].decode("utf-8", "replace").rstrip("\x00")
+    off = 8 + l_text
+    (n_ref,) = struct.unpack_from("<i", raw, off)
+    off += 4
+    from adam_tpu.models.dictionaries import SequenceRecord
+
+    recs = []
+    for _ in range(n_ref):
+        if len(raw) < off + 4:
+            raise ValueError("truncated BAM reference list")
+        (l_name,) = struct.unpack_from("<i", raw, off)
+        if len(raw) < off + 4 + l_name + 4:
+            raise ValueError("truncated BAM reference list")
+        name = raw[off + 4 : off + 4 + l_name - 1].decode("ascii")
+        (l_ref,) = struct.unpack_from("<i", raw, off + 4 + l_name)
+        recs.append(SequenceRecord(name, l_ref))
+        off += 4 + l_name + 4
+    header = SamHeader.parse(text.splitlines())
+    if len(header.seq_dict) == 0 and recs:
+        header.seq_dict = SequenceDictionary(tuple(recs))
+    return header, off
+
+
+def iter_bam_batches(
+    path: str,
+    batch_reads: int = 500_000,
+    window_bytes: int = 32 * 1024 * 1024,
+):
+    """Constant-memory streaming BAM reader.
+
+    Yields (ReadBatch, ReadSidecar, SamHeader) chunks of roughly
+    ``batch_reads`` reads (window-granular): compressed windows are read
+    off disk, their
+    *complete* BGZF blocks decompressed (native block-parallel codec),
+    and complete BAM records tokenized, carrying both the compressed and
+    decompressed tails into the next window — so a WGS-scale BAM never
+    has to fit in memory (the role of hadoop-bam's splitting reader).
+    Requires the native codec (raises RuntimeError without it; the
+    whole-file :func:`read_bam` is the fallback path).
+    """
+    from adam_tpu import native
+
+    if not native.available():
+        raise RuntimeError(
+            "iter_bam_batches requires the native codec; "
+            "use read_bam for the pure-Python whole-file path"
+        )
+    with open(path, "rb") as fh:
+        comp_tail = b""
+        raw_tail = b""
+        header = None
+        records_off = 0
+        pending: list[tuple] = []
+        pending_reads = 0
+        eof = False
+        while not eof:
+            chunk = fh.read(window_bytes)
+            if not chunk:
+                eof = True
+            comp = comp_tail + chunk
+            if comp:
+                got = native.bgzf_decompress_partial(comp)
+                if got is None:
+                    raise ValueError(f"{path}: not a BGZF/BAM file")
+                blob, consumed = got
+                if eof and consumed < len(comp):
+                    raise ValueError(f"{path}: truncated BGZF block at EOF")
+                comp_tail = comp[consumed:]
+                raw = raw_tail + blob
+            else:
+                raw = raw_tail
+            if header is None:
+                try:
+                    header, records_off = _parse_bam_header_blob(raw)
+                except ValueError:
+                    if eof:
+                        raise
+                    raw_tail = raw
+                    continue  # need more data for the preamble
+                raw = raw[records_off:]
+            out = native.tokenize_bam(
+                raw, 0, header.read_groups.names, partial=True
+            )
+            if out is None:
+                raise ValueError(f"{path}: malformed BAM records")
+            consumed = out.pop("consumed")
+            if eof and consumed < len(raw):
+                raise ValueError(f"{path}: truncated BAM record at EOF")
+            raw_tail = raw[consumed:]
+            n = len(out["flags"])
+            if n:
+                pending.append(out)
+                pending_reads += n
+            while pending_reads >= batch_reads or (eof and pending):
+                take, taken = [], 0
+                while pending and taken < batch_reads:
+                    take.append(pending.pop(0))
+                    taken += len(take[-1]["flags"])
+                batches = [_columns_to_batch(o, 1) for o in take]
+                if len(batches) == 1:
+                    batch, side = batches[0]
+                else:
+                    batch = ReadBatch.concat([b for b, _ in batches])
+                    side = ReadSidecar.concat([s for _, s in batches])
+                pending_reads -= taken
+                yield batch, side, header
+                if not eof:
+                    break
+
+
 def read_bam(
     path: str, round_rows_to: int = 1
 ) -> tuple[ReadBatch, ReadSidecar, SamHeader]:

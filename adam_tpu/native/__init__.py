@@ -118,6 +118,16 @@ def _lib() -> Optional[ct.CDLL]:
                 + [ct.c_int]
             )
             lib.bamtok_free.argtypes = [ct.c_void_p]
+            lib.bgzf_scan2.restype = ct.c_void_p
+            lib.bgzf_scan2.argtypes = [_u8p, ct.c_int64, ct.c_int]
+            lib.bgzf_consumed.restype = ct.c_int64
+            lib.bgzf_consumed.argtypes = [ct.c_void_p]
+            lib.bamtok_scan2.restype = ct.c_void_p
+            lib.bamtok_scan2.argtypes = [
+                _u8p, ct.c_int64, ct.c_int64, ct.c_int,
+            ]
+            lib.bamtok_consumed.restype = ct.c_int64
+            lib.bamtok_consumed.argtypes = [ct.c_void_p]
             lib.ref_positions.argtypes = [
                 _u8p, _i32p, _i32p, _i64p,
                 ct.c_int64, ct.c_int64, ct.c_int64, _i64p, ct.c_int,
@@ -285,6 +295,30 @@ def bgzf_decompress(data) -> Optional[bytes]:
         lib.bgzf_free(h)
 
 
+def bgzf_decompress_partial(data) -> Optional[tuple[bytes, int]]:
+    """Streaming-window BGZF decode: decompress the *complete* blocks in
+    ``data`` -> (decompressed bytes, input bytes consumed); a truncated
+    final block is left for the caller's next window.  None if the data
+    is not BGZF or the native library is unavailable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    h = lib.bgzf_scan2(_u8_ptr(buf), len(buf), 1)
+    if not h:
+        return None
+    try:
+        nb = ct.c_int64()
+        ob = ct.c_int64()
+        lib.bgzf_dims(h, ct.byref(nb), ct.byref(ob))
+        out = np.empty(max(1, ob.value), np.uint8)
+        if lib.bgzf_fill(h, _u8_ptr(out), _nthreads()) != 0:
+            return None
+        return out[: ob.value].tobytes(), int(lib.bgzf_consumed(h))
+    finally:
+        lib.bgzf_free(h)
+
+
 def bgzf_compress(
     data, level: int = 6, block_size: int = 0xFF00
 ) -> Optional[bytes]:
@@ -310,13 +344,21 @@ def bgzf_compress(
 
 
 def tokenize_bam(raw, records_off: int,
-                 rg_names: Sequence[str]) -> Optional[dict]:
-    """Parse decompressed BAM records into columnar arrays."""
+                 rg_names: Sequence[str],
+                 partial: bool = False) -> Optional[dict]:
+    """Parse decompressed BAM records into columnar arrays.
+
+    With ``partial=True`` (streaming windows) a record truncated at the
+    end of ``raw`` stops the scan instead of failing, and the result
+    carries ``out["consumed"]`` — the byte offset after the last
+    complete record — so the caller can carry the tail into the next
+    window."""
     lib = _lib()
     if lib is None:
         return None
     buf = _as_u8(raw)
-    h = lib.bamtok_scan(_u8_ptr(buf), len(buf), records_off)
+    h = lib.bamtok_scan2(_u8_ptr(buf), len(buf), records_off,
+                         1 if partial else 0)
     if not h:
         return None
     try:
@@ -367,6 +409,7 @@ def tokenize_bam(raw, records_off: int,
         out["attr_buf"] = out["attr_buf"][: ab.value]
         out["md_buf"] = out["md_buf"][: mb.value]
         out["oq_buf"] = out["oq_buf"][: qb.value]
+        out["consumed"] = int(lib.bamtok_consumed(h))
         return out
     finally:
         lib.bamtok_free(h)

@@ -363,6 +363,7 @@ struct BgzfHandle {
   int64_t n;
   std::vector<BgzfBlock> blocks;
   int64_t out_bytes = 0;
+  int64_t consumed = 0;
 };
 
 // returns header length and total block size via *bsize, or -1 if not BGZF
@@ -395,6 +396,7 @@ struct BamHandle {
   std::vector<int64_t> rec_off;  // offset of each record's block_size field
   int64_t name_bytes = 0;
   int64_t tag_bytes = 0;  // capacity estimate for stringified tags
+  int64_t consumed = 0;
   int32_t lmax = 0, cmax = 0;
 };
 
@@ -782,7 +784,10 @@ void samtok_free(void* vh) { delete static_cast<SamHandle*>(vh); }
 
 // ----------------------------------------------------------------- BGZF --
 
-void* bgzf_scan(const uint8_t* buf, int64_t n) {
+// partial_ok: a truncated final block (streaming window) ends the scan
+// instead of failing; bgzf_consumed() then reports how many input bytes
+// belong to complete blocks.
+void* bgzf_scan2(const uint8_t* buf, int64_t n, int partial_ok) {
   auto* h = new BgzfHandle;
   h->buf = buf;
   h->n = n;
@@ -791,6 +796,8 @@ void* bgzf_scan(const uint8_t* buf, int64_t n) {
     int64_t bsize = 0;
     int64_t hl = bgzf_block_header(buf + off, n - off, &bsize);
     if (hl < 0 || bsize < hl + 8 || off + bsize > n) {
+      bool truncated = (hl < 0 && n - off < 18) || (hl >= 0 && off + bsize > n);
+      if (partial_ok && truncated) break;
       delete h;
       return nullptr;
     }
@@ -805,7 +812,16 @@ void* bgzf_scan(const uint8_t* buf, int64_t n) {
     off += bsize;
   }
   h->out_bytes = out;
+  h->consumed = off;
   return h;
+}
+
+void* bgzf_scan(const uint8_t* buf, int64_t n) {
+  return bgzf_scan2(buf, n, 0);
+}
+
+int64_t bgzf_consumed(void* vh) {
+  return static_cast<BgzfHandle*>(vh)->consumed;
 }
 
 void bgzf_dims(void* vh, int64_t* n_blocks, int64_t* out_bytes) {
@@ -927,7 +943,11 @@ int bgzf_compress(const uint8_t* in, int64_t n, int64_t block_size,
 
 // ------------------------------------------------------------------ BAM --
 
-void* bamtok_scan(const uint8_t* buf, int64_t n, int64_t records_off) {
+// partial_ok: a record truncated by the end of a streaming window ends
+// the scan (bamtok_consumed() reports the bytes covered by complete
+// records); structurally malformed records still fail the scan.
+void* bamtok_scan2(const uint8_t* buf, int64_t n, int64_t records_off,
+                   int partial_ok) {
   auto* h = new BamHandle;
   h->buf = buf;
   h->n = n;
@@ -938,6 +958,7 @@ void* bamtok_scan(const uint8_t* buf, int64_t n, int64_t records_off) {
     memcpy(&bs, buf + off, 4);
     if (bs < 32 || off + 4 + bs > n) {
       if (bs == 0) break;
+      if (partial_ok && bs >= 32 && off + 4 + bs > n) break;
       delete h;
       return nullptr;
     }
@@ -962,7 +983,16 @@ void* bamtok_scan(const uint8_t* buf, int64_t n, int64_t records_off) {
     h->tag_bytes += tag_bin * 6 + 48;
     off += 4 + bs;
   }
+  h->consumed = off;
   return h;
+}
+
+void* bamtok_scan(const uint8_t* buf, int64_t n, int64_t records_off) {
+  return bamtok_scan2(buf, n, records_off, 0);
+}
+
+int64_t bamtok_consumed(void* vh) {
+  return static_cast<BamHandle*>(vh)->consumed;
 }
 
 void bamtok_dims(void* vh, int64_t* n_records, int32_t* lmax, int32_t* cmax,
