@@ -113,21 +113,36 @@ def distributed_observe(batch: ReadBatch, residue_ok, is_mismatch, read_ok,
 # --------------------------------------------------------------------------
 # fixed-capacity all_to_all routing, shared by k-mer count and sort
 # --------------------------------------------------------------------------
-def _route_all_to_all(values, dest, n_dev: int, pad):
-    """Send each value to its destination shard; returns the flat array of
-    values received by this shard, padded with ``pad``.
+def _route_all_to_all(values, dest, n_dev: int, pad, cap: int | None = None):
+    """Send each value to its destination shard; returns (received,
+    n_dropped) where ``received`` is the flat array of values landing on
+    this shard (padded with ``pad``).
 
-    Fixed capacity: every shard sends an [n_dev, m] buffer (worst case all
-    m local values to one destination); row d goes to device d.
+    ``cap`` bounds the per-destination send buffer: memory is
+    O(n_dev * cap) per shard instead of the worst-case O(n_dev * m).
+    Values beyond a destination's capacity are dropped and *counted* —
+    callers run with a slack-factor capacity and fall back to the exact
+    worst-case (cap = m) on the rare overflow (psum'd count > 0), so
+    results are always exact.
     """
     m = values.shape[0]
+    if cap is None:
+        cap = m
     order = jnp.argsort(dest)
     vals_sorted = values[order]
     dest_sorted = dest[order]
-    slot = jnp.arange(m) - jnp.searchsorted(dest_sorted, jnp.arange(n_dev))[dest_sorted]
-    buf = jnp.full((n_dev, m), pad, dtype=values.dtype)
-    buf = buf.at[dest_sorted, slot].set(vals_sorted)
-    return jax.lax.all_to_all(buf, SHARD_AXIS, 0, 0).reshape(-1)
+    slot = (
+        jnp.arange(m)
+        - jnp.searchsorted(dest_sorted, jnp.arange(n_dev))[dest_sorted]
+    )
+    fits = slot < cap
+    # overflowing values scatter into a trash slot past the real buffer
+    flat = jnp.full(n_dev * cap + 1, pad, dtype=values.dtype)
+    idx = jnp.where(fits, dest_sorted * cap + slot, n_dev * cap)
+    flat = flat.at[idx].set(vals_sorted)
+    buf = flat[: n_dev * cap].reshape(n_dev, cap)
+    dropped = jax.lax.psum(jnp.sum(~fits), SHARD_AXIS)
+    return jax.lax.all_to_all(buf, SHARD_AXIS, 0, 0).reshape(-1), dropped
 
 
 def _mix_hash(keys):
@@ -141,22 +156,22 @@ def _mix_hash(keys):
 # --------------------------------------------------------------------------
 # k-mer counting with hash-sharded all_to_all
 # --------------------------------------------------------------------------
-@partial(jax.jit, static_argnames=("k", "mesh"))
-def _distributed_kmers_jit(bases, lengths, valid, k: int, mesh):
+@partial(jax.jit, static_argnames=("k", "mesh", "cap"))
+def _distributed_kmers_jit(bases, lengths, valid, k: int, mesh, cap=None):
     n_dev = mesh.devices.size
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
         check_vma=False,
     )
     def run(b, l, v):
         packed, win_valid = kmer_ops.extract_kmers(b, l, v, k)
         keys = jnp.where(win_valid, packed, jnp.int64(-1)).ravel()
         dest = jnp.where(keys >= 0, _mix_hash(keys) % n_dev, jnp.int64(0))
-        mine = _route_all_to_all(keys, dest, n_dev, jnp.int64(-1))
+        mine, dropped = _route_all_to_all(keys, dest, n_dev, jnp.int64(-1), cap)
         s = jnp.sort(mine)
         is_new = jnp.concatenate([jnp.array([True]), s[1:] != s[:-1]])
         is_head = is_new & (s >= 0)
@@ -164,7 +179,7 @@ def _distributed_kmers_jit(bases, lengths, valid, k: int, mesh):
         counts = jax.ops.segment_sum(
             (s >= 0).astype(jnp.int32), seg, num_segments=s.shape[0]
         )
-        return s[None], counts[seg][None], is_head[None]
+        return s[None], counts[seg][None], is_head[None], dropped
 
     return run(bases, lengths, valid)
 
@@ -179,11 +194,19 @@ def distributed_count_kmers(batch: ReadBatch, k: int, mesh=None) -> dict[str, in
     if batch.n_rows == 0:
         return {}
     mesh = mesh or genome_mesh()
-    batch = pad_batch_for_mesh(batch, mesh.devices.size).to_device()
-    s, counts, heads = jax.tree.map(
-        np.asarray,
-        _distributed_kmers_jit(batch.bases, batch.lengths, batch.valid, k, mesh),
+    n_dev = mesh.devices.size
+    batch = pad_batch_for_mesh(batch, n_dev).to_device()
+    # capacity-bounded routing: 4x-uniform slack, exact-worst-case retry
+    m = (batch.n_rows // n_dev) * (batch.lmax - k + 1)
+    cap = min(m, 4 * m // n_dev + 64)
+    s, counts, heads, dropped = _distributed_kmers_jit(
+        batch.bases, batch.lengths, batch.valid, k, mesh, cap
     )
+    if int(dropped) > 0:  # rare: pathological key skew
+        s, counts, heads, dropped = _distributed_kmers_jit(
+            batch.bases, batch.lengths, batch.valid, k, mesh, m
+        )
+    s, counts, heads = np.asarray(s), np.asarray(counts), np.asarray(heads)
     out: dict[str, int] = {}
     for d in range(s.shape[0]):
         keys = s[d][heads[d]]
@@ -196,16 +219,8 @@ def distributed_count_kmers(batch: ReadBatch, k: int, mesh=None) -> dict[str, in
 # --------------------------------------------------------------------------
 # distributed sort (splitter-based all_to_all)
 # --------------------------------------------------------------------------
-@partial(jax.jit, static_argnames=("mesh",))
-def distributed_sort_keys(keys, mesh):
-    """Globally sort an i64 key array sharded across the mesh.
-
-    Sample-splitter strategy: all_gather a per-shard sample, derive
-    n_dev-1 splitters (identical on every shard), route each key to its
-    splitter bucket with a fixed-capacity all_to_all, then sort locally.
-    Returns [n_dev, cap] keys per shard (padded with i64 max) whose
-    concatenation is globally sorted.
-    """
+@partial(jax.jit, static_argnames=("mesh", "cap"))
+def _distributed_sort_jit(keys, mesh, cap=None):
     n_dev = mesh.devices.size
     PAD = jnp.iinfo(jnp.int64).max
 
@@ -213,7 +228,7 @@ def distributed_sort_keys(keys, mesh):
         shard_map,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS),),
-        out_specs=P(SHARD_AXIS),
+        out_specs=(P(SHARD_AXIS), P()),
         check_vma=False,
     )
     def run(local):
@@ -229,10 +244,30 @@ def distributed_sort_keys(keys, mesh):
         idx = (jnp.arange(1, n_dev) * samples.shape[0]) // n_dev
         splitters = samples[idx]
         dest = jnp.searchsorted(splitters, local, side="right")
-        recv = _route_all_to_all(local, dest, n_dev, PAD)
-        return jnp.sort(recv)[None]
+        recv, dropped = _route_all_to_all(local, dest, n_dev, PAD, cap)
+        return jnp.sort(recv)[None], dropped
 
     return run(keys)
+
+
+def distributed_sort_keys(keys, mesh):
+    """Globally sort an i64 key array sharded across the mesh.
+
+    Sample-splitter strategy: all_gather a per-shard sample, derive
+    n_dev-1 splitters (identical on every shard), route each key to its
+    splitter bucket with a capacity-bounded all_to_all (4x-uniform
+    slack, exact-worst-case retry on overflow), then sort locally.
+    Returns [n_dev, cap] keys per shard (padded with i64 max) whose
+    concatenation is globally sorted.
+    """
+    n_dev = mesh.devices.size
+    # shape only — never fetch (keys may span non-addressable devices)
+    m = int(np.prod(keys.shape)) // n_dev
+    cap = min(m, 4 * m // n_dev + 64)
+    out, dropped = _distributed_sort_jit(keys, mesh, cap)
+    if int(dropped) > 0:  # splitters degenerate (heavy key duplication)
+        out, dropped = _distributed_sort_jit(keys, mesh, m)
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,91 @@
+"""Two-process jax.distributed harness (run by test_parallel.py).
+
+Each process contributes one CPU device; the genome mesh spans both, so
+the shard_map collectives (all_gather / all_to_all / psum) in
+adam_tpu.parallel.dist really cross a process boundary over the gRPC
+DCN transport — the single-host simulation of SURVEY §2.6's multi-host
+requirement (the reference's analog: Spark executors shuffling over TCP).
+
+Usage: python multihost_harness.py <coordinator> <num_procs> <proc_id>
+Prints "HARNESS OK <checksum>" on success from every process.
+"""
+
+import os
+import sys
+
+# one CPU device per process, no axon
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+flags.append("--xla_force_host_platform_device_count=1")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coordinator, n_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+
+    from adam_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed(coordinator, n_procs, pid)
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from adam_tpu.parallel import dist
+    from adam_tpu.parallel.mesh import SHARD_AXIS, genome_mesh
+
+    devices = jax.devices()
+    assert len(devices) == n_procs, f"expected {n_procs} devices, got {devices}"
+    mesh = genome_mesh(devices)
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+
+    # ---- distributed sort across processes ----
+    m = 64
+    rng = np.random.default_rng(1234)
+    global_keys = rng.integers(0, 2**40, n_procs * m, dtype=np.int64)
+    local = global_keys[pid * m : (pid + 1) * m]
+    keys = jax.make_array_from_process_local_data(sharding, local)
+    out = dist.distributed_sort_keys(keys, mesh)
+
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(
+        multihost_utils.process_allgather(out, tiled=True)
+    ).ravel()
+    real = gathered[gathered != np.iinfo(np.int64).max]
+    expected = np.sort(global_keys)
+    assert len(real) == len(expected), (len(real), len(expected))
+    assert (real == expected).all(), "distributed sort mismatch"
+
+    # ---- psum-combined flagstat-style reduction across processes ----
+    import jax.numpy as jnp
+    from functools import partial
+    from jax import shard_map
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P(),
+        check_vma=False,
+    )
+    def total(x):
+        return jax.lax.psum(x.sum(), SHARD_AXIS)
+
+    t = total(keys)
+    assert int(t) == int(global_keys.sum()), "psum mismatch"
+
+    print(f"HARNESS OK {int(expected[0]) % 100000}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
