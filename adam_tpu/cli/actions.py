@@ -187,6 +187,13 @@ class Transform(Command):
                        help="accepted for parity")
         p.add_argument("-sort_fastq_output", action="store_true")
         p.add_argument(
+            "-checkpoint_dir", default=None,
+            help="materialize each completed stage to Parquet here and "
+            "resume after the deepest completed stage on rerun (the "
+            "framework's failure-recovery story: stage checkpoint-restart "
+            "over re-shardable columnar stores)",
+        )
+        p.add_argument(
             "-backend", default="tpu", choices=["tpu", "spark"],
             help="execution backend: 'tpu' runs the pipeline here; "
             "'spark' is the embedding mode — the caller (a Spark "
@@ -228,64 +235,89 @@ class Transform(Command):
             else:
                 ds = context.load_alignments(args.input)
 
-        if args.trimReads:
-            with ins.TIMERS.time(ins.TRIM_READS):
-                rg_idx = None
-                if args.trimReadGroup is not None:
-                    rg_idx = ds.header.read_groups.names.index(args.trimReadGroup)
-                from adam_tpu.pipelines import trim
+        stages = []
 
-                ds = trim.trim_reads(
-                    ds, args.trimFromStart, args.trimFromEnd, rg_idx=rg_idx
-                )
+        if args.trimReads:
+            def _trim(ds):
+                with ins.TIMERS.time(ins.TRIM_READS):
+                    rg_idx = None
+                    if args.trimReadGroup is not None:
+                        rg_idx = ds.header.read_groups.names.index(
+                            args.trimReadGroup
+                        )
+                    from adam_tpu.pipelines import trim
+
+                    return trim.trim_reads(
+                        ds, args.trimFromStart, args.trimFromEnd, rg_idx=rg_idx
+                    )
+            stages.append(("trim", _trim))
 
         if args.qualityBasedTrim and args.trimBeforeBQSR:
-            with ins.TIMERS.time(ins.TRIM_READS):
-                ds = ds.trim_low_quality_read_groups(args.qualityThreshold)
+            stages.append((
+                "quality_trim",
+                lambda ds: ds.trim_low_quality_read_groups(
+                    args.qualityThreshold
+                ),
+            ))
 
         if args.mark_duplicate_reads:
-            with ins.TIMERS.time(ins.MARK_DUPLICATES):
-                ds = ds.mark_duplicates()
+            def _markdup(ds):
+                with ins.TIMERS.time(ins.MARK_DUPLICATES):
+                    return ds.mark_duplicates()
+            stages.append(("mark_duplicates", _markdup))
 
         if args.realign_indels:
-            with ins.TIMERS.time(ins.REALIGN_INDELS):
-                kw = dict(
-                    max_indel_size=args.max_indel_size,
-                    max_consensus_number=args.max_consensus_number,
-                    lod_threshold=args.log_odds_threshold,
-                    max_target_size=args.max_target_size,
-                )
-                if args.known_indels:
-                    gt = GenotypeDataset.load(
-                        args.known_indels, contig_names=ds.seq_dict.names
+            def _realign(ds):
+                with ins.TIMERS.time(ins.REALIGN_INDELS):
+                    kw = dict(
+                        max_indel_size=args.max_indel_size,
+                        max_consensus_number=args.max_consensus_number,
+                        lod_threshold=args.log_odds_threshold,
+                        max_target_size=args.max_target_size,
                     )
-                    ds = ds.realign_indels(
-                        consensus_model="knowns",
-                        known_indels=gt.indel_table(), **kw,
-                    )
-                else:
-                    ds = ds.realign_indels(consensus_model="reads", **kw)
+                    if args.known_indels:
+                        gt = GenotypeDataset.load(
+                            args.known_indels, contig_names=ds.seq_dict.names
+                        )
+                        return ds.realign_indels(
+                            consensus_model="knowns",
+                            known_indels=gt.indel_table(), **kw,
+                        )
+                    return ds.realign_indels(consensus_model="reads", **kw)
+            stages.append(("realign_indels", _realign))
 
         if args.recalibrate_base_qualities:
-            with ins.TIMERS.time(ins.BQSR):
-                known = None
-                if args.known_snps:
-                    gt = GenotypeDataset.load(
-                        args.known_snps, contig_names=ds.seq_dict.names
+            def _bqsr(ds):
+                with ins.TIMERS.time(ins.BQSR):
+                    known = None
+                    if args.known_snps:
+                        gt = GenotypeDataset.load(
+                            args.known_snps, contig_names=ds.seq_dict.names
+                        )
+                        known = gt.snp_table()
+                    return ds.recalibrate_base_qualities(
+                        known_snps=known,
+                        dump_observation_table=args.dump_observations,
                     )
-                    known = gt.snp_table()
-                ds = ds.recalibrate_base_qualities(
-                    known_snps=known,
-                    dump_observation_table=args.dump_observations,
-                )
+            stages.append(("bqsr", _bqsr))
 
         if args.qualityBasedTrim and not args.trimBeforeBQSR:
-            with ins.TIMERS.time(ins.TRIM_READS):
-                ds = ds.trim_low_quality_read_groups(args.qualityThreshold)
+            stages.append((
+                "quality_trim",
+                lambda ds: ds.trim_low_quality_read_groups(
+                    args.qualityThreshold
+                ),
+            ))
 
         if args.sort_reads:
-            with ins.TIMERS.time(ins.SORT_READS):
-                ds = ds.sort_by_reference_position()
+            def _sort(ds):
+                with ins.TIMERS.time(ins.SORT_READS):
+                    return ds.sort_by_reference_position()
+            stages.append(("sort", _sort))
+
+        from adam_tpu.pipelines.checkpoint import run_stages
+
+        ds = run_stages(ds, stages, checkpoint_dir=args.checkpoint_dir)
 
         with ins.TIMERS.time(ins.SAVE_OUTPUT):
             if args.sort_fastq_output and str(args.output).endswith(

@@ -119,9 +119,18 @@ def main(argv=None) -> int:
     ins.TIMERS.recording = bool(args.print_metrics)
     try:
         rc = cmd.run(args)
+    except BrokenPipeError:  # e.g. `adam-tpu print ... | head`
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
     finally:
         if args.print_metrics:
-            print(ins.TIMERS.report())
+            try:
+                print(ins.TIMERS.report())
+            except BrokenPipeError:
+                pass
     return int(rc or 0)
 
 

@@ -131,3 +131,29 @@ def shard_rows_by_position(
     part = position_partition(seq_dict, contig_idx, pos, n_shards)
     part = np.where(part >= n_shards, n_shards - 1, part)
     return [np.flatnonzero(part == s) for s in range(n_shards)]
+
+
+def partition_by_contig(contig_idx, n_partitions: int | None = None):
+    """Partition rows by contig (rdd/ReferencePartitioner.scala): every
+    row of a contig lands on the same partition.
+
+    -> i32[N] partition ids in [0, n_partitions); unplaced rows (-1
+    contig) go to the last partition.  Defaults to one partition per
+    contig present.
+    """
+    contig_idx = np.asarray(contig_idx)
+    uniq = np.unique(contig_idx[contig_idx >= 0])
+    if n_partitions is None:
+        n_partitions = max(1, len(uniq)) + 1
+    part = np.where(
+        contig_idx >= 0,
+        contig_idx % max(1, n_partitions - 1),
+        n_partitions - 1,
+    )
+    return part.astype(np.int32)
+
+
+def shard_rows_by_contig(contig_idx, n_shards: int):
+    """Row-index lists per shard under contig partitioning."""
+    part = partition_by_contig(contig_idx, n_shards)
+    return [np.flatnonzero(part == s) for s in range(n_shards)]

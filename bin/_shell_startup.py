@@ -1,0 +1,14 @@
+"""adam-tpu-shell preamble: the `import ADAMContext._` analog."""
+import jax  # noqa: F401
+import numpy as np  # noqa: F401
+
+import adam_tpu  # noqa: F401
+from adam_tpu.api.datasets import (  # noqa: F401
+    AlignmentDataset,
+    FeatureDataset,
+    GenotypeDataset,
+)
+from adam_tpu.io.context import load_alignments  # noqa: F401
+
+print(f"adam_tpu {adam_tpu.__version__} — devices: {jax.devices()}")
+print("loaded: AlignmentDataset, GenotypeDataset, FeatureDataset, load_alignments")

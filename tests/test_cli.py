@@ -263,3 +263,51 @@ def test_plugin_execution(ref_resources, capsys):
 def test_plugin_rejects_non_plugin():
     with pytest.raises(TypeError):
         P.load_plugin("tests.test_cli.run_cli")
+
+
+def test_transform_checkpoint_restart(ref_resources, tmp_path, capsys):
+    """Stage checkpoint-restart: a rerun resumes from the deepest
+    completed stage instead of recomputing (the framework's
+    failure-recovery story)."""
+    import json
+
+    from adam_tpu.cli.main import main
+
+    inp = str(ref_resources / "bqsr1.sam")
+    out1 = str(tmp_path / "o1.adam")
+    ck = str(tmp_path / "ck")
+    rc = main(["transform", inp, out1, "-mark_duplicate_reads",
+               "-sort_reads", "-checkpoint_dir", ck])
+    assert rc == 0
+    manifest = json.loads((tmp_path / "ck" / "MANIFEST.json").read_text())
+    assert manifest["completed"] == ["mark_duplicates", "sort"]
+
+    # corrupt-resume semantics: drop the sort checkpoint; rerun resumes
+    # from mark_duplicates and redoes only sort
+    import shutil
+    shutil.rmtree(tmp_path / "ck" / "sort.adam", ignore_errors=True)
+    (tmp_path / "ck" / "sort.adam").unlink(missing_ok=True)
+    (tmp_path / "ck" / "MANIFEST.json").write_text(
+        json.dumps({"stages": ["mark_duplicates", "sort"],
+                    "completed": ["mark_duplicates"]})
+    )
+    out2 = str(tmp_path / "o2.adam")
+    rc = main(["transform", inp, out2, "-mark_duplicate_reads",
+               "-sort_reads", "-checkpoint_dir", ck])
+    assert rc == 0
+    from adam_tpu.io import context
+    d1 = context.load_alignments(out1)
+    d2 = context.load_alignments(out2)
+    np.testing.assert_array_equal(
+        np.asarray(d1.batch.start), np.asarray(d2.batch.start)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(d1.batch.flags), np.asarray(d2.batch.flags)
+    )
+
+    # changed stage composition invalidates old checkpoints
+    out3 = str(tmp_path / "o3.adam")
+    rc = main(["transform", inp, out3, "-sort_reads", "-checkpoint_dir", ck])
+    assert rc == 0
+    manifest = json.loads((tmp_path / "ck" / "MANIFEST.json").read_text())
+    assert manifest["stages"] == ["sort"]

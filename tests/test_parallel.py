@@ -160,3 +160,15 @@ def test_two_process_distributed():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert "HARNESS OK" in out, f"proc {pid} output:\n{out[-3000:]}"
+
+
+def test_partition_by_contig():
+    """ReferencePartitioner semantics: same contig -> same partition,
+    unplaced rows -> the dedicated last partition."""
+    ci = np.array([0, 1, 0, 2, -1, 1])
+    part = partitioner.partition_by_contig(ci, 3)
+    assert part[0] == part[2]
+    assert part[1] == part[5]
+    assert part[4] == 2
+    shards = partitioner.shard_rows_by_contig(ci, 3)
+    assert sorted(np.concatenate(shards).tolist()) == list(range(6))
