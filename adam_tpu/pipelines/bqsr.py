@@ -384,7 +384,9 @@ def recalibrate_base_qualities(
 
     g = grid_rows(b.n_rows)
     gl = lmax  # _observe_device already grid-aligned the lane count
-    new_quals = np.asarray(
+    from adam_tpu.utils.transfer import device_fetch
+
+    new_quals = device_fetch(
         recalibrate_kernel(
             dev["bases"], dev["quals"], dev["lengths"],
             dev["flags"], dev["read_group_idx"],

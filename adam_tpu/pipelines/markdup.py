@@ -37,17 +37,34 @@ from adam_tpu.formats.strings import StringColumn
 from adam_tpu.ops import cigar as cigar_ops
 
 
-@jax.jit
 def _device_read_columns(b: ReadBatch):
-    """Per-read device kernels: 5' position and quality score."""
-    five_prime = cigar_ops.five_prime_position(
-        b.start, b.end, b.flags, b.cigar_ops, b.cigar_lens, b.cigar_n
+    """Per-read device kernels: 5' position and quality score.
+
+    Only the columns these kernels read are shipped to the device — the
+    base matrix (the biggest column by far) stays on the host.
+    """
+    from functools import partial as _partial
+
+    @_partial(jax.jit, static_argnames=("lmax",))
+    def kernel(start, end, flags, c_ops, c_lens, c_n, lengths, quals,
+               lmax: int):
+        five_prime = cigar_ops.five_prime_position(
+            start, end, flags, c_ops, c_lens, c_n
+        )
+        in_read = jnp.arange(lmax)[None, :] < lengths[:, None]
+        score = jnp.sum(
+            jnp.where(in_read & (quals >= 15), quals, 0).astype(jnp.int32),
+            axis=1,
+        )
+        return five_prime, score
+
+    bb = b.to_numpy()
+    return kernel(
+        jnp.asarray(bb.start), jnp.asarray(bb.end), jnp.asarray(bb.flags),
+        jnp.asarray(bb.cigar_ops), jnp.asarray(bb.cigar_lens),
+        jnp.asarray(bb.cigar_n), jnp.asarray(bb.lengths),
+        jnp.asarray(bb.quals), bb.lmax,
     )
-    in_read = jnp.arange(b.lmax)[None, :] < b.lengths[:, None]
-    score = jnp.sum(
-        jnp.where(in_read & (b.quals >= 15), b.quals, 0).astype(jnp.int32), axis=1
-    )
-    return five_prime, score
 
 
 def _bucket_ids(ds: AlignmentDataset) -> tuple[np.ndarray, int]:
@@ -91,8 +108,10 @@ def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
     n = b.n_rows
     if n == 0:
         return ds
+    from adam_tpu.utils.transfer import device_fetch
+
     five_prime, read_score = jax.tree.map(
-        np.asarray, _device_read_columns(ds.batch.to_device())
+        device_fetch, _device_read_columns(ds.batch)
     )
 
     bucket_of, n_buckets = _bucket_ids(ds)

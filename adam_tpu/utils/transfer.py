@@ -1,0 +1,33 @@
+"""Device->host transfer helpers.
+
+On a hosted/tunneled TPU the device link is the pipeline bottleneck
+(measured 2-30 MB/s, high variance); fetching a large array as several
+row slices on a thread pool roughly doubles sustained throughput by
+keeping multiple transfer RPCs in flight. On directly-attached devices
+the chunking is harmless (PCIe/DMA is far faster than any of this).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+_MIN_CHUNK_BYTES = 8 * 1024 * 1024
+_MAX_THREADS = 8
+
+
+def device_fetch(x, threads: int = _MAX_THREADS) -> np.ndarray:
+    """Fetch a (possibly device-resident) array to host numpy."""
+    nbytes = getattr(x, "nbytes", 0)
+    if nbytes < 2 * _MIN_CHUNK_BYTES or x.ndim == 0:
+        return np.asarray(x)
+    n = x.shape[0]
+    n_chunks = min(threads, max(1, int(nbytes // _MIN_CHUNK_BYTES)), n)
+    if n_chunks <= 1:
+        return np.asarray(x)
+    bounds = [n * i // n_chunks for i in range(n_chunks + 1)]
+    slices = [x[bounds[i]: bounds[i + 1]] for i in range(n_chunks)]
+    with ThreadPoolExecutor(n_chunks) as ex:
+        parts = list(ex.map(np.asarray, slices))
+    return np.concatenate(parts, axis=0)
