@@ -1,0 +1,99 @@
+"""Host-side out-of-core genome shuffle (Arrow/Parquet spill).
+
+SURVEY §2.6: within a pod slice the shuffle role is played by XLA
+collectives over ICI (parallel/dist.py), but data that exceeds device
+(or even host) memory needs a *host-level* exchange — the role Spark's
+TCP shuffle plays for the reference. Here it is: stream columnar batches
+(e.g. from the windowed BAM reader), route every read to its genome-bin
+shard with the cumulative-offset partitioner, and append each shard's
+rows to its own Parquet store through a ParquetWriter. Shards are
+re-shardable, independently loadable (one per host/process over DCN),
+and never require the whole dataset in memory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from adam_tpu.parallel.partitioner import position_partition
+
+
+def shuffle_alignments_to_shards(
+    batches: Iterable,
+    n_shards: int,
+    out_dir: str,
+    compression: str = "snappy",
+) -> list[str]:
+    """Stream (batch, sidecar, header) triples into per-genome-bin shards.
+
+    -> ordered list of shard paths (``shard-00000.adam`` ... plus a final
+    ``shard-unmapped.adam`` when unplaced reads exist). Constant memory:
+    only one streamed batch is resident at a time; each shard grows by
+    Parquet row groups.
+    """
+    import jax
+    import pyarrow.parquet as pq
+
+    from adam_tpu.io.parquet import to_arrow_alignments
+
+    os.makedirs(out_dir, exist_ok=True)
+    writers: dict[int, pq.ParquetWriter] = {}
+    paths: dict[int, str] = {}
+
+    def shard_path(s: int) -> str:
+        name = (
+            f"shard-{s:05d}.adam" if s < n_shards else "shard-unmapped.adam"
+        )
+        return os.path.join(out_dir, name)
+
+    try:
+        for batch, side, header in batches:
+            b = jax.tree.map(np.asarray, batch)
+            valid = np.asarray(b.valid)
+            part = position_partition(
+                header.seq_dict, b.contig_idx, b.start, n_shards
+            )
+            for s in np.unique(part[valid]):
+                rows = np.flatnonzero(valid & (part == s))
+                sub = jax.tree.map(lambda x: x[rows], b)
+                sub_side = side.take(rows)
+                table = to_arrow_alignments(sub, sub_side, header)
+                s = int(s)
+                if s not in writers:
+                    paths[s] = shard_path(s)
+                    writers[s] = pq.ParquetWriter(
+                        paths[s], table.schema, compression=compression
+                    )
+                writers[s].write_table(table)
+    finally:
+        for w in writers.values():
+            w.close()
+    return [paths[s] for s in sorted(paths)]
+
+
+def shuffle_bam_to_shards(
+    bam_path: str,
+    n_shards: int,
+    out_dir: str,
+    batch_reads: int = 500_000,
+    compression: str = "snappy",
+) -> list[str]:
+    """Windowed BAM reader -> genome-bin Parquet shards, end to end out
+    of core (a WGS BAM never resides in memory)."""
+    from adam_tpu.io.sam import iter_bam_batches
+
+    return shuffle_alignments_to_shards(
+        iter_bam_batches(bam_path, batch_reads=batch_reads),
+        n_shards, out_dir, compression=compression,
+    )
+
+
+def iter_shards(paths: Iterable[str]) -> Iterator:
+    """Load shards one at a time -> (ReadBatch, ReadSidecar, SamHeader)."""
+    from adam_tpu.io.parquet import load_alignments
+
+    for p in paths:
+        yield load_alignments(p)
