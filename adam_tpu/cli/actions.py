@@ -181,10 +181,16 @@ class Transform(Command):
         p.add_argument("-qualityBasedTrim", action="store_true")
         p.add_argument("-qualityThreshold", type=int, default=20)
         p.add_argument("-trimBeforeBQSR", action="store_true")
-        p.add_argument("-repartition", type=int, default=-1,
-                       help="accepted for parity")
-        p.add_argument("-coalesce", type=int, default=-1,
-                       help="accepted for parity")
+        p.add_argument(
+            "-repartition", type=int, default=-1,
+            help="no-op: columnar batches have no RDD partition count; "
+            "sharding is chosen by the device mesh (logged when set)",
+        )
+        p.add_argument(
+            "-coalesce", type=int, default=-1,
+            help="no-op: columnar batches have no RDD partition count; "
+            "sharding is chosen by the device mesh (logged when set)",
+        )
         p.add_argument("-sort_fastq_output", action="store_true")
         p.add_argument(
             "-checkpoint_dir", default=None,
@@ -234,6 +240,15 @@ class Transform(Command):
                 ds = context.load_parquet_alignments(args.input)
             else:
                 ds = context.load_alignments(args.input)
+
+        if args.repartition != -1 or args.coalesce != -1:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "-repartition/-coalesce are no-ops here: columnar batches "
+                "have no RDD partition count (sharding follows the device "
+                "mesh)"
+            )
 
         stages = []
 
