@@ -89,6 +89,33 @@ def five_prime_position(start, end, flags, cigar_ops, cigar_lens, cigar_n):
     return jnp.where(rev, ue, us)
 
 
+def five_prime_position_np(start, end, flags, cigar_ops, cigar_lens, cigar_n):
+    """Host (numpy) twin of :func:`five_prime_position` -> i64[N].
+
+    Pipelines whose only device work would be this walk plus a couple of
+    reductions (duplicate marking's key prep) run it host-side: on a
+    tunneled chip the fetch of even small outputs costs more than the
+    whole computation.
+    """
+    import numpy as np
+
+    ops = np.asarray(cigar_ops)
+    lens = np.asarray(cigar_lens).astype(np.int64)
+    n_ops = np.asarray(cigar_n)
+    N, C = ops.shape if ops.ndim == 2 else (len(n_ops), 0)
+    if C == 0:
+        return np.asarray(start).copy()
+    v = np.arange(C)[None, :] < n_ops[:, None]
+    clip = ((ops == schema.CIGAR_S) | (ops == schema.CIGAR_H)) & v
+    lead_run = np.cumprod(clip.astype(np.int64), axis=1)
+    lead = (lens * lead_run).sum(axis=1)
+    run_pred = (clip | ~v).astype(np.int64)
+    trail_run = np.cumprod(run_pred[:, ::-1], axis=1)[:, ::-1]
+    trail = (lens * clip * trail_run).sum(axis=1)
+    rev = (np.asarray(flags) & schema.FLAG_REVERSE) != 0
+    return np.where(rev, np.asarray(end) + trail, np.asarray(start) - lead)
+
+
 def first_real_op(cigar_ops, cigar_n):
     """Code of the first non-clip op, CIGAR_PAD if none."""
     C = cigar_ops.shape[-1]

@@ -38,33 +38,23 @@ from adam_tpu.ops import cigar as cigar_ops
 
 
 def _device_read_columns(b: ReadBatch):
-    """Per-read device kernels: 5' position and quality score.
+    """Per-read key prep: 5' clipped position and quality score.
 
-    Only the columns these kernels read are shipped to the device — the
-    base matrix (the biggest column by far) stays on the host.
+    Runs host-side (vectorized numpy): the computation is two masked
+    reductions, and on a tunneled chip even the small outputs' fetch
+    costs more than computing them locally.  The sharded pipeline's
+    device variant lives in parallel/dist.py.
     """
-    from functools import partial as _partial
-
-    @_partial(jax.jit, static_argnames=("lmax",))
-    def kernel(start, end, flags, c_ops, c_lens, c_n, lengths, quals,
-               lmax: int):
-        five_prime = cigar_ops.five_prime_position(
-            start, end, flags, c_ops, c_lens, c_n
-        )
-        in_read = jnp.arange(lmax)[None, :] < lengths[:, None]
-        score = jnp.sum(
-            jnp.where(in_read & (quals >= 15), quals, 0).astype(jnp.int32),
-            axis=1,
-        )
-        return five_prime, score
-
     bb = b.to_numpy()
-    return kernel(
-        jnp.asarray(bb.start), jnp.asarray(bb.end), jnp.asarray(bb.flags),
-        jnp.asarray(bb.cigar_ops), jnp.asarray(bb.cigar_lens),
-        jnp.asarray(bb.cigar_n), jnp.asarray(bb.lengths),
-        jnp.asarray(bb.quals), bb.lmax,
+    five_prime = cigar_ops.five_prime_position_np(
+        bb.start, bb.end, bb.flags, bb.cigar_ops, bb.cigar_lens, bb.cigar_n
     )
+    quals = np.asarray(bb.quals)
+    in_read = np.arange(bb.lmax)[None, :] < np.asarray(bb.lengths)[:, None]
+    score = np.where(in_read & (quals >= 15), quals, 0).sum(
+        axis=1, dtype=np.int32
+    )
+    return five_prime, score
 
 
 def _bucket_ids(ds: AlignmentDataset) -> tuple[np.ndarray, int]:
