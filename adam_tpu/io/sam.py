@@ -676,6 +676,16 @@ def write_bam(
         body.write(struct.pack("<i", r.length))
     b = batch.to_numpy()
     rg_names = header.read_groups.names
+
+    from adam_tpu import native
+
+    nat = native.bam_encode(b, side, rg_names)
+    if nat is not None:
+        body.write(nat)
+        with open(path, "wb") as fh:
+            fh.write(bgzf_compress(body.getvalue()))
+        return
+
     for i in range(b.n_rows):
         if not b.valid[i]:
             continue

@@ -17,6 +17,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
@@ -541,9 +542,313 @@ int bam_tags_to_text(const uint8_t* t, const uint8_t* te, char* out,
 
 }  // namespace
 
+// ----------------------------------------------------- BAM encoding ----
+
+namespace bamenc {  // NOLINT — internal helpers
+
+// SAM text tag field ("NM:i:5") -> binary BAM tag bytes appended to out
+// (nullptr = size-only pass).  Returns bytes produced, or -1 on a
+// malformed field.
+inline int64_t tag_to_bin(const uint8_t* f, const uint8_t* fe, uint8_t* out) {
+  if (fe - f < 5 || f[2] != ':' || f[4] != ':') return -1;
+  const uint8_t* val = f + 5;
+  int64_t vlen = fe - val;
+  char typ = char(f[3]);
+  int64_t w = 0;
+  auto put8 = [&](uint8_t v) { if (out) out[w] = v; ++w; };
+  auto put_bytes = [&](const uint8_t* p, int64_t n) {
+    if (out) memcpy(out + w, p, size_t(n));
+    w += n;
+  };
+  auto parse_num = [&](const uint8_t* p, const uint8_t* pe, int64_t* ok_v,
+                       bool* ok) {
+    bool o = true;
+    int64_t v = parse_i64(p, pe, &o);
+    *ok = o;
+    *ok_v = v;
+  };
+  put8(f[0]);
+  put8(f[1]);
+  switch (typ) {
+    case 'A':
+      if (vlen != 1) return -1;
+      put8('A');
+      put8(val[0]);
+      break;
+    case 'i': {
+      bool ok;
+      int64_t v;
+      parse_num(val, fe, &v, &ok);
+      if (!ok) return -1;
+      int32_t v32 = int32_t(v);
+      put8('i');
+      put_bytes(reinterpret_cast<uint8_t*>(&v32), 4);
+      break;
+    }
+    case 'f': {
+      float fv = strtof(reinterpret_cast<const char*>(val), nullptr);
+      put8('f');
+      put_bytes(reinterpret_cast<uint8_t*>(&fv), 4);
+      break;
+    }
+    case 'Z':
+    case 'H':
+      put8(uint8_t(typ));
+      put_bytes(val, vlen);
+      put8(0);
+      break;
+    case 'B': {
+      if (vlen < 1) return -1;
+      char sub = char(val[0]);
+      put8('B');
+      put8(uint8_t(sub));
+      // count elements
+      uint32_t cnt = 0;
+      for (const uint8_t* p = val + 1; p < fe; ++p)
+        if (*p == ',') ++cnt;
+      put_bytes(reinterpret_cast<uint8_t*>(&cnt), 4);
+      const uint8_t* p = val + 1;
+      while (p < fe && *p == ',') {
+        ++p;
+        const uint8_t* q = p;
+        while (q < fe && *q != ',') ++q;
+        if (sub == 'f') {
+          float fv = strtof(reinterpret_cast<const char*>(p), nullptr);
+          put_bytes(reinterpret_cast<uint8_t*>(&fv), 4);
+        } else {
+          bool ok;
+          int64_t v;
+          parse_num(p, q, &v, &ok);
+          if (!ok) return -1;
+          switch (sub) {
+            case 'c': case 'C': {
+              uint8_t b = uint8_t(v); put_bytes(&b, 1); break;
+            }
+            case 's': case 'S': {
+              uint16_t s16 = uint16_t(v);
+              put_bytes(reinterpret_cast<uint8_t*>(&s16), 2);
+              break;
+            }
+            case 'i': case 'I': {
+              uint32_t u32 = uint32_t(v);
+              put_bytes(reinterpret_cast<uint8_t*>(&u32), 4);
+              break;
+            }
+            default: return -1;
+          }
+        }
+        p = q;
+      }
+      break;
+    }
+    default:
+      return -1;
+  }
+  return w;
+}
+
+// All tags for one record (attrs text + MD/OQ/RG appended in the writer's
+// order) -> binary; out == nullptr for the size pass.
+inline int64_t tags_to_bin(
+    const uint8_t* attr, int64_t attr_len,
+    const uint8_t* md, int64_t md_len, bool has_md,
+    const uint8_t* oq, int64_t oq_len, bool has_oq,
+    const uint8_t* rg, int64_t rg_len, bool has_rg,
+    uint8_t* out) {
+  int64_t w = 0;
+  const uint8_t* p = attr;
+  const uint8_t* pe = attr + attr_len;
+  while (p < pe) {
+    const uint8_t* q = static_cast<const uint8_t*>(
+        memchr(p, '\t', size_t(pe - p)));
+    const uint8_t* fe = q ? q : pe;
+    if (fe > p) {
+      int64_t n = tag_to_bin(p, fe, out ? out + w : nullptr);
+      if (n < 0) return -1;
+      w += n;
+    }
+    p = q ? q + 1 : pe;
+  }
+  auto put_z = [&](char a, char b, const uint8_t* v, int64_t n) {
+    if (out) {
+      out[w] = uint8_t(a);
+      out[w + 1] = uint8_t(b);
+      out[w + 2] = 'Z';
+      memcpy(out + w + 3, v, size_t(n));
+      out[w + 3 + n] = 0;
+    }
+    w += n + 4;
+  };
+  if (has_md) put_z('M', 'D', md, md_len);
+  if (has_oq) put_z('O', 'Q', oq, oq_len);
+  if (has_rg) put_z('R', 'G', rg, rg_len);
+  return w;
+}
+
+}  // namespace bamenc
+
 extern "C" {
 
-int adamtok_version() { return 4; }
+int adamtok_version() { return 5; }
+
+// -------------------------------------------------------- BAM encode ----
+
+// Encode valid rows into a BAM record stream (the inverse of
+// bamtok_fill; tags from the stringified attrs + MD/OQ/RG sidecars).
+// Two passes: per-record sizes (threaded) -> exclusive offsets -> fill
+// (threaded).  Returns bytes written, -1 on malformed tag text, -2 if
+// ``cap`` is too small.
+int64_t bam_encode(
+    const int32_t* flags, const int32_t* contig_idx, const int64_t* start,
+    const int32_t* mapq, const int32_t* mate_contig_idx,
+    const int64_t* mate_start, const int32_t* tlen, const int32_t* lengths,
+    const uint8_t* has_qual, const uint8_t* valid,
+    const uint8_t* bases, const uint8_t* quals, int64_t lmax,
+    const uint8_t* cigar_ops, const int32_t* cigar_lens,
+    const int32_t* cigar_n, int64_t cmax,
+    const uint8_t* name_buf, const int64_t* name_off,
+    const uint8_t* attr_buf, const int64_t* attr_off,
+    const uint8_t* md_buf, const int64_t* md_off, const uint8_t* md_present,
+    const uint8_t* oq_buf, const int64_t* oq_off, const uint8_t* oq_present,
+    const int32_t* rg_idx, const uint8_t* rg_buf, const int64_t* rg_off,
+    int32_t n_rgs, int64_t N, uint8_t* out, int64_t cap, int nthreads) {
+  static const uint8_t kNib[6] = {1, 2, 4, 8, 15, 0};  // A C G T N PAD
+  if (nthreads < 1) nthreads = 1;
+  std::vector<int64_t> sizes(size_t(N) + 1, 0);
+  std::atomic<int> bad{0};
+
+  auto tag_parts = [&](int64_t i, const uint8_t** a, int64_t* al,
+                       const uint8_t** md, int64_t* mdl, bool* hmd,
+                       const uint8_t** oq, int64_t* oql, bool* hoq,
+                       const uint8_t** rg, int64_t* rgl, bool* hrg) {
+    *a = attr_buf + attr_off[i];
+    *al = attr_off[i + 1] - attr_off[i];
+    *hmd = md_present[i] != 0;
+    *md = md_buf + md_off[i];
+    *mdl = md_off[i + 1] - md_off[i];
+    *hoq = oq_present[i] != 0;
+    *oq = oq_buf + oq_off[i];
+    *oql = oq_off[i + 1] - oq_off[i];
+    int32_t r = rg_idx[i];
+    *hrg = r >= 0 && r < n_rgs;
+    if (*hrg) {
+      *rg = rg_buf + rg_off[r];
+      *rgl = rg_off[r + 1] - rg_off[r];
+    } else {
+      *rg = nullptr;
+      *rgl = 0;
+    }
+  };
+
+  auto size_one = [&](int64_t i) -> int64_t {
+    if (!valid[i]) return 0;
+    const uint8_t *a, *md, *oq, *rg;
+    int64_t al, mdl, oql, rgl;
+    bool hmd, hoq, hrg;
+    tag_parts(i, &a, &al, &md, &mdl, &hmd, &oq, &oql, &hoq, &rg, &rgl, &hrg);
+    int64_t tagsz = bamenc::tags_to_bin(a, al, md, mdl, hmd, oq, oql, hoq,
+                                        rg, rgl, hrg, nullptr);
+    if (tagsz < 0) return -1;
+    int64_t L = lengths[i];
+    int64_t nm = name_off[i + 1] - name_off[i];
+    return 4 + 32 + nm + 1 + 4 * int64_t(cigar_n[i]) + (L + 1) / 2 + L +
+           tagsz;
+  };
+
+  {
+    auto work = [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; ++i) {
+        int64_t s = size_one(i);
+        if (s < 0) { bad.store(1); return; }
+        sizes[size_t(i) + 1] = s;
+      }
+    };
+    if (nthreads == 1 || N < 4096) {
+      work(0, N);
+    } else {
+      std::vector<std::thread> ts;
+      for (int t = 0; t < nthreads; ++t)
+        ts.emplace_back(work, N * t / nthreads, N * (t + 1) / nthreads);
+      for (auto& t : ts) t.join();
+    }
+  }
+  if (bad.load()) return -1;
+  for (int64_t i = 0; i < N; ++i) sizes[size_t(i) + 1] += sizes[size_t(i)];
+  int64_t total = sizes[size_t(N)];
+  if (total > cap) return -2;
+
+  auto fill_one = [&](int64_t i) {
+    if (!valid[i]) return;
+    uint8_t* w = out + sizes[size_t(i)];
+    int64_t block = sizes[size_t(i) + 1] - sizes[size_t(i)] - 4;
+    int32_t bs32 = int32_t(block);
+    memcpy(w, &bs32, 4); w += 4;
+    int64_t nm = name_off[i + 1] - name_off[i];
+    int64_t L = lengths[i];
+    int32_t hdr[4];
+    hdr[0] = contig_idx[i];
+    hdr[1] = start[i] >= 0 ? int32_t(start[i]) : -1;
+    memcpy(w, hdr, 8); w += 8;
+    *w++ = uint8_t(nm + 1);
+    *w++ = uint8_t(mapq[i] & 0xFF);
+    uint16_t bin16 = 0;
+    memcpy(w, &bin16, 2); w += 2;
+    uint16_t nc16 = uint16_t(cigar_n[i]);
+    memcpy(w, &nc16, 2); w += 2;
+    uint16_t fl16 = uint16_t(flags[i] & 0xFFFF);
+    memcpy(w, &fl16, 2); w += 2;
+    int32_t l32 = int32_t(L);
+    memcpy(w, &l32, 4); w += 4;
+    int32_t mc = mate_contig_idx[i];
+    memcpy(w, &mc, 4); w += 4;
+    int32_t mp = mate_start[i] >= 0 ? int32_t(mate_start[i]) : -1;
+    memcpy(w, &mp, 4); w += 4;
+    int32_t tl32 = tlen[i];
+    memcpy(w, &tl32, 4); w += 4;
+    memcpy(w, name_buf + name_off[i], size_t(nm)); w += nm;
+    *w++ = 0;
+    for (int32_t k = 0; k < cigar_n[i]; ++k) {
+      uint32_t c = (uint32_t(cigar_lens[i * cmax + k]) << 4) |
+                   (cigar_ops[i * cmax + k] & 0xF);
+      memcpy(w, &c, 4); w += 4;
+    }
+    const uint8_t* bs = bases + i * lmax;
+    for (int64_t j = 0; j + 1 < L + 1; j += 2) {
+      uint8_t hi = kNib[bs[j] > 5 ? 5 : bs[j]];
+      uint8_t lo = (j + 1 < L) ? kNib[bs[j + 1] > 5 ? 5 : bs[j + 1]] : 0;
+      *w++ = uint8_t((hi << 4) | lo);
+    }
+    const uint8_t* q = quals + i * lmax;
+    if (has_qual[i]) {
+      for (int64_t j = 0; j < L; ++j)
+        *w++ = (q[j] == QUAL_PAD) ? 0xFF : q[j];
+    } else {
+      memset(w, 0xFF, size_t(L));
+      w += L;
+    }
+    const uint8_t *a, *md, *oq, *rg;
+    int64_t al, mdl, oql, rgl;
+    bool hmd, hoq, hrg;
+    tag_parts(i, &a, &al, &md, &mdl, &hmd, &oq, &oql, &hoq, &rg, &rgl, &hrg);
+    bamenc::tags_to_bin(a, al, md, mdl, hmd, oq, oql, hoq, rg, rgl, hrg, w);
+  };
+
+  {
+    auto work = [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; ++i) fill_one(i);
+    };
+    if (nthreads == 1 || N < 4096) {
+      work(0, N);
+    } else {
+      std::vector<std::thread> ts;
+      for (int t = 0; t < nthreads; ++t)
+        ts.emplace_back(work, N * t / nthreads, N * (t + 1) / nthreads);
+      for (auto& t : ts) t.join();
+    }
+  }
+  return total;
+}
+
 
 // ------------------------------------------------------- CIGAR walks ----
 
