@@ -284,11 +284,19 @@ def write_sam(
     header: SamHeader,
     sort_order: Optional[str] = None,
 ) -> None:
-    with open(path, "w") as fh:
+    from adam_tpu import native
+
+    with open(path, "wb") as fh:
         for line in header.to_lines(sort_order=sort_order):
-            fh.write(line + "\n")
+            fh.write(line.encode("utf-8") + b"\n")
+        nat = native.sam_encode(
+            batch, side, header.read_groups.names, header.seq_dict.names
+        )
+        if nat is not None:
+            fh.write(nat)
+            return
         for line in format_sam_records(batch, side, header):
-            fh.write(line + "\n")
+            fh.write(line.encode("utf-8") + b"\n")
 
 
 # --------------------------------------------------------------------------

@@ -137,6 +137,20 @@ def _lib() -> Optional[ct.CDLL]:
                 _u8p, _i64p, ct.c_int64, ct.c_int64,
                 _u8p, _i32p, _i32p, ct.c_int,
             ]
+            lib.sam_encode.restype = ct.c_int64
+            lib.sam_encode.argtypes = [
+                _i32p, _i32p, _i64p, _i32p, _i32p, _i64p, _i32p, _i32p,
+                _u8p, _u8p,
+                _u8p, _u8p, ct.c_int64,
+                _u8p, _i32p, _i32p, ct.c_int64,
+                _u8p, _i64p,
+                _u8p, _i64p,
+                _u8p, _i64p, _u8p,
+                _u8p, _i64p, _u8p,
+                _i32p, _u8p, _i64p, ct.c_int32,
+                _u8p, _i64p, ct.c_int32,
+                ct.c_int64, _u8p, ct.c_int64, ct.c_int,
+            ]
             lib.bam_encode.restype = ct.c_int64
             lib.bam_encode.argtypes = [
                 _i32p, _i32p, _i64p, _i32p, _i32p, _i64p, _i32p, _i32p,
@@ -551,6 +565,83 @@ def bam_encode(batch, side, rg_names: Sequence[str]) -> Optional[bytes]:
         _u8_ptr(oq_valid),
         c32(b.read_group_idx).ctypes.data_as(_i32p),
         _u8_ptr(gbuf), goff.ctypes.data_as(_i64p), ct.c_int32(len(rg_names)),
+        ct.c_int64(n), _u8_ptr(out), ct.c_int64(cap), ct.c_int(_nthreads()),
+    )
+    if got < 0:
+        return None
+    return out[:got].tobytes()
+
+
+def sam_encode(batch, side, rg_names: Sequence[str],
+               contig_names: Sequence[str]) -> Optional[bytes]:
+    """Format a (ReadBatch, ReadSidecar) as SAM text lines (no header);
+    None -> caller falls back to the pure-Python formatter."""
+    lib = _lib()
+    if lib is None:
+        return None
+    from adam_tpu.formats.strings import StringColumn
+
+    import jax
+
+    b = jax.tree.map(lambda x: np.asarray(x), batch)
+    n = b.n_rows
+    names = StringColumn.of(side.names)
+    attrs = StringColumn.of(side.attrs)
+    md = StringColumn.of(side.md)
+    oq = StringColumn.of(side.orig_quals)
+    if len(names) < n or len(attrs) < n or len(md) < n or len(oq) < n:
+        return None
+    gbuf, goff = _str_dict(rg_names)
+    cbuf, coff = _str_dict(contig_names)
+
+    def c64(x):
+        return np.ascontiguousarray(x, np.int64)
+
+    def c32(x):
+        return np.ascontiguousarray(x, np.int32)
+
+    def cu8(x):
+        return np.ascontiguousarray(x, np.uint8)
+
+    lens = np.where(b.valid, b.lengths, 0).astype(np.int64)
+    max_name = (max((len(s) for s in contig_names), default=1) + 2) * 2
+    cap = int(
+        n * (140 + max_name)
+        + int(names.offsets[-1])
+        + 12 * int(np.asarray(b.cigar_n, np.int64).sum())
+        + int(lens.sum()) * 2
+        + int(attrs.offsets[-1]) + int(md.offsets[-1]) + int(oq.offsets[-1])
+        + (max((len(s) for s in rg_names), default=0) + 8) * n
+    )
+    out = np.empty(cap, np.uint8)
+    got = lib.sam_encode(
+        c32(b.flags).ctypes.data_as(_i32p),
+        c32(b.contig_idx).ctypes.data_as(_i32p),
+        c64(b.start).ctypes.data_as(_i64p),
+        c32(b.mapq).ctypes.data_as(_i32p),
+        c32(b.mate_contig_idx).ctypes.data_as(_i32p),
+        c64(b.mate_start).ctypes.data_as(_i64p),
+        c32(b.tlen).ctypes.data_as(_i32p),
+        c32(b.lengths).ctypes.data_as(_i32p),
+        _u8_ptr(cu8(np.asarray(b.has_qual))),
+        _u8_ptr(cu8(np.asarray(b.valid))),
+        _u8_ptr(cu8(b.bases).reshape(-1)),
+        _u8_ptr(cu8(b.quals).reshape(-1)),
+        ct.c_int64(b.lmax),
+        _u8_ptr(cu8(b.cigar_ops).reshape(-1)),
+        c32(b.cigar_lens).ctypes.data_as(_i32p),
+        c32(b.cigar_n).ctypes.data_as(_i32p),
+        ct.c_int64(b.cmax),
+        _u8_ptr(names.buf), names.offsets.ctypes.data_as(_i64p),
+        _u8_ptr(attrs.buf), attrs.offsets.ctypes.data_as(_i64p),
+        _u8_ptr(md.buf), md.offsets.ctypes.data_as(_i64p),
+        _u8_ptr(cu8(np.asarray(md.valid))),
+        _u8_ptr(oq.buf), oq.offsets.ctypes.data_as(_i64p),
+        _u8_ptr(cu8(np.asarray(oq.valid) & (oq.lengths() > 0))),
+        c32(b.read_group_idx).ctypes.data_as(_i32p),
+        _u8_ptr(gbuf), goff.ctypes.data_as(_i64p), ct.c_int32(len(rg_names)),
+        _u8_ptr(cbuf), coff.ctypes.data_as(_i64p),
+        ct.c_int32(len(contig_names)),
         ct.c_int64(n), _u8_ptr(out), ct.c_int64(cap), ct.c_int(_nthreads()),
     )
     if got < 0:

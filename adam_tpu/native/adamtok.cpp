@@ -691,6 +691,145 @@ extern "C" {
 
 int adamtok_version() { return 5; }
 
+// -------------------------------------------------------- SAM encode ----
+
+// Format valid rows as SAM text lines (the writer's format_sam_records
+// semantics: 1-based positions with 0 for unplaced, '=' RNEXT
+// shortening, MD/OQ/RG tags appended after the raw attrs).  Two passes
+// like bam_encode.  Returns bytes written, -2 if cap too small.
+int64_t sam_encode(
+    const int32_t* flags, const int32_t* contig_idx, const int64_t* start,
+    const int32_t* mapq, const int32_t* mate_contig_idx,
+    const int64_t* mate_start, const int32_t* tlen, const int32_t* lengths,
+    const uint8_t* has_qual, const uint8_t* valid,
+    const uint8_t* bases, const uint8_t* quals, int64_t lmax,
+    const uint8_t* cigar_ops, const int32_t* cigar_lens,
+    const int32_t* cigar_n, int64_t cmax,
+    const uint8_t* name_buf, const int64_t* name_off,
+    const uint8_t* attr_buf, const int64_t* attr_off,
+    const uint8_t* md_buf, const int64_t* md_off, const uint8_t* md_present,
+    const uint8_t* oq_buf, const int64_t* oq_off, const uint8_t* oq_present,
+    const int32_t* rg_idx, const uint8_t* rg_buf, const int64_t* rg_off,
+    int32_t n_rgs,
+    const uint8_t* ctg_buf, const int64_t* ctg_off, int32_t n_ctgs,
+    int64_t N, uint8_t* out, int64_t cap, int nthreads) {
+  static const char kBase[6] = {'A', 'C', 'G', 'T', 'N', '.'};
+  if (nthreads < 1) nthreads = 1;
+  std::vector<int64_t> sizes(size_t(N) + 1, 0);
+
+  auto emit = [&](int64_t i, uint8_t* w) -> int64_t {
+    // w == nullptr: size-only
+    int64_t n_w = 0;
+    auto put = [&](const uint8_t* p, int64_t n) {
+      if (w) memcpy(w + n_w, p, size_t(n));
+      n_w += n;
+    };
+    auto putc_ = [&](char c) {
+      if (w) w[n_w] = uint8_t(c);
+      ++n_w;
+    };
+    auto put_int = [&](int64_t v) {
+      char tmp[24];
+      int n = snprintf(tmp, sizeof tmp, "%lld", (long long)v);
+      put(reinterpret_cast<uint8_t*>(tmp), n);
+    };
+    auto put_span = [&](const uint8_t* b2, const int64_t* off, int64_t k) {
+      put(b2 + off[k], off[k + 1] - off[k]);
+    };
+    put_span(name_buf, name_off, i);
+    putc_('\t');
+    put_int(flags[i]);
+    putc_('\t');
+    int32_t c = contig_idx[i];
+    if (c >= 0 && c < n_ctgs) put_span(ctg_buf, ctg_off, c);
+    else putc_('*');
+    putc_('\t');
+    put_int(start[i] >= 0 ? start[i] + 1 : 0);
+    putc_('\t');
+    put_int(mapq[i] >= 0 ? mapq[i] : 0);
+    putc_('\t');
+    int32_t nc = cigar_n[i];
+    if (nc == 0) {
+      putc_('*');
+    } else {
+      for (int32_t k = 0; k < nc; ++k) {
+        put_int(cigar_lens[i * cmax + k]);
+        putc_("MIDNSHP=X??????\?"[cigar_ops[i * cmax + k] & 0xF]);
+      }
+    }
+    putc_('\t');
+    int32_t mc = mate_contig_idx[i];
+    if (mc < 0) putc_('*');
+    else if (mc == c && c >= 0) putc_('=');
+    else if (mc < n_ctgs) put_span(ctg_buf, ctg_off, mc);
+    else putc_('*');
+    putc_('\t');
+    put_int(mate_start[i] >= 0 ? mate_start[i] + 1 : 0);
+    putc_('\t');
+    put_int(tlen[i]);
+    putc_('\t');
+    int64_t L = lengths[i];
+    if (L == 0) {
+      putc_('*');
+    } else {
+      const uint8_t* bs = bases + i * lmax;
+      for (int64_t j = 0; j < L; ++j)
+        putc_(kBase[bs[j] > 5 ? 5 : bs[j]]);
+    }
+    putc_('\t');
+    if (L == 0 || !has_qual[i]) {
+      putc_('*');
+    } else {
+      const uint8_t* q = quals + i * lmax;
+      for (int64_t j = 0; j < L; ++j)
+        putc_(char(uint8_t(q[j] + 33)));
+    }
+    int64_t al = attr_off[i + 1] - attr_off[i];
+    if (al) {
+      putc_('\t');
+      put(attr_buf + attr_off[i], al);
+    }
+    if (md_present[i]) {
+      put(reinterpret_cast<const uint8_t*>("\tMD:Z:"), 6);
+      put_span(md_buf, md_off, i);
+    }
+    if (oq_present[i]) {
+      put(reinterpret_cast<const uint8_t*>("\tOQ:Z:"), 6);
+      put_span(oq_buf, oq_off, i);
+    }
+    int32_t r = rg_idx[i];
+    if (r >= 0 && r < n_rgs) {
+      put(reinterpret_cast<const uint8_t*>("\tRG:Z:"), 6);
+      put_span(rg_buf, rg_off, r);
+    }
+    putc_('\n');
+    return n_w;
+  };
+
+  auto pass = [&](bool fill) {
+    auto work = [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; ++i) {
+        if (!valid[i]) continue;
+        if (fill) emit(i, out + sizes[size_t(i)]);
+        else sizes[size_t(i) + 1] = emit(i, nullptr);
+      }
+    };
+    if (nthreads == 1 || N < 4096) {
+      work(0, N);
+    } else {
+      std::vector<std::thread> ts;
+      for (int t = 0; t < nthreads; ++t)
+        ts.emplace_back(work, N * t / nthreads, N * (t + 1) / nthreads);
+      for (auto& t : ts) t.join();
+    }
+  };
+  pass(false);
+  for (int64_t i = 0; i < N; ++i) sizes[size_t(i) + 1] += sizes[size_t(i)];
+  if (sizes[size_t(N)] > cap) return -2;
+  pass(true);
+  return sizes[size_t(N)];
+}
+
 // -------------------------------------------------------- BAM encode ----
 
 // Encode valid rows into a BAM record stream (the inverse of

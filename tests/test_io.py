@@ -529,3 +529,23 @@ def test_native_bam_encoder_bytewise(ref_resources, tmp_path):
         sam_io.bgzf_decompress(p_nat.read_bytes())
         == sam_io.bgzf_decompress(p_py.read_bytes())
     )
+
+
+def test_native_sam_writer_bytewise(ref_resources, tmp_path):
+    """The C++ SAM formatter must produce the pure-Python writer's exact
+    text (positions, '=', tags, missing quals)."""
+    from adam_tpu import native
+    from adam_tpu.io import sam as sam_io
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    ds = ctx.load_alignments(str(ref_resources / "small.sam"))
+    p_nat, p_py = tmp_path / "n.sam", tmp_path / "p.sam"
+    sam_io.write_sam(str(p_nat), ds.batch, ds.sidecar, ds.header)
+    orig = native.sam_encode
+    native.sam_encode = lambda *a, **k: None
+    try:
+        sam_io.write_sam(str(p_py), ds.batch, ds.sidecar, ds.header)
+    finally:
+        native.sam_encode = orig
+    assert p_nat.read_bytes() == p_py.read_bytes()
