@@ -492,16 +492,14 @@ def cigar_cols(buf: np.ndarray, offsets: np.ndarray, cmax: int):
     return ops, lens, n_ops
 
 
-def bam_encode(batch, side, rg_names: Sequence[str]) -> Optional[bytes]:
-    """Encode a (ReadBatch, ReadSidecar) into the BAM record stream
-    (everything after the reference list); None -> caller falls back to
-    the pure-Python writer."""
-    lib = _lib()
-    if lib is None:
-        return None
-    from adam_tpu.formats.strings import StringColumn
-
+def _encode_prep(batch, side, rg_names: Sequence[str]):
+    """Shared marshalling for the SAM/BAM encoders: numpy-ified batch,
+    sidecar StringColumns (None when the sidecar is shorter than the
+    padded batch -> caller falls back), RG dict, and the common leading
+    ctypes argument list."""
     import jax
+
+    from adam_tpu.formats.strings import StringColumn
 
     b = jax.tree.map(lambda x: np.asarray(x), batch)
     n = b.n_rows
@@ -510,62 +508,83 @@ def bam_encode(batch, side, rg_names: Sequence[str]) -> Optional[bytes]:
     md = StringColumn.of(side.md)
     oq = StringColumn.of(side.orig_quals)
     if len(names) < n or len(attrs) < n or len(md) < n or len(oq) < n:
-        return None  # sidecar shorter than padded batch: fall back
+        return None
+
+    c64 = lambda x: np.ascontiguousarray(x, np.int64)  # noqa: E731
+    c32 = lambda x: np.ascontiguousarray(x, np.int32)  # noqa: E731
+    cu8 = lambda x: np.ascontiguousarray(x, np.uint8)  # noqa: E731
+
     gbuf, goff = _str_dict(rg_names)
-
-    def c64(x):
-        return np.ascontiguousarray(x, np.int64)
-
-    def c32(x):
-        return np.ascontiguousarray(x, np.int32)
-
-    def cu8(x):
-        return np.ascontiguousarray(x, np.uint8)
-
-    # generous capacity: fixed part + names + cigars + seq/qual + binary
-    # tags can only shrink vs their text form (+4 for MD/OQ/RG Z-wrappers)
-    lens = np.where(b.valid, b.lengths, 0).astype(np.int64)
-    cap = int(
-        n * 64
-        + int(names.offsets[-1])
-        + 4 * int(np.asarray(b.cigar_n, np.int64).sum())
-        + int(lens.sum()) * 2
-        + int(attrs.offsets[-1]) + int(md.offsets[-1]) + int(oq.offsets[-1])
-        + 16 * n
-        + (max((len(s) for s in rg_names), default=0) + 8) * n
+    # keep every marshalled array alive for the duration of the call
+    keep = dict(
+        flags=c32(b.flags), contig_idx=c32(b.contig_idx), start=c64(b.start),
+        mapq=c32(b.mapq), mate_contig_idx=c32(b.mate_contig_idx),
+        mate_start=c64(b.mate_start), tlen=c32(b.tlen),
+        lengths=c32(b.lengths), has_qual=cu8(np.asarray(b.has_qual)),
+        valid=cu8(np.asarray(b.valid)),
+        bases=cu8(b.bases).reshape(-1), quals=cu8(b.quals).reshape(-1),
+        cigar_ops=cu8(b.cigar_ops).reshape(-1),
+        cigar_lens=c32(b.cigar_lens), cigar_n=c32(b.cigar_n),
+        md_valid=cu8(np.asarray(md.valid)),
+        oq_valid=cu8(np.asarray(oq.valid) & (oq.lengths() > 0)),
+        rg_idx=c32(b.read_group_idx), gbuf=gbuf, goff=goff,
     )
-    out = np.empty(cap, np.uint8)
-    valid = cu8(np.asarray(b.valid))
-    md_valid = cu8(np.asarray(md.valid))
-    oq_valid = cu8(np.asarray(oq.valid) & (oq.lengths() > 0))
-    # rows with invalid attrs columns: zero-length spans already encode ""
-    got = lib.bam_encode(
-        c32(b.flags).ctypes.data_as(_i32p),
-        c32(b.contig_idx).ctypes.data_as(_i32p),
-        c64(b.start).ctypes.data_as(_i64p),
-        c32(b.mapq).ctypes.data_as(_i32p),
-        c32(b.mate_contig_idx).ctypes.data_as(_i32p),
-        c64(b.mate_start).ctypes.data_as(_i64p),
-        c32(b.tlen).ctypes.data_as(_i32p),
-        c32(b.lengths).ctypes.data_as(_i32p),
-        _u8_ptr(cu8(np.asarray(b.has_qual))),
-        _u8_ptr(valid),
-        _u8_ptr(cu8(b.bases).reshape(-1)),
-        _u8_ptr(cu8(b.quals).reshape(-1)),
+    args = [
+        keep["flags"].ctypes.data_as(_i32p),
+        keep["contig_idx"].ctypes.data_as(_i32p),
+        keep["start"].ctypes.data_as(_i64p),
+        keep["mapq"].ctypes.data_as(_i32p),
+        keep["mate_contig_idx"].ctypes.data_as(_i32p),
+        keep["mate_start"].ctypes.data_as(_i64p),
+        keep["tlen"].ctypes.data_as(_i32p),
+        keep["lengths"].ctypes.data_as(_i32p),
+        _u8_ptr(keep["has_qual"]),
+        _u8_ptr(keep["valid"]),
+        _u8_ptr(keep["bases"]),
+        _u8_ptr(keep["quals"]),
         ct.c_int64(b.lmax),
-        _u8_ptr(cu8(b.cigar_ops).reshape(-1)),
-        c32(b.cigar_lens).ctypes.data_as(_i32p),
-        c32(b.cigar_n).ctypes.data_as(_i32p),
+        _u8_ptr(keep["cigar_ops"]),
+        keep["cigar_lens"].ctypes.data_as(_i32p),
+        keep["cigar_n"].ctypes.data_as(_i32p),
         ct.c_int64(b.cmax),
         _u8_ptr(names.buf), names.offsets.ctypes.data_as(_i64p),
         _u8_ptr(attrs.buf), attrs.offsets.ctypes.data_as(_i64p),
         _u8_ptr(md.buf), md.offsets.ctypes.data_as(_i64p),
-        _u8_ptr(md_valid),
+        _u8_ptr(keep["md_valid"]),
         _u8_ptr(oq.buf), oq.offsets.ctypes.data_as(_i64p),
-        _u8_ptr(oq_valid),
-        c32(b.read_group_idx).ctypes.data_as(_i32p),
+        _u8_ptr(keep["oq_valid"]),
+        keep["rg_idx"].ctypes.data_as(_i32p),
         _u8_ptr(gbuf), goff.ctypes.data_as(_i64p), ct.c_int32(len(rg_names)),
-        ct.c_int64(n), _u8_ptr(out), ct.c_int64(cap), ct.c_int(_nthreads()),
+    ]
+    # common capacity terms: names + cigars + seq/qual + sidecar strings
+    lens = np.where(b.valid, b.lengths, 0).astype(np.int64)
+    base_cap = (
+        int(names.offsets[-1])
+        + 12 * int(np.asarray(b.cigar_n, np.int64).sum())
+        + int(lens.sum()) * 2
+        + int(attrs.offsets[-1]) + int(md.offsets[-1]) + int(oq.offsets[-1])
+        + (max((len(s) for s in rg_names), default=0) + 8) * n
+    )
+    keep["_strings"] = (names, attrs, md, oq)
+    return n, args, base_cap, keep
+
+
+def bam_encode(batch, side, rg_names: Sequence[str]) -> Optional[bytes]:
+    """Encode a (ReadBatch, ReadSidecar) into the BAM record stream
+    (everything after the reference list); None -> caller falls back to
+    the pure-Python writer."""
+    lib = _lib()
+    if lib is None:
+        return None
+    prep = _encode_prep(batch, side, rg_names)
+    if prep is None:
+        return None
+    n, args, base_cap, keep = prep
+    cap = int(n * 80 + base_cap)
+    out = np.empty(cap, np.uint8)
+    got = lib.bam_encode(
+        *args, ct.c_int64(n), _u8_ptr(out), ct.c_int64(cap),
+        ct.c_int(_nthreads()),
     )
     if got < 0:
         return None
@@ -579,67 +598,16 @@ def sam_encode(batch, side, rg_names: Sequence[str],
     lib = _lib()
     if lib is None:
         return None
-    from adam_tpu.formats.strings import StringColumn
-
-    import jax
-
-    b = jax.tree.map(lambda x: np.asarray(x), batch)
-    n = b.n_rows
-    names = StringColumn.of(side.names)
-    attrs = StringColumn.of(side.attrs)
-    md = StringColumn.of(side.md)
-    oq = StringColumn.of(side.orig_quals)
-    if len(names) < n or len(attrs) < n or len(md) < n or len(oq) < n:
+    prep = _encode_prep(batch, side, rg_names)
+    if prep is None:
         return None
-    gbuf, goff = _str_dict(rg_names)
+    n, args, base_cap, keep = prep
     cbuf, coff = _str_dict(contig_names)
-
-    def c64(x):
-        return np.ascontiguousarray(x, np.int64)
-
-    def c32(x):
-        return np.ascontiguousarray(x, np.int32)
-
-    def cu8(x):
-        return np.ascontiguousarray(x, np.uint8)
-
-    lens = np.where(b.valid, b.lengths, 0).astype(np.int64)
     max_name = (max((len(s) for s in contig_names), default=1) + 2) * 2
-    cap = int(
-        n * (140 + max_name)
-        + int(names.offsets[-1])
-        + 12 * int(np.asarray(b.cigar_n, np.int64).sum())
-        + int(lens.sum()) * 2
-        + int(attrs.offsets[-1]) + int(md.offsets[-1]) + int(oq.offsets[-1])
-        + (max((len(s) for s in rg_names), default=0) + 8) * n
-    )
+    cap = int(n * (140 + max_name) + base_cap)
     out = np.empty(cap, np.uint8)
     got = lib.sam_encode(
-        c32(b.flags).ctypes.data_as(_i32p),
-        c32(b.contig_idx).ctypes.data_as(_i32p),
-        c64(b.start).ctypes.data_as(_i64p),
-        c32(b.mapq).ctypes.data_as(_i32p),
-        c32(b.mate_contig_idx).ctypes.data_as(_i32p),
-        c64(b.mate_start).ctypes.data_as(_i64p),
-        c32(b.tlen).ctypes.data_as(_i32p),
-        c32(b.lengths).ctypes.data_as(_i32p),
-        _u8_ptr(cu8(np.asarray(b.has_qual))),
-        _u8_ptr(cu8(np.asarray(b.valid))),
-        _u8_ptr(cu8(b.bases).reshape(-1)),
-        _u8_ptr(cu8(b.quals).reshape(-1)),
-        ct.c_int64(b.lmax),
-        _u8_ptr(cu8(b.cigar_ops).reshape(-1)),
-        c32(b.cigar_lens).ctypes.data_as(_i32p),
-        c32(b.cigar_n).ctypes.data_as(_i32p),
-        ct.c_int64(b.cmax),
-        _u8_ptr(names.buf), names.offsets.ctypes.data_as(_i64p),
-        _u8_ptr(attrs.buf), attrs.offsets.ctypes.data_as(_i64p),
-        _u8_ptr(md.buf), md.offsets.ctypes.data_as(_i64p),
-        _u8_ptr(cu8(np.asarray(md.valid))),
-        _u8_ptr(oq.buf), oq.offsets.ctypes.data_as(_i64p),
-        _u8_ptr(cu8(np.asarray(oq.valid) & (oq.lengths() > 0))),
-        c32(b.read_group_idx).ctypes.data_as(_i32p),
-        _u8_ptr(gbuf), goff.ctypes.data_as(_i64p), ct.c_int32(len(rg_names)),
+        *args,
         _u8_ptr(cbuf), coff.ctypes.data_as(_i64p),
         ct.c_int32(len(contig_names)),
         ct.c_int64(n), _u8_ptr(out), ct.c_int64(cap), ct.c_int(_nthreads()),

@@ -79,6 +79,21 @@ inline int64_t parse_i64(const uint8_t* p, const uint8_t* end, bool* ok) {
   return neg ? -v : v;
 }
 
+// shared row-range fan-out: fn(lo, hi) over [0, N) on up to nthreads
+// threads (serial below 4096 rows, where thread spawn outweighs work)
+template <class F>
+void parallel_rows(int64_t N, int nthreads, F fn) {
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads == 1 || N < 4096) {
+    fn(int64_t(0), N);
+    return;
+  }
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; ++t)
+    ts.emplace_back(fn, N * t / nthreads, N * (t + 1) / nthreads);
+  for (auto& t : ts) t.join();
+}
+
 using Dict = std::unordered_map<std::string, int32_t>;
 
 Dict build_dict(const uint8_t* buf, const int64_t* off, int32_t n) {
@@ -717,8 +732,14 @@ int64_t sam_encode(
   if (nthreads < 1) nthreads = 1;
   std::vector<int64_t> sizes(size_t(N) + 1, 0);
 
+  std::atomic<int> oob{0};
   auto emit = [&](int64_t i, uint8_t* w) -> int64_t {
-    // w == nullptr: size-only
+    // w == nullptr: size-only.  Out-of-range contig/RG indices mark the
+    // whole encode as failed (-1) so the caller's Python fallback can
+    // surface the corruption loudly instead of writing a wrong file.
+    if (contig_idx[i] >= n_ctgs || mate_contig_idx[i] >= n_ctgs ||
+        rg_idx[i] >= n_rgs)
+      oob.store(1);
     int64_t n_w = 0;
     auto put = [&](const uint8_t* p, int64_t n) {
       if (w) memcpy(w + n_w, p, size_t(n));
@@ -814,16 +835,10 @@ int64_t sam_encode(
         else sizes[size_t(i) + 1] = emit(i, nullptr);
       }
     };
-    if (nthreads == 1 || N < 4096) {
-      work(0, N);
-    } else {
-      std::vector<std::thread> ts;
-      for (int t = 0; t < nthreads; ++t)
-        ts.emplace_back(work, N * t / nthreads, N * (t + 1) / nthreads);
-      for (auto& t : ts) t.join();
-    }
+    parallel_rows(N, nthreads, work);
   };
   pass(false);
+  if (oob.load()) return -1;
   for (int64_t i = 0; i < N; ++i) sizes[size_t(i) + 1] += sizes[size_t(i)];
   if (sizes[size_t(N)] > cap) return -2;
   pass(true);
@@ -881,6 +896,7 @@ int64_t bam_encode(
 
   auto size_one = [&](int64_t i) -> int64_t {
     if (!valid[i]) return 0;
+    if (rg_idx[i] >= n_rgs) return -1;  // corrupt batch: fail loudly
     const uint8_t *a, *md, *oq, *rg;
     int64_t al, mdl, oql, rgl;
     bool hmd, hoq, hrg;
@@ -902,14 +918,7 @@ int64_t bam_encode(
         sizes[size_t(i) + 1] = s;
       }
     };
-    if (nthreads == 1 || N < 4096) {
-      work(0, N);
-    } else {
-      std::vector<std::thread> ts;
-      for (int t = 0; t < nthreads; ++t)
-        ts.emplace_back(work, N * t / nthreads, N * (t + 1) / nthreads);
-      for (auto& t : ts) t.join();
-    }
+    parallel_rows(N, nthreads, work);
   }
   if (bad.load()) return -1;
   for (int64_t i = 0; i < N; ++i) sizes[size_t(i) + 1] += sizes[size_t(i)];
@@ -976,14 +985,7 @@ int64_t bam_encode(
     auto work = [&](int64_t lo, int64_t hi) {
       for (int64_t i = lo; i < hi; ++i) fill_one(i);
     };
-    if (nthreads == 1 || N < 4096) {
-      work(0, N);
-    } else {
-      std::vector<std::thread> ts;
-      for (int t = 0; t < nthreads; ++t)
-        ts.emplace_back(work, N * t / nthreads, N * (t + 1) / nthreads);
-      for (auto& t : ts) t.join();
-    }
+    parallel_rows(N, nthreads, work);
   }
   return total;
 }
@@ -1042,16 +1044,7 @@ int cigar_cols(const uint8_t* buf, const int64_t* offsets, int64_t N,
       n_ops[i] = n;
     }
   };
-  if (nthreads == 1 || N < 4096) {
-    work(0, N);
-  } else {
-    std::vector<std::thread> ts;
-    for (int t = 0; t < nthreads; ++t) {
-      int64_t lo = N * t / nthreads, hi = N * (t + 1) / nthreads;
-      ts.emplace_back(work, lo, hi);
-    }
-    for (auto& t : ts) t.join();
-  }
+  parallel_rows(N, nthreads, work);
   return bad.load() ? -1 : 0;
 }
 
@@ -1092,16 +1085,7 @@ void ref_positions(const uint8_t* ops, const int32_t* lens,
       }
     }
   };
-  if (nthreads == 1 || N < 4096) {
-    work(0, N);
-    return;
-  }
-  std::vector<std::thread> ts;
-  for (int t = 0; t < nthreads; ++t) {
-    int64_t lo = N * t / nthreads, hi = N * (t + 1) / nthreads;
-    ts.emplace_back(work, lo, hi);
-  }
-  for (auto& t : ts) t.join();
+  parallel_rows(N, nthreads, work);
 }
 
 // ------------------------------------------------------------------ SAM --
