@@ -137,6 +137,12 @@ def _lib() -> Optional[ct.CDLL]:
                 _u8p, _i64p, ct.c_int64, ct.c_int64,
                 _u8p, _i32p, _i32p, ct.c_int,
             ]
+            lib.bqsr_apply.argtypes = [
+                _u8p, _u8p, _i32p, _i32p, _i32p, _u8p, _u8p,
+                ct.c_int64, ct.c_int64,
+                _u8p, ct.c_int32, ct.c_int32, ct.c_int64,
+                _u8p, ct.c_int,
+            ]
             lib.sam_encode.restype = ct.c_int64
             lib.sam_encode.argtypes = [
                 _i32p, _i32p, _i64p, _i32p, _i32p, _i64p, _i32p, _i32p,
@@ -615,3 +621,30 @@ def sam_encode(batch, side, rg_names: Sequence[str],
     if got < 0:
         return None
     return out[:got].tobytes()
+
+
+def bqsr_apply(bases, quals, lengths, flags, rg_idx, has_qual, valid,
+               table_u8, gl: int):
+    """Threaded host application of the BQSR recalibration table ->
+    new quals u8[N, L]; None if native unavailable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    bases = np.ascontiguousarray(bases, np.uint8)
+    quals = np.ascontiguousarray(quals, np.uint8)
+    n, lmax = bases.shape
+    table = np.ascontiguousarray(table_u8, np.uint8)
+    n_rg, _, n_cyc, _ = table.shape
+    out = np.empty((n, lmax), np.uint8)
+    lib.bqsr_apply(
+        _u8_ptr(bases.reshape(-1)), _u8_ptr(quals.reshape(-1)),
+        np.ascontiguousarray(lengths, np.int32).ctypes.data_as(_i32p),
+        np.ascontiguousarray(flags, np.int32).ctypes.data_as(_i32p),
+        np.ascontiguousarray(rg_idx, np.int32).ctypes.data_as(_i32p),
+        _u8_ptr(np.ascontiguousarray(has_qual, np.uint8)),
+        _u8_ptr(np.ascontiguousarray(valid, np.uint8)),
+        ct.c_int64(n), ct.c_int64(lmax),
+        _u8_ptr(table.reshape(-1)), ct.c_int32(n_rg), ct.c_int32(n_cyc),
+        ct.c_int64(gl), _u8_ptr(out.reshape(-1)), ct.c_int(_nthreads()),
+    )
+    return out

@@ -706,6 +706,64 @@ extern "C" {
 
 int adamtok_version() { return 5; }
 
+// -------------------------------------------------------- BQSR apply ----
+
+// Apply the recalibration phred table to every residue: the host twin of
+// pipelines/bqsr.recalibrate_kernel's gather stage (cycle and dinuc
+// covariates recomputed per residue, CycleCovariate.scala:31-49 /
+// DinucCovariate.scala:24-50 semantics, Q5 floor + pad/valid masks).
+void bqsr_apply(
+    const uint8_t* bases, const uint8_t* quals, const int32_t* lengths,
+    const int32_t* flags, const int32_t* rg_idx, const uint8_t* has_qual,
+    const uint8_t* valid, int64_t N, int64_t lmax,
+    const uint8_t* table, int32_t n_rg, int32_t n_cyc, int64_t gl,
+    uint8_t* out, int nthreads) {
+  static const uint8_t kComp[6] = {3, 2, 1, 0, 4, 5};  // A<->T C<->G
+  constexpr int32_t kNQual = 94, kNDinuc = 17, kDinucNone = 16;
+  constexpr uint8_t kQualPad = 255, kMinQ = 5;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* bs = bases + i * lmax;
+      const uint8_t* q = quals + i * lmax;
+      uint8_t* w = out + i * lmax;
+      memcpy(w, q, size_t(lmax));
+      if (!valid[i] || !has_qual[i]) continue;
+      int64_t L = lengths[i];
+      int32_t fl = flags[i];
+      bool rev = fl & 0x10;
+      bool second = (fl & 0x1) && (fl & 0x80);
+      int64_t initial = rev ? (second ? -L : L) : (second ? -1 : 1);
+      int64_t inc = rev ? (second ? 1 : -1) : (second ? -1 : 1);
+      int32_t rg = rg_idx[i] >= 0 && rg_idx[i] < n_rg ? rg_idx[i] : n_rg - 1;
+      const uint8_t* rg_table =
+          table + size_t(rg) * kNQual * n_cyc * kNDinuc;
+      for (int64_t j = 0; j < L && j < lmax; ++j) {
+        uint8_t qv = q[j];
+        if (qv < kMinQ || qv >= kQualPad) continue;
+        int64_t cyc = initial + inc * j + gl;
+        // machine-order previous base (reverse strand: complement of j+1)
+        uint8_t cur = bs[j], prev;
+        bool first_machine;
+        if (rev) {
+          cur = kComp[cur > 5 ? 5 : cur];
+          uint8_t nb = (j + 1 < L) ? bs[j + 1] : 5;
+          prev = kComp[nb > 5 ? 5 : nb];
+          first_machine = (j == L - 1);
+        } else {
+          prev = j ? bs[j - 1] : 5;
+          first_machine = (j == 0);
+        }
+        int32_t din = (!first_machine && cur < 4 && prev < 4)
+                          ? int32_t(prev) * 4 + cur
+                          : kDinucNone;
+        int32_t qi = qv < kNQual ? qv : kNQual - 1;
+        w[j] = rg_table[(int64_t(qi) * n_cyc + cyc) * kNDinuc + din];
+      }
+    }
+  };
+  parallel_rows(N, nthreads, work);
+}
+
 // -------------------------------------------------------- SAM encode ----
 
 // Format valid rows as SAM text lines (the writer's format_sam_records

@@ -71,6 +71,40 @@ def compute_cycles(lengths, flags, lmax: int):
     return initial[:, None] + increment[:, None] * pos
 
 
+def compute_cycles_np(lengths, flags, lmax: int):
+    """Host twin of :func:`compute_cycles` (vectorized numpy)."""
+    rev = (np.asarray(flags) & schema.FLAG_REVERSE) != 0
+    second = ((np.asarray(flags) & schema.FLAG_PAIRED) != 0) & (
+        (np.asarray(flags) & schema.FLAG_SECOND_OF_PAIR) != 0
+    )
+    L = np.asarray(lengths).astype(np.int32)
+    initial = np.where(rev, np.where(second, -L, L), np.where(second, -1, 1))
+    increment = np.where(rev, np.where(second, 1, -1), np.where(second, -1, 1))
+    pos = np.arange(lmax, dtype=np.int32)[None, :]
+    return initial[:, None] + increment[:, None] * pos
+
+
+def compute_dinucs_np(bases, lengths, flags, lmax: int):
+    """Host twin of :func:`compute_dinucs` (vectorized numpy)."""
+    comp = np.asarray(schema.BASE_COMPLEMENT)
+    bases = np.asarray(bases)
+    rev = ((np.asarray(flags) & schema.FLAG_REVERSE) != 0)[:, None]
+    prev_f = np.pad(bases[:, :-1], ((0, 0), (1, 0)),
+                    constant_values=schema.BASE_N)
+    next_b = np.pad(bases[:, 1:], ((0, 0), (0, 1)),
+                    constant_values=schema.BASE_N)
+    cur = np.where(rev, comp[bases], bases)
+    prev = np.where(rev, comp[next_b], prev_f)
+    i = np.arange(lmax)[None, :]
+    lens = np.asarray(lengths)
+    in_read = i < lens[:, None]
+    first_machine = np.where(rev, i == (lens[:, None] - 1), i == 0)
+    regular = (cur < 4) & (prev < 4)
+    ok = in_read & ~first_machine & regular
+    idx = prev.astype(np.int32) * 4 + cur.astype(np.int32)
+    return np.where(ok, idx, DINUC_NONE)
+
+
 def compute_dinucs(bases, lengths, flags, lmax: int):
     """Dinucleotide index per residue -> i32[N, L] in [0, 16].
 
@@ -117,17 +151,23 @@ def observe_kernel(
     rg = jnp.where(read_group_idx >= 0, read_group_idx, n_rg - 1).astype(jnp.int32)
     include = residue_ok & read_ok[:, None]
 
+    # i32 keys and counts: int64 is emulated on the TPU vector unit and
+    # the scatter-add dominates the pass; a single batch shard can't
+    # overflow 2^31 observations (callers psum in i64 across shards)
     flat_key = (
         ((rg[:, None] * N_QUAL + q) * n_cyc + (cycles + lmax)) * N_DINUC + dinucs
-    )
+    ).astype(jnp.int32)
     size = n_rg * N_QUAL * n_cyc * N_DINUC
     flat_key = jnp.where(include, flat_key, 0).ravel()
-    ones = include.astype(jnp.int64).ravel()
-    mm = (include & is_mismatch).astype(jnp.int64).ravel()
-    total = jnp.zeros(size, jnp.int64).at[flat_key].add(ones)
-    mism = jnp.zeros(size, jnp.int64).at[flat_key].add(mm)
+    ones = include.astype(jnp.int32).ravel()
+    mm = (include & is_mismatch).astype(jnp.int32).ravel()
+    total = jnp.zeros(size, jnp.int32).at[flat_key].add(ones)
+    mism = jnp.zeros(size, jnp.int32).at[flat_key].add(mm)
     shape = (n_rg, N_QUAL, n_cyc, N_DINUC)
-    return total.reshape(shape), mism.reshape(shape)
+    return (
+        total.reshape(shape).astype(jnp.int64),
+        mism.reshape(shape).astype(jnp.int64),
+    )
 
 
 class ObservationTable:
@@ -380,21 +420,52 @@ def recalibrate_base_qualities(
         with open(dump_observation_table, "w") as fh:
             fh.write(obs.to_csv())
     b = ds.batch.to_numpy()
-    from adam_tpu.formats.batch import grid_rows, pad_rows_np
+    # the delta-stack table is built on device from the psum-able
+    # histograms, but the per-residue application is a pure GATHER — run
+    # it host-side from the compact u8 table (n_rg x 94 x cycles x 17,
+    # ~4 MB) instead of fetching the full [N, L] qual matrix (~100 MB on
+    # a WGS-scale batch; the device link is the pipeline bottleneck)
+    phred_table = np.asarray(
+        recalibration_phred_table(total, mism).astype(jnp.uint8)
+    )
+    gl = lmax  # _observe_device's grid-aligned lane count (table width)
+    n_rg = phred_table.shape[0]
+    n_cyc = phred_table.shape[2]
+    L = b.lmax
+    quals = np.asarray(b.quals)
+    rg = np.where(
+        np.asarray(b.read_group_idx) >= 0, np.asarray(b.read_group_idx),
+        n_rg - 1,
+    ).astype(np.int32)
+    from adam_tpu import native
 
-    g = grid_rows(b.n_rows)
-    gl = lmax  # _observe_device already grid-aligned the lane count
-    from adam_tpu.utils.transfer import device_fetch
-
-    new_quals = device_fetch(
-        recalibrate_kernel(
-            dev["bases"], dev["quals"], dev["lengths"],
-            dev["flags"], dev["read_group_idx"],
-            jnp.asarray(pad_rows_np(b.has_qual, g, False)),
-            jnp.asarray(pad_rows_np(b.valid, g, False)),
-            total, mism, gl,
+    new_quals = native.bqsr_apply(
+        b.bases, quals, b.lengths, b.flags, b.read_group_idx,
+        b.has_qual, b.valid, phred_table, gl,
+    )
+    if new_quals is None:
+        # fused i32 flat index into the raveled table: one gather,
+        # minimal [N, L] temporaries (numpy fallback)
+        idx = compute_cycles_np(b.lengths, b.flags, L)
+        idx += gl
+        q32 = np.minimum(quals, N_QUAL - 1).astype(np.int32)
+        q32 += rg[:, None] * N_QUAL
+        q32 *= n_cyc
+        idx += q32
+        del q32
+        idx *= N_DINUC
+        idx += compute_dinucs_np(b.bases, b.lengths, b.flags, L)
+        new_q = phred_table.ravel()[idx]
+        del idx
+        in_read = np.arange(L)[None, :] < np.asarray(b.lengths)[:, None]
+        apply_mask = (
+            in_read
+            & (quals >= MIN_ACCEPTABLE_QUALITY)
+            & (quals < schema.QUAL_PAD)
+            & np.asarray(b.has_qual)[:, None]
+            & np.asarray(b.valid)[:, None]
         )
-    )[: b.n_rows, : b.lmax]
+        new_quals = np.where(apply_mask, new_q, quals).astype(np.uint8)
     # stash original quals in the sidecar (setOrigQual, Recalibrator.scala:36-40)
     # — vectorized: encode the pre-recalibration qual matrix as a string
     # column and merge it into rows that had no OQ yet.
