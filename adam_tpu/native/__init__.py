@@ -137,6 +137,12 @@ def _lib() -> Optional[ct.CDLL]:
                 _u8p, _i64p, ct.c_int64, ct.c_int64,
                 _u8p, _i32p, _i32p, ct.c_int,
             ]
+            lib.bqsr_observe.argtypes = [
+                _u8p, _u8p, _i32p, _i32p, _i32p,
+                _u8p, _u8p, _u8p,
+                ct.c_int64, ct.c_int64, ct.c_int32, ct.c_int64,
+                _i64p, _i64p, ct.c_int,
+            ]
             lib.bqsr_apply.argtypes = [
                 _u8p, _u8p, _i32p, _i32p, _i32p, _u8p, _u8p,
                 ct.c_int64, ct.c_int64,
@@ -648,3 +654,32 @@ def bqsr_apply(bases, quals, lengths, flags, rg_idx, has_qual, valid,
         ct.c_int64(gl), _u8_ptr(out.reshape(-1)), ct.c_int(_nthreads()),
     )
     return out
+
+
+def bqsr_observe(bases, quals, lengths, flags, rg_idx,
+                 residue_ok, is_mm, read_ok, n_rg: int, gl: int):
+    """Threaded host covariate histogram -> (total, mism) i64 arrays of
+    shape [n_rg, 94, 2*gl+1, 17]; None if native unavailable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    bases = np.ascontiguousarray(bases, np.uint8)
+    quals = np.ascontiguousarray(quals, np.uint8)
+    n, lmax = bases.shape
+    n_cyc = 2 * gl + 1
+    shape = (n_rg, 94, n_cyc, 17)
+    total = np.empty(shape, np.int64)
+    mism = np.empty(shape, np.int64)
+    lib.bqsr_observe(
+        _u8_ptr(bases.reshape(-1)), _u8_ptr(quals.reshape(-1)),
+        np.ascontiguousarray(lengths, np.int32).ctypes.data_as(_i32p),
+        np.ascontiguousarray(flags, np.int32).ctypes.data_as(_i32p),
+        np.ascontiguousarray(rg_idx, np.int32).ctypes.data_as(_i32p),
+        _u8_ptr(np.ascontiguousarray(residue_ok, np.uint8).reshape(-1)),
+        _u8_ptr(np.ascontiguousarray(is_mm, np.uint8).reshape(-1)),
+        _u8_ptr(np.ascontiguousarray(read_ok, np.uint8)),
+        ct.c_int64(n), ct.c_int64(lmax), ct.c_int32(n_rg), ct.c_int64(gl),
+        total.ctypes.data_as(_i64p), mism.ctypes.data_as(_i64p),
+        ct.c_int(_nthreads()),
+    )
+    return total, mism

@@ -706,6 +706,86 @@ extern "C" {
 
 int adamtok_version() { return 5; }
 
+// ------------------------------------------------------ BQSR observe ----
+
+// Dense covariate histogram: the host twin of pipelines/bqsr.
+// observe_kernel (scatter-add over (rg, qual, cycle, dinuc)), used on
+// single-device topologies where there is no cross-chip psum to win;
+// per-thread local histograms merged at the end keep it deterministic.
+void bqsr_observe(
+    const uint8_t* bases, const uint8_t* quals, const int32_t* lengths,
+    const int32_t* flags, const int32_t* rg_idx,
+    const uint8_t* residue_ok, const uint8_t* is_mm, const uint8_t* read_ok,
+    int64_t N, int64_t lmax, int32_t n_rg, int64_t gl,
+    int64_t* total, int64_t* mism, int nthreads) {
+  static const uint8_t kComp[6] = {3, 2, 1, 0, 4, 5};
+  constexpr int32_t kNQual = 94, kNDinuc = 17, kDinucNone = 16;
+  const int64_t n_cyc = 2 * gl + 1;
+  const int64_t size = int64_t(n_rg) * kNQual * n_cyc * kNDinuc;
+  memset(total, 0, size_t(size) * 8);
+  memset(mism, 0, size_t(size) * 8);
+  if (nthreads < 1) nthreads = 1;
+  int nt = (N < 4096) ? 1 : nthreads;
+  std::vector<std::vector<int64_t>> loc_t(nt), loc_m(nt);
+  auto work = [&](int t, int64_t lo, int64_t hi) {
+    auto& lt = loc_t[t];
+    auto& lm = loc_m[t];
+    lt.assign(size_t(size), 0);
+    lm.assign(size_t(size), 0);
+    for (int64_t i = lo; i < hi; ++i) {
+      if (!read_ok[i]) continue;
+      const uint8_t* bs = bases + i * lmax;
+      const uint8_t* q = quals + i * lmax;
+      const uint8_t* rok = residue_ok + i * lmax;
+      const uint8_t* mm = is_mm + i * lmax;
+      int64_t L = lengths[i];
+      int32_t fl = flags[i];
+      bool rev = fl & 0x10;
+      bool second = (fl & 0x1) && (fl & 0x80);
+      int64_t initial = rev ? (second ? -L : L) : (second ? -1 : 1);
+      int64_t inc = rev ? (second ? 1 : -1) : (second ? -1 : 1);
+      int32_t rg = rg_idx[i] >= 0 && rg_idx[i] < n_rg ? rg_idx[i] : n_rg - 1;
+      for (int64_t j = 0; j < L && j < lmax; ++j) {
+        if (!rok[j]) continue;
+        int64_t cyc = initial + inc * j + gl;
+        uint8_t cur = bs[j], prev;
+        bool first_machine;
+        if (rev) {
+          cur = kComp[cur > 5 ? 5 : cur];
+          uint8_t nb = (j + 1 < L) ? bs[j + 1] : 5;
+          prev = kComp[nb > 5 ? 5 : nb];
+          first_machine = (j == L - 1);
+        } else {
+          prev = j ? bs[j - 1] : 5;
+          first_machine = (j == 0);
+        }
+        int32_t din = (!first_machine && cur < 4 && prev < 4)
+                          ? int32_t(prev) * 4 + cur
+                          : kDinucNone;
+        int32_t qi = q[j] < kNQual ? q[j] : kNQual - 1;
+        int64_t key =
+            ((int64_t(rg) * kNQual + qi) * n_cyc + cyc) * kNDinuc + din;
+        ++lt[size_t(key)];
+        if (mm[j]) ++lm[size_t(key)];
+      }
+    }
+  };
+  if (nt == 1) {
+    work(0, 0, N);
+  } else {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < nt; ++t)
+      ts.emplace_back(work, t, N * t / nt, N * (t + 1) / nt);
+    for (auto& t : ts) t.join();
+  }
+  for (int t = 0; t < nt; ++t) {
+    for (int64_t k = 0; k < size; ++k) {
+      total[k] += loc_t[size_t(t)][size_t(k)];
+      mism[k] += loc_m[size_t(t)][size_t(k)];
+    }
+  }
+}
+
 // -------------------------------------------------------- BQSR apply ----
 
 // Apply the recalibration phred table to every residue: the host twin of

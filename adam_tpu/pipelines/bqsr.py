@@ -264,23 +264,30 @@ def _observe_device(
 
     g = grid_rows(b.n_rows)
     gl = grid_cols(lmax)
-    # keep the padded device arrays around so the recalibration pass can
-    # reuse them instead of paying the host->device transfer twice
-    dev = {
-        "bases": jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
-        "quals": jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
-        "lengths": jnp.asarray(pad_rows_np(b.lengths, g, 0)),
-        "flags": jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-        "read_group_idx": jnp.asarray(pad_rows_np(b.read_group_idx, g, -1)),
-    }
-    total, mism = observe_kernel(
-        dev["bases"], dev["quals"], dev["lengths"],
-        dev["flags"], dev["read_group_idx"],
-        jnp.asarray(pad_rows_np(residue_ok, g, False, cols=gl)),
-        jnp.asarray(pad_rows_np(is_mm, g, False, cols=gl)),
-        jnp.asarray(pad_rows_np(read_ok, g, False)),
-        n_rg, gl,
+    # Single-device topology: the device scatter-add's payoff is the
+    # cross-chip psum (parallel/dist.distributed_observe keeps it); with
+    # one chip the threaded host histogram is exact and avoids shipping
+    # [N, L] mask arrays to a possibly-throttled device.
+    from adam_tpu import native
+
+    nat = native.bqsr_observe(
+        b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
+        residue_ok & read_ok[:, None], is_mm, read_ok, n_rg, gl,
     )
+    if nat is not None:
+        total, mism = jnp.asarray(nat[0]), jnp.asarray(nat[1])
+    else:
+        total, mism = observe_kernel(
+            jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
+            jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+            jnp.asarray(pad_rows_np(b.lengths, g, 0)),
+            jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+            jnp.asarray(pad_rows_np(b.read_group_idx, g, -1)),
+            jnp.asarray(pad_rows_np(residue_ok, g, False, cols=gl)),
+            jnp.asarray(pad_rows_np(is_mm, g, False, cols=gl)),
+            jnp.asarray(pad_rows_np(read_ok, g, False)),
+            n_rg, gl,
+        )
     rg_names = ds.read_groups.names + ["null"]
     # visit accounting (BaseQualityRecalibration.scala:99-123's logging)
     import logging
@@ -292,13 +299,13 @@ def _observe_device(
         int((residue_ok & read_ok[:, None]).sum()),
         int((~residue_ok & read_ok[:, None]).sum()),
     )
-    return total, mism, rg_names, gl, dev
+    return total, mism, rg_names, gl
 
 
 def build_observation_table(
     ds: AlignmentDataset, known_snps: Optional[SnpTable] = None
 ) -> ObservationTable:
-    total, mism, rg_names, lmax, _ = _observe_device(ds, known_snps)
+    total, mism, rg_names, lmax = _observe_device(ds, known_snps)
     return ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
 
 
@@ -414,7 +421,7 @@ def recalibrate_base_qualities(
     known_snps: Optional[SnpTable] = None,
     dump_observation_table: Optional[str] = None,
 ) -> AlignmentDataset:
-    total, mism, rg_names, lmax, dev = _observe_device(ds, known_snps)
+    total, mism, rg_names, lmax = _observe_device(ds, known_snps)
     if dump_observation_table:
         obs = ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
         with open(dump_observation_table, "w") as fh:
