@@ -275,7 +275,7 @@ def _observe_device(
         residue_ok & read_ok[:, None], is_mm, read_ok, n_rg, gl,
     )
     if nat is not None:
-        total, mism = jnp.asarray(nat[0]), jnp.asarray(nat[1])
+        total, mism = nat  # host arrays: downstream table math stays host
     else:
         total, mism = observe_kernel(
             jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
@@ -383,6 +383,58 @@ def recalibration_phred_table(total, mismatches):
     return error_probability_to_phred(jnp.exp(bounded))
 
 
+def recalibration_phred_table_np(total, mismatches) -> np.ndarray:
+    """Host twin of :func:`recalibration_phred_table` (same f64 math on
+    the small table shapes; differential-tested for bit parity)."""
+    err = np.asarray(PHRED_TO_ERROR)
+    total = np.asarray(total, np.float64)
+    mismatches = np.asarray(mismatches, np.float64)
+
+    def emp_log(t, m):
+        return np.log((1.0 + m) / (2.0 + t))
+
+    g_t = total.sum(axis=(1, 2, 3))
+    g_m = mismatches.sum(axis=(1, 2, 3))
+    q_levels = np.arange(N_QUAL)
+    q_t = total.sum(axis=(2, 3))
+    q_m = mismatches.sum(axis=(2, 3))
+    g_exp = (err[q_levels][None, :] * q_t).sum(axis=1)
+    c_t = total.sum(axis=3)
+    c_m = mismatches.sum(axis=3)
+    d_t = total.sum(axis=2)
+    d_m = mismatches.sum(axis=2)
+
+    residue_logp = np.log(err[q_levels])
+    g_present = g_t > 0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        global_delta = np.where(
+            g_present,
+            emp_log(g_t, g_m) - np.log(g_exp / np.maximum(g_t, 1)),
+            0.0,
+        )
+        q_present = g_present[:, None] & (q_t > 0)
+        offset1 = residue_logp[None, :] + global_delta[:, None]
+        quality_delta = np.where(q_present, emp_log(q_t, q_m) - offset1, 0.0)
+        offset2 = offset1 + quality_delta
+        cyc_delta = np.where(
+            q_present[:, :, None] & (c_t > 0),
+            emp_log(c_t, c_m) - offset2[:, :, None],
+            0.0,
+        )
+        din_delta = np.where(
+            q_present[:, :, None] & (d_t > 0),
+            emp_log(d_t, d_m) - offset2[:, :, None],
+            0.0,
+        )
+    log_p = (
+        offset2[:, :, None, None]
+        + cyc_delta[:, :, :, None]
+        + din_delta[:, :, None, :]
+    )
+    bounded = np.minimum(0.0, np.maximum(np.log(err[MAX_QUAL]), log_p))
+    return np.floor(-10.0 * np.log10(np.exp(bounded)) + 0.5).astype(np.int32)
+
+
 @partial(jax.jit, static_argnames=("lmax",))
 def recalibrate_kernel(
     bases, quals, lengths, flags, read_group_idx, has_qual, valid,
@@ -432,9 +484,15 @@ def recalibrate_base_qualities(
     # it host-side from the compact u8 table (n_rg x 94 x cycles x 17,
     # ~4 MB) instead of fetching the full [N, L] qual matrix (~100 MB on
     # a WGS-scale batch; the device link is the pipeline bottleneck)
-    phred_table = np.asarray(
-        recalibration_phred_table(total, mism).astype(jnp.uint8)
-    )
+    # table math runs wherever the histograms live: host arrays (the
+    # single-chip native-observe path) stay host; device arrays (the
+    # sharded psum path) use the device kernel and fetch the tiny table
+    if isinstance(total, np.ndarray):
+        phred_table = recalibration_phred_table_np(total, mism).astype(np.uint8)
+    else:
+        phred_table = np.asarray(
+            recalibration_phred_table(total, mism).astype(jnp.uint8)
+        )
     gl = lmax  # _observe_device's grid-aligned lane count (table width)
     n_rg = phred_table.shape[0]
     n_cyc = phred_table.shape[2]

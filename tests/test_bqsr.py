@@ -96,3 +96,22 @@ def test_snp_table(ref_resources):
         np.array([[16050611, 16050610], [16050611, -1]]),
     )
     np.testing.assert_array_equal(mask, [[True, False], [False, False]])
+
+
+def test_phred_table_host_device_parity(ref_resources):
+    """The host (numpy) recalibration table must match the device kernel
+    bit-for-bit on real observation data."""
+    import jax.numpy as jnp
+
+    from adam_tpu.io.context import load_alignments
+    from adam_tpu.pipelines import bqsr as B
+
+    ds = load_alignments(str(ref_resources / "bqsr1.sam"))
+    obs = build_observation_table(ds)
+    host = B.recalibration_phred_table_np(obs.total, obs.mismatches)
+    dev = np.asarray(
+        B.recalibration_phred_table(
+            jnp.asarray(obs.total), jnp.asarray(obs.mismatches)
+        )
+    )
+    np.testing.assert_array_equal(host, dev)
