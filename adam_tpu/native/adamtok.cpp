@@ -726,6 +726,12 @@ void bqsr_observe(
   memset(mism, 0, size_t(size) * 8);
   if (nthreads < 1) nthreads = 1;
   int nt = (N < 4096) ? 1 : nthreads;
+  // each thread owns a private histogram pair (16 bytes/cell); cap the
+  // fan-out so the scratch stays under ~1 GB even for many read groups
+  constexpr int64_t kScratchBudget = 1LL << 30;
+  int64_t max_nt = kScratchBudget / (size * 16);
+  if (max_nt < 1) max_nt = 1;
+  if (nt > max_nt) nt = int(max_nt);
   std::vector<std::vector<int64_t>> loc_t(nt), loc_m(nt);
   auto work = [&](int t, int64_t lo, int64_t hi) {
     auto& lt = loc_t[t];

@@ -220,10 +220,14 @@ class ObservationTable:
 def _observe_device(
     ds: AlignmentDataset, known_snps: Optional[SnpTable] = None
 ):
-    """Run the observation pass; returns (total, mism) left ON DEVICE plus
-    (rg_names, lmax).  Host work is only mask-building; the histograms are
-    fetched lazily by callers that need them host-side (CSV dump), so the
-    recalibration pass can consume them without a device round-trip."""
+    """Run the observation pass -> (total, mism, rg_names, lmax).
+
+    The histograms are **host numpy arrays** when the native threaded
+    histogram ran (the single-chip default), and **device arrays** when
+    the jit scatter-add fallback ran; downstream consumers dispatch on
+    ``isinstance(total, np.ndarray)`` so each path stays on its side of
+    the device link (the sharded psum variant lives in
+    parallel/dist.distributed_observe)."""
     b = ds.batch.to_numpy()
     lmax = b.lmax
     is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar, need_ref_codes=False)
@@ -270,9 +274,10 @@ def _observe_device(
     # [N, L] mask arrays to a possibly-throttled device.
     from adam_tpu import native
 
+    include = residue_ok & read_ok[:, None]
     nat = native.bqsr_observe(
         b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
-        residue_ok & read_ok[:, None], is_mm, read_ok, n_rg, gl,
+        include, is_mm, read_ok, n_rg, gl,
     )
     if nat is not None:
         total, mism = nat  # host arrays: downstream table math stays host
@@ -292,13 +297,16 @@ def _observe_device(
     # visit accounting (BaseQualityRecalibration.scala:99-123's logging)
     import logging
 
-    logging.getLogger(__name__).info(
-        "BQSR observe: %d reads eligible of %d; %d residues visited, "
-        "%d residues filtered",
-        int(read_ok.sum()), int(np.asarray(b.valid).sum()),
-        int((residue_ok & read_ok[:, None]).sum()),
-        int((~residue_ok & read_ok[:, None]).sum()),
-    )
+    log = logging.getLogger(__name__)
+    if log.isEnabledFor(logging.INFO):
+        n_visited = int(include.sum())
+        log.info(
+            "BQSR observe: %d reads eligible of %d; %d residues visited, "
+            "%d residues filtered",
+            int(read_ok.sum()), int(np.asarray(b.valid).sum()),
+            n_visited,
+            int(read_ok.sum() * b.lmax) - n_visited,
+        )
     return total, mism, rg_names, gl
 
 
