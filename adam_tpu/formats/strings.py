@@ -259,3 +259,25 @@ def _span_local_positions(lens: np.ndarray) -> np.ndarray:
         return np.zeros(0, dtype=np.int64)
     flat_starts = np.concatenate([[0], np.cumsum(lens[:-1])])
     return np.arange(total, dtype=np.int64) - np.repeat(flat_starts, lens)
+
+
+def with_overrides(col: "StringColumn", overrides: dict) -> "StringColumn":
+    """Replace a sparse set of rows ({row: str|None}) in one vectorized
+    pass — the whole column is never materialized as python strings."""
+    if not overrides:
+        return col
+    n = len(col)
+    idx = np.fromiter(sorted(overrides), np.int64, len(overrides))
+    vals = [overrides[int(i)] for i in idx]
+    enc = [v.encode("utf-8") if v is not None else b"" for v in vals]
+    lens = np.zeros(n, np.int64)
+    lens[idx] = [len(e) for e in enc]
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    buf = np.frombuffer(b"".join(enc), np.uint8)
+    valid = col.valid.copy()
+    valid[idx] = [v is not None for v in vals]
+    repl = StringColumn(buf, offsets, valid)
+    mask = np.zeros(n, bool)
+    mask[idx] = True
+    return StringColumn.where(mask, repl, col)

@@ -467,19 +467,27 @@ def realign_indels(
     )
 
     # group rows by target, position-sorted within the group (the
-    # reference sorts the RDD before target mapping)
+    # reference sorts the RDD before target mapping) — vectorized:
+    # lexsort then split at target boundaries, no per-read python loop
+    sel = np.flatnonzero(mapped & (tidx >= 0))
     groups: dict[int, list[int]] = {}
-    for i in np.flatnonzero(mapped):
-        t = int(tidx[i])
-        if t >= 0:
-            groups.setdefault(t, []).append(i)
-    for rows in groups.values():
-        rows.sort(key=lambda i: (int(b.start[i]), i))
+    if len(sel):
+        order = np.lexsort(
+            (sel, np.asarray(b.start)[sel].astype(np.int64), tidx[sel])
+        )
+        srows = sel[order]
+        stid = tidx[srows]
+        bounds = np.flatnonzero(np.diff(stid) != 0) + 1
+        for chunk in np.split(srows, bounds):
+            groups[int(tidx[chunk[0]])] = [int(i) for i in chunk]
 
     new_batch = jax.tree.map(np.array, b)  # writable copies
     side = ds.sidecar
-    new_md = list(side.md)
-    new_attrs = list(side.attrs)
+    # sparse overrides: only realigned rows get new MD/attrs — the full
+    # sidecar is never materialized as python strings (8M reads would
+    # cost ~30s just in string churn)
+    new_md: dict[int, Optional[str]] = {}
+    new_attrs: dict[int, str] = {}
     rng = rng or random.Random(0)
 
     # ---- phase 1 (host): per group, rebuild reference + consensuses ----
@@ -569,7 +577,7 @@ def realign_indels(
             consensuses = rng.sample(consensuses, max_consensus_number)
         if not consensuses:
             # still keep preprocessing results (readsToClean ++ realigned)
-            _write_back(new_batch, new_md, new_attrs, to_clean, realigned={})
+            _write_back(new_batch, side, new_md, new_attrs, to_clean, realigned={})
             continue
 
         group_ctx[t] = (to_clean, consensuses, reference, ref_start, ref_end)
@@ -684,9 +692,15 @@ def realign_indels(
                 realigned[ri] = dc_replace(
                     r, start=new_start, cigar=new_cigar, md=md, mapq=r.mapq + 10
                 ), new_end
-        _write_back(new_batch, new_md, new_attrs, to_clean, realigned)
+        _write_back(new_batch, side, new_md, new_attrs, to_clean, realigned)
 
-    new_side = dc_replace(side, md=new_md, attrs=new_attrs)
+    from adam_tpu.formats.strings import StringColumn, with_overrides
+
+    new_side = dc_replace(
+        side,
+        md=with_overrides(StringColumn.of(side.md), new_md),
+        attrs=with_overrides(StringColumn.of(side.attrs), new_attrs),
+    )
     return ds.with_batch(new_batch, new_side)
 
 
@@ -715,8 +729,12 @@ def _sw_preprocess(reads, reference, ref_start, weights):
     return out
 
 
-def _write_back(new_batch, new_md, new_attrs, to_clean, realigned):
-    """Apply (possibly realigned) host reads back into the batch."""
+def _write_back(new_batch, side, new_md, new_attrs, to_clean, realigned):
+    """Apply (possibly realigned) host reads back into the batch.
+
+    MD/attr updates land in the sparse ``new_md``/``new_attrs`` override
+    dicts (row -> str), merged into the sidecar columns in one pass at
+    the end of realign_indels."""
     cmax = new_batch.cmax
     for ri, r in enumerate(to_clean):
         if ri in realigned:
@@ -727,9 +745,8 @@ def _write_back(new_batch, new_md, new_attrs, to_clean, realigned):
                 int(new_batch.cigar_n[rr.row]),
             )
             tag = f"OC:Z:{old_cigar}\tOP:i:{old_start + 1}"
-            new_attrs[rr.row] = (
-                new_attrs[rr.row] + "\t" + tag if new_attrs[rr.row] else tag
-            )
+            cur = new_attrs.get(rr.row, side.attrs[rr.row]) or ""
+            new_attrs[rr.row] = cur + "\t" + tag if cur else tag
         else:
             rr, new_end = r, None
         cig = cigar_to_string(rr.cigar)
@@ -745,4 +762,5 @@ def _write_back(new_batch, new_md, new_attrs, to_clean, realigned):
             new_batch.end[rr.row] = new_end
         else:
             new_batch.end[rr.row] = rr.end
-        new_md[rr.row] = rr.md.to_string() if rr.md is not None else new_md[rr.row]
+        if rr.md is not None:
+            new_md[rr.row] = rr.md.to_string()
