@@ -712,9 +712,15 @@ int adamtok_version() { return 5; }
 // observe_kernel (scatter-add over (rg, qual, cycle, dinuc)), used on
 // single-device topologies where there is no cross-chip psum to win;
 // per-thread local histograms merged at the end keep it deterministic.
+// residue_ok may be nullptr: the aligned-to-reference filter (M/=/X
+// spans) plus q>0 / base<4 checks are then computed from the cigar
+// columns in-loop — no [N, L] mask or position array ever materializes
+// on the host (known-SNP masking passes an explicit mask instead).
 void bqsr_observe(
     const uint8_t* bases, const uint8_t* quals, const int32_t* lengths,
     const int32_t* flags, const int32_t* rg_idx,
+    const uint8_t* cigar_ops, const int32_t* cigar_lens,
+    const int32_t* cigar_n, int64_t cmax,
     const uint8_t* residue_ok, const uint8_t* is_mm, const uint8_t* read_ok,
     int64_t N, int64_t lmax, int32_t n_rg, int64_t gl,
     int64_t* total, int64_t* mism, int nthreads) {
@@ -738,11 +744,13 @@ void bqsr_observe(
     auto& lm = loc_m[t];
     lt.assign(size_t(size), 0);
     lm.assign(size_t(size), 0);
+    // per-thread scratch: aligned-span flags for one read
+    std::vector<uint8_t> aligned(static_cast<size_t>(lmax), 0);
     for (int64_t i = lo; i < hi; ++i) {
       if (!read_ok[i]) continue;
       const uint8_t* bs = bases + i * lmax;
       const uint8_t* q = quals + i * lmax;
-      const uint8_t* rok = residue_ok + i * lmax;
+      const uint8_t* rok = residue_ok ? residue_ok + i * lmax : nullptr;
       const uint8_t* mm = is_mm + i * lmax;
       int64_t L = lengths[i];
       int32_t fl = flags[i];
@@ -751,8 +759,35 @@ void bqsr_observe(
       int64_t initial = rev ? (second ? -L : L) : (second ? -1 : 1);
       int64_t inc = rev ? (second ? 1 : -1) : (second ? -1 : 1);
       int32_t rg = rg_idx[i] >= 0 && rg_idx[i] < n_rg ? rg_idx[i] : n_rg - 1;
+      if (!rok) {
+        // mark query positions consumed by reference-aligned ops (M/=/X)
+        static const uint8_t kQ[16] = {1, 1, 0, 0, 1, 0, 0, 1, 1,
+                                       0, 0, 0, 0, 0, 0, 0};
+        memset(aligned.data(), 0, size_t(lmax));
+        int64_t qp = 0;
+        int nc = cigar_n[i] > cmax ? int(cmax) : cigar_n[i];
+        for (int k = 0; k < nc && qp < lmax; ++k) {
+          uint8_t op = cigar_ops[i * cmax + k] & 15;
+          int64_t len = cigar_lens[i * cmax + k];
+          if (len < 0) len = 0;
+          bool cq = kQ[op];
+          bool cr = consumes_ref(op);
+          if (cq && cr) {
+            int64_t stop = qp + len;
+            if (stop > lmax) stop = lmax;
+            for (int64_t j2 = qp; j2 < stop; ++j2) aligned[size_t(j2)] = 1;
+          }
+          if (cq) qp += len;
+        }
+      }
       for (int64_t j = 0; j < L && j < lmax; ++j) {
-        if (!rok[j]) continue;
+        if (rok) {
+          if (!rok[j]) continue;
+        } else {
+          if (!aligned[size_t(j)] || q[j] == 0 || q[j] >= QUAL_PAD ||
+              bs[j] >= 4)
+            continue;
+        }
         int64_t cyc = initial + inc * j + gl;
         uint8_t cur = bs[j], prev;
         bool first_machine;

@@ -245,25 +245,8 @@ def _observe_device(
         & has_md
     )
 
-    # residue filter: q>0, ACGT base, aligned to reference, not a known SNP.
-    # Positions are computed host-side: they only feed host filters, and an
-    # int64 [N, L] device fetch would dwarf the pass on a tunneled TPU.
-    ref_pos = cigar_ops.reference_positions_np(
-        b.cigar_ops, b.cigar_lens, b.cigar_n, b.start, lmax
-    )
-    has_ref = ref_pos >= 0
-    quals = np.asarray(b.quals)
-    residue_ok = (quals > 0) & (quals < schema.QUAL_PAD) & (np.asarray(b.bases) < 4) & has_ref
-    if known_snps is not None and len(known_snps):
-        masked = known_snps.mask_positions(
-            ds.seq_dict.names, np.asarray(b.contig_idx), ref_pos
-        )
-        residue_ok &= ~masked
-
     # one extra bin for RG-less reads (the reference's null readGroup)
     n_rg = len(ds.read_groups) + 1
-    # grid-pad rows+lanes so the device sees a cache-stable, aligned
-    # shape; the padded rows have read_ok=False so they contribute nothing
     from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
 
     g = grid_rows(b.n_rows)
@@ -274,10 +257,32 @@ def _observe_device(
     # [N, L] mask arrays to a possibly-throttled device.
     from adam_tpu import native
 
-    include = residue_ok & read_ok[:, None]
+    snp_active = known_snps is not None and len(known_snps)
+    residue_ok = None
+    if snp_active or not native.available():
+        # residue filter: q>0, ACGT base, aligned to reference, not a
+        # known SNP — built host-side only when actually needed (the
+        # int64 [N, L] position array is ~3 GB at WGS batch sizes)
+        ref_pos = cigar_ops.reference_positions_np(
+            b.cigar_ops, b.cigar_lens, b.cigar_n, b.start, lmax
+        )
+        quals = np.asarray(b.quals)
+        residue_ok = (
+            (quals > 0) & (quals < schema.QUAL_PAD)
+            & (np.asarray(b.bases) < 4) & (ref_pos >= 0)
+        )
+        if snp_active:
+            masked = known_snps.mask_positions(
+                ds.seq_dict.names, np.asarray(b.contig_idx), ref_pos
+            )
+            residue_ok &= ~masked
+        del ref_pos
+
     nat = native.bqsr_observe(
         b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
-        include, is_mm, read_ok, n_rg, gl,
+        b.cigar_ops, b.cigar_lens, b.cigar_n,
+        residue_ok & read_ok[:, None] if residue_ok is not None else None,
+        is_mm, read_ok, n_rg, gl,
     )
     if nat is not None:
         total, mism = nat  # host arrays: downstream table math stays host
@@ -299,7 +304,7 @@ def _observe_device(
 
     log = logging.getLogger(__name__)
     if log.isEnabledFor(logging.INFO):
-        n_visited = int(include.sum())
+        n_visited = int(np.asarray(total).sum())
         log.info(
             "BQSR observe: %d reads eligible of %d; %d residues visited, "
             "%d residues filtered",
