@@ -13,6 +13,9 @@ import sys
 import pathlib
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# don't share the persistent compile cache with tunneled-backend runs:
+# its "cpu" entries may be AOT results for a different machine
+os.environ.setdefault("ADAM_TPU_NO_COMPILE_CACHE", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
