@@ -228,14 +228,29 @@ def write_fastq(
     import numpy as np
 
     b = batch.to_numpy()
+    select = np.asarray(b.valid).copy()
+    if row_mask is not None:
+        select &= np.asarray(row_mask, bool)
+    if predicate is not None:
+        flags = np.asarray(b.flags)
+        select &= np.fromiter(
+            (bool(predicate(int(f))) for f in flags), bool, len(flags)
+        )
+
+    from adam_tpu import native
+
+    nat = (
+        native.fastq_encode(b, side, select, add_suffix)
+        if not str(path).endswith(".gz")
+        else None
+    )
+    if nat is not None:
+        with open(path, "wb") as fh:
+            fh.write(nat)
+        return
+
     with _open(path, "wt") as fh:
-        for i in range(b.n_rows):
-            if not b.valid[i]:
-                continue
-            if row_mask is not None and not row_mask[i]:
-                continue
-            if predicate is not None and not predicate(int(b.flags[i])):
-                continue
+        for i in np.flatnonzero(select):
             fh.write(
                 format_fastq_record(
                     side.names[i], b.bases[i], b.quals[i], int(b.lengths[i]),

@@ -144,6 +144,12 @@ def _lib() -> Optional[ct.CDLL]:
                 ct.c_int64, ct.c_int64, ct.c_int32, ct.c_int64,
                 _i64p, _i64p, ct.c_int,
             ]
+            lib.fastq_encode.restype = ct.c_int64
+            lib.fastq_encode.argtypes = [
+                _i32p, _i32p, _u8p, _u8p, _u8p, ct.c_int64,
+                _u8p, _i64p, ct.c_int, ct.c_int64, _u8p, ct.c_int64,
+                ct.c_int,
+            ]
             lib.bqsr_apply.argtypes = [
                 _u8p, _u8p, _i32p, _i32p, _i32p, _u8p, _u8p,
                 ct.c_int64, ct.c_int64,
@@ -715,3 +721,36 @@ def bqsr_observe(bases, quals, lengths, flags, rg_idx,
         ct.c_int(_nthreads()),
     )
     return total, mism
+
+
+def fastq_encode(batch, side, select, add_suffix: bool) -> Optional[bytes]:
+    """Format selected rows as FASTQ text; None -> python fallback."""
+    lib = _lib()
+    if lib is None:
+        return None
+    import jax
+
+    from adam_tpu.formats.strings import StringColumn
+
+    b = jax.tree.map(lambda x: np.asarray(x), batch)
+    n = b.n_rows
+    names = StringColumn.of(side.names)
+    if len(names) < n:
+        return None
+    lens = np.where(select, b.lengths, 0).astype(np.int64)
+    cap = int(int(names.offsets[-1]) + 2 * int(lens.sum()) + 16 * n + 64)
+    out = _pretouch(np.empty(cap, np.uint8))
+    got = lib.fastq_encode(
+        np.ascontiguousarray(b.flags, np.int32).ctypes.data_as(_i32p),
+        np.ascontiguousarray(b.lengths, np.int32).ctypes.data_as(_i32p),
+        _u8_ptr(np.ascontiguousarray(select, np.uint8)),
+        _u8_ptr(np.ascontiguousarray(b.bases, np.uint8).reshape(-1)),
+        _u8_ptr(np.ascontiguousarray(b.quals, np.uint8).reshape(-1)),
+        ct.c_int64(b.lmax),
+        _u8_ptr(names.buf), names.offsets.ctypes.data_as(_i64p),
+        ct.c_int(1 if add_suffix else 0),
+        ct.c_int64(n), _u8_ptr(out), ct.c_int64(cap), ct.c_int(_nthreads()),
+    )
+    if got < 0:
+        return None
+    return out[:got].tobytes()

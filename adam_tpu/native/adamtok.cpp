@@ -827,6 +827,74 @@ void bqsr_observe(
   }
 }
 
+// ------------------------------------------------------ FASTQ encode ----
+
+// Format selected rows as FASTQ records (convertToFastq semantics:
+// reverse-strand reads are reverse-complemented back to sequencer
+// orientation, quals reversed; /1 /2 suffixes for paired reads when
+// add_suffix). Two-pass like sam_encode. Returns bytes, -2 if cap small.
+int64_t fastq_encode(
+    const int32_t* flags, const int32_t* lengths,
+    const uint8_t* select, const uint8_t* bases, const uint8_t* quals,
+    int64_t lmax, const uint8_t* name_buf, const int64_t* name_off,
+    int add_suffix, int64_t N, uint8_t* out, int64_t cap, int nthreads) {
+  static const char kBase[6] = {'A', 'C', 'G', 'T', 'N', '.'};
+  static const uint8_t kComp[6] = {3, 2, 1, 0, 4, 5};
+  if (nthreads < 1) nthreads = 1;
+  std::vector<int64_t> sizes(size_t(N) + 1, 0);
+
+  auto emit = [&](int64_t i, uint8_t* w) -> int64_t {
+    int64_t n_w = 0;
+    auto putc_ = [&](char c) {
+      if (w) w[n_w] = uint8_t(c);
+      ++n_w;
+    };
+    int64_t L = lengths[i];
+    if (L > lmax) L = lmax;
+    int32_t fl = flags[i];
+    bool rev = fl & 0x10;
+    putc_('@');
+    int64_t nm = name_off[i + 1] - name_off[i];
+    if (w) memcpy(w + n_w, name_buf + name_off[i], size_t(nm));
+    n_w += nm;
+    if (add_suffix && (fl & 0x1)) {
+      putc_('/');
+      putc_((fl & 0x40) ? '1' : '2');
+    }
+    putc_('\n');
+    const uint8_t* bs = bases + i * lmax;
+    for (int64_t j = 0; j < L; ++j) {
+      uint8_t c = rev ? bs[L - 1 - j] : bs[j];
+      if (c > 5) c = 5;
+      putc_(kBase[rev ? kComp[c] : c]);
+    }
+    putc_('\n');
+    putc_('+');
+    putc_('\n');
+    const uint8_t* q = quals + i * lmax;
+    for (int64_t j = 0; j < L; ++j)
+      putc_(char(uint8_t(q[rev ? L - 1 - j : j] + 33)));
+    putc_('\n');
+    return n_w;
+  };
+
+  auto pass = [&](bool fill) {
+    auto work = [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; ++i) {
+        if (!select[i]) continue;
+        if (fill) emit(i, out + sizes[size_t(i)]);
+        else sizes[size_t(i) + 1] = emit(i, nullptr);
+      }
+    };
+    parallel_rows(N, nthreads, work);
+  };
+  pass(false);
+  for (int64_t i = 0; i < N; ++i) sizes[size_t(i) + 1] += sizes[size_t(i)];
+  if (sizes[size_t(N)] > cap) return -2;
+  pass(true);
+  return sizes[size_t(N)];
+}
+
 // -------------------------------------------------------- BQSR apply ----
 
 // Apply the recalibration phred table to every residue: the host twin of

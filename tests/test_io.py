@@ -549,3 +549,23 @@ def test_native_sam_writer_bytewise(ref_resources, tmp_path):
     finally:
         native.sam_encode = orig
     assert p_nat.read_bytes() == p_py.read_bytes()
+
+
+def test_native_fastq_writer_bytewise(ref_resources, tmp_path):
+    """The C++ FASTQ formatter matches the python writer byte for byte
+    (revcomp of reverse-strand reads, /1 /2 suffixes)."""
+    from adam_tpu import native
+    from adam_tpu.io import fastq as fq
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    ds = ctx.load_alignments(str(ref_resources / "small.sam"))
+    p_nat, p_py = tmp_path / "n.fq", tmp_path / "p.fq"
+    fq.write_fastq(str(p_nat), ds.batch, ds.sidecar)
+    orig = native.fastq_encode
+    native.fastq_encode = lambda *a, **k: None
+    try:
+        fq.write_fastq(str(p_py), ds.batch, ds.sidecar)
+    finally:
+        native.fastq_encode = orig
+    assert p_nat.read_bytes() == p_py.read_bytes()
