@@ -161,10 +161,13 @@ class StringColumn:
         mat = np.ascontiguousarray(mat, dtype=np.uint8)
         lens = np.asarray(lens, dtype=np.int64)
         n, w = mat.shape
-        mask = np.arange(w)[None, :] < lens[:, None]
-        buf = mat[mask]  # row-major: concatenated row prefixes, in order
         offsets = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(lens, out=offsets[1:])
+        if n and (lens == w).all():
+            # uniform full-width rows: the buffer IS the matrix
+            return StringColumn(mat.reshape(-1).copy(), offsets, valid)
+        mask = np.arange(w)[None, :] < lens[:, None]
+        buf = mat[mask]  # row-major: concatenated row prefixes, in order
         return StringColumn(buf, offsets, valid)
 
     @staticmethod

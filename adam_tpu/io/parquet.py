@@ -96,8 +96,16 @@ def _matrix_string_array(mat: np.ndarray, lens: np.ndarray,
 
 def _cigar_string_array(ops: np.ndarray, lens: np.ndarray,
                         n_ops: np.ndarray) -> "pa.Array":
-    """Columnar CIGARs -> arrow string column ('*' when no ops) — one
-    vectorized np.char pass per lane instead of a per-read join loop."""
+    """Columnar CIGARs -> arrow string column ('*' when no ops): native
+    threaded emitter, np.char lane passes as the fallback."""
+    from adam_tpu import native
+    from adam_tpu.formats.strings import StringColumn
+
+    nat = native.cigar_strings(ops, lens, n_ops)
+    if nat is not None:
+        buf, offsets = nat
+        return StringColumn(buf, offsets).to_arrow()
+
     N, C = ops.shape if ops.ndim == 2 else (len(n_ops), 0)
     if C == 0 or N == 0:
         return pa.array(np.full(N, "*", dtype=object), pa.string())

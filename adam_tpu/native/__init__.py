@@ -144,6 +144,11 @@ def _lib() -> Optional[ct.CDLL]:
                 ct.c_int64, ct.c_int64, ct.c_int32, ct.c_int64,
                 _i64p, _i64p, ct.c_int,
             ]
+            lib.cigar_strings.restype = ct.c_int64
+            lib.cigar_strings.argtypes = [
+                _u8p, _i32p, _i32p, ct.c_int64, ct.c_int64,
+                _u8p, ct.c_int64, _i64p, ct.c_int,
+            ]
             lib.fastq_encode.restype = ct.c_int64
             lib.fastq_encode.argtypes = [
                 _i32p, _i32p, _u8p, _u8p, _u8p, ct.c_int64,
@@ -754,3 +759,30 @@ def fastq_encode(batch, side, select, add_suffix: bool) -> Optional[bytes]:
     if got < 0:
         return None
     return out[:got].tobytes()
+
+
+def cigar_strings(cigar_ops, cigar_lens, cigar_n):
+    """Columnar cigars -> (buf u8, offsets i64[N+1]) run-length strings
+    ('*' when no ops); None if native unavailable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    ops = np.ascontiguousarray(cigar_ops, np.uint8)
+    lens = np.ascontiguousarray(cigar_lens, np.int32)
+    n_ops = np.ascontiguousarray(cigar_n, np.int32)
+    n, C = ops.shape if ops.ndim == 2 else (len(n_ops), 0)
+    if C == 0:
+        off = np.arange(n + 1, dtype=np.int64)
+        return np.full(n, ord("*"), np.uint8), off
+    cap = int(12 * int(np.minimum(n_ops, C).clip(0).sum()) + n + 64)
+    out = _pretouch(np.empty(cap, np.uint8))
+    offsets = np.empty(n + 1, np.int64)
+    got = lib.cigar_strings(
+        _u8_ptr(ops.reshape(-1)), lens.ctypes.data_as(_i32p),
+        n_ops.ctypes.data_as(_i32p), ct.c_int64(n), ct.c_int64(C),
+        _u8_ptr(out), ct.c_int64(cap), offsets.ctypes.data_as(_i64p),
+        ct.c_int(_nthreads()),
+    )
+    if got < 0:
+        return None
+    return out[:got], offsets

@@ -827,6 +827,49 @@ void bqsr_observe(
   }
 }
 
+// ----------------------------------------------------- CIGAR strings ----
+
+// Columnar cigars -> concatenated run-length strings + offsets ('*' for
+// cigar-less rows). Returns total bytes, -2 if cap too small.
+int64_t cigar_strings(
+    const uint8_t* ops, const int32_t* lens, const int32_t* n_ops,
+    int64_t N, int64_t C, uint8_t* out, int64_t cap, int64_t* offsets,
+    int nthreads) {
+  std::vector<int64_t> sizes(size_t(N) + 1, 0);
+  auto emit = [&](int64_t i, uint8_t* w) -> int64_t {
+    int nc = n_ops[i] > C ? int(C) : n_ops[i];
+    if (nc == 0) {
+      if (w) *w = '*';
+      return 1;
+    }
+    int64_t n_w = 0;
+    for (int k = 0; k < nc; ++k) {
+      char tmp[16];
+      int n = snprintf(tmp, sizeof tmp, "%d", lens[i * C + k]);
+      if (w) memcpy(w + n_w, tmp, size_t(n));
+      n_w += n;
+      if (w) w[n_w] = "MIDNSHP=X??????\?"[ops[i * C + k] & 0xF];
+      ++n_w;
+    }
+    return n_w;
+  };
+  auto pass = [&](bool fill) {
+    auto work = [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; ++i) {
+        if (fill) emit(i, out + sizes[size_t(i)]);
+        else sizes[size_t(i) + 1] = emit(i, nullptr);
+      }
+    };
+    parallel_rows(N, nthreads, work);
+  };
+  pass(false);
+  for (int64_t i = 0; i < N; ++i) sizes[size_t(i) + 1] += sizes[size_t(i)];
+  if (sizes[size_t(N)] > cap) return -2;
+  pass(true);
+  memcpy(offsets, sizes.data(), size_t(N + 1) * 8);
+  return sizes[size_t(N)];
+}
+
 // ------------------------------------------------------ FASTQ encode ----
 
 // Format selected rows as FASTQ records (convertToFastq semantics:
