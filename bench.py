@@ -14,7 +14,8 @@ reference's Spark-CPU executors — one host, all cores, same vectorized
 code), on a 100k-read slice, and the ratio of reads/sec is reported.
 
 Secondary lines (also printed, one JSON object per line, driver reads
-line 1): Smith-Waterman GCUPS from the Pallas wavefront kernel
+line 1): Smith-Waterman wavefront GCUPS (scan backend; see
+ops/smith_waterman._use_pallas for the measured backend choice)
 (BASELINE.md metric 2), packed k-mer counting throughput (metric 3,
 the count_kmers k=21 config), and the stage split of the e2e run.
 """
@@ -213,7 +214,7 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "secondary",
-                "sw_pallas_gcups": round(gcups, 2),
+                "sw_wavefront_gcups": round(gcups, 2),
                 "kmers_per_sec": round(kps, 1),
                 "cpu_baseline_reads_per_sec": round(cpu_rps, 1),
                 "stages_s": {
