@@ -235,11 +235,19 @@ class Transform(Command):
             elif args.force_load_fastq:
                 ds = context.load_fastq(args.input)
             elif args.force_load_ifastq:
-                ds = context.load_interleaved_fastq(args.input)
+                ds = context.load_interleaved_fastq(
+                    args.input, stringency=args.stringency
+                )
             elif args.force_load_parquet:
                 ds = context.load_parquet_alignments(args.input)
             else:
-                ds = context.load_alignments(args.input)
+                kw = {}
+                base = str(args.input)
+                if base.endswith(".gz"):
+                    base = base[:-3]
+                if base.endswith(".ifq"):
+                    kw["stringency"] = args.stringency
+                ds = context.load_alignments(args.input, **kw)
 
         if args.repartition != -1 or args.coalesce != -1:
             import logging
@@ -378,7 +386,9 @@ class Adam2Fastq(Command):
             kw["projection"] = ["readName", "sequence", "qual", "flags"]
         ds = context.load_alignments(args.input, **kw)
         if args.output2:
-            ds.save_paired_fastq(args.output, args.output2)
+            ds.save_paired_fastq(
+                args.output, args.output2, stringency=args.stringency
+            )
         else:
             from adam_tpu.io import fastq
 

@@ -687,7 +687,7 @@ def write_bam(
 
     from adam_tpu import native
 
-    nat = native.bam_encode(b, side, rg_names)
+    nat = native.bam_encode(b, side, rg_names, len(sd))
     if nat is not None:
         body.write(nat)
         with open(path, "wb") as fh:

@@ -185,7 +185,7 @@ def _lib() -> Optional[ct.CDLL]:
                 _u8p, _i64p,
                 _u8p, _i64p, _u8p,
                 _u8p, _i64p, _u8p,
-                _i32p, _u8p, _i64p, ct.c_int32,
+                _i32p, _u8p, _i64p, ct.c_int32, ct.c_int32,
                 ct.c_int64, _u8p, ct.c_int64, ct.c_int,
             ]
             _LIB = lib
@@ -608,10 +608,13 @@ def _encode_prep(batch, side, rg_names: Sequence[str]):
     return n, args, base_cap, keep
 
 
-def bam_encode(batch, side, rg_names: Sequence[str]) -> Optional[bytes]:
+def bam_encode(batch, side, rg_names: Sequence[str],
+               n_refs: int) -> Optional[bytes]:
     """Encode a (ReadBatch, ReadSidecar) into the BAM record stream
     (everything after the reference list); None -> caller falls back to
-    the pure-Python writer."""
+    the pure-Python writer.  ``n_refs`` bounds contig/mate refIDs — an
+    out-of-range index fails the encode rather than emitting a BAM whose
+    refID points outside the reference list."""
     lib = _lib()
     if lib is None:
         return None
@@ -622,8 +625,8 @@ def bam_encode(batch, side, rg_names: Sequence[str]) -> Optional[bytes]:
     cap = int(n * 80 + base_cap)
     out = _pretouch(np.empty(cap, np.uint8))
     got = lib.bam_encode(
-        *args, ct.c_int64(n), _u8_ptr(out), ct.c_int64(cap),
-        ct.c_int(_nthreads()),
+        *args, ct.c_int32(int(n_refs)), ct.c_int64(n), _u8_ptr(out),
+        ct.c_int64(cap), ct.c_int(_nthreads()),
     )
     if got < 0:
         return None

@@ -382,13 +382,17 @@ struct BgzfHandle {
   int64_t consumed = 0;
 };
 
-// returns header length and total block size via *bsize, or -1 if not BGZF
+// returns header length and total block size via *bsize; -1 if not BGZF
+// (bad magic / no BC subfield), -2 if the header is cut short by the end
+// of the buffer (streaming windows need more bytes, not an error)
 int64_t bgzf_block_header(const uint8_t* p, int64_t avail, int64_t* bsize) {
-  if (avail < 18 || p[0] != 0x1f || p[1] != 0x8b || p[2] != 8 ||
-      !(p[3] & 4))
-    return -1;
+  if (avail >= 1 && p[0] != 0x1f) return -1;
+  if (avail >= 2 && p[1] != 0x8b) return -1;
+  if (avail >= 3 && p[2] != 8) return -1;
+  if (avail >= 4 && !(p[3] & 4)) return -1;
+  if (avail < 18) return -2;
   uint16_t xlen = uint16_t(p[10]) | (uint16_t(p[11]) << 8);
-  if (avail < 12 + xlen) return -1;
+  if (avail < 12 + xlen) return -2;
   const uint8_t* x = p + 12;
   const uint8_t* xe = x + xlen;
   while (x + 4 <= xe) {
@@ -570,6 +574,16 @@ inline int64_t tag_to_bin(const uint8_t* f, const uint8_t* fe, uint8_t* out) {
   int64_t vlen = fe - val;
   char typ = char(f[3]);
   int64_t w = 0;
+  // strtof needs a NUL terminator; the attrs buffer has none, so copy the
+  // bounded [p, pe) field into a stack buffer before parsing (ADVICE r2)
+  auto parse_f32 = [](const uint8_t* p, const uint8_t* pe) -> float {
+    char buf[64];
+    size_t n = size_t(pe - p);
+    if (n >= sizeof(buf)) n = sizeof(buf) - 1;
+    memcpy(buf, p, n);
+    buf[n] = 0;
+    return strtof(buf, nullptr);
+  };
   auto put8 = [&](uint8_t v) { if (out) out[w] = v; ++w; };
   auto put_bytes = [&](const uint8_t* p, int64_t n) {
     if (out) memcpy(out + w, p, size_t(n));
@@ -601,7 +615,7 @@ inline int64_t tag_to_bin(const uint8_t* f, const uint8_t* fe, uint8_t* out) {
       break;
     }
     case 'f': {
-      float fv = strtof(reinterpret_cast<const char*>(val), nullptr);
+      float fv = parse_f32(val, fe);
       put8('f');
       put_bytes(reinterpret_cast<uint8_t*>(&fv), 4);
       break;
@@ -628,7 +642,7 @@ inline int64_t tag_to_bin(const uint8_t* f, const uint8_t* fe, uint8_t* out) {
         const uint8_t* q = p;
         while (q < fe && *q != ',') ++q;
         if (sub == 'f') {
-          float fv = strtof(reinterpret_cast<const char*>(p), nullptr);
+          float fv = parse_f32(p, q);
           put_bytes(reinterpret_cast<uint8_t*>(&fv), 4);
         } else {
           bool ok;
@@ -1155,7 +1169,8 @@ int64_t bam_encode(
     const uint8_t* md_buf, const int64_t* md_off, const uint8_t* md_present,
     const uint8_t* oq_buf, const int64_t* oq_off, const uint8_t* oq_present,
     const int32_t* rg_idx, const uint8_t* rg_buf, const int64_t* rg_off,
-    int32_t n_rgs, int64_t N, uint8_t* out, int64_t cap, int nthreads) {
+    int32_t n_rgs, int32_t n_refs, int64_t N, uint8_t* out, int64_t cap,
+    int nthreads) {
   static const uint8_t kNib[6] = {1, 2, 4, 8, 15, 0};  // A C G T N PAD
   if (nthreads < 1) nthreads = 1;
   std::vector<int64_t> sizes(size_t(N) + 1, 0);
@@ -1187,6 +1202,9 @@ int64_t bam_encode(
   auto size_one = [&](int64_t i) -> int64_t {
     if (!valid[i]) return 0;
     if (rg_idx[i] >= n_rgs) return -1;  // corrupt batch: fail loudly
+    // an out-of-range refID would poison the BAM silently (sam_encode's
+    // contig lookup fails loudly; mirror that here)
+    if (contig_idx[i] >= n_refs || mate_contig_idx[i] >= n_refs) return -1;
     const uint8_t *a, *md, *oq, *rg;
     int64_t al, mdl, oql, rgl;
     bool hmd, hoq, hrg;
@@ -1514,7 +1532,7 @@ void* bgzf_scan2(const uint8_t* buf, int64_t n, int partial_ok) {
     int64_t bsize = 0;
     int64_t hl = bgzf_block_header(buf + off, n - off, &bsize);
     if (hl < 0 || bsize < hl + 8 || off + bsize > n) {
-      bool truncated = (hl < 0 && n - off < 18) || (hl >= 0 && off + bsize > n);
+      bool truncated = hl == -2 || (hl >= 0 && off + bsize > n);
       if (partial_ok && truncated) break;
       delete h;
       return nullptr;

@@ -145,9 +145,12 @@ def partition_by_contig(contig_idx, n_partitions: int | None = None):
     uniq = np.unique(contig_idx[contig_idx >= 0])
     if n_partitions is None:
         n_partitions = max(1, len(uniq)) + 1
+    # rank-encode before the modulo: raw ids can be sparse/high, which
+    # would collide distinct contigs while leaving partitions empty
+    rank = np.searchsorted(uniq, np.clip(contig_idx, 0, None))
     part = np.where(
         contig_idx >= 0,
-        contig_idx % max(1, n_partitions - 1),
+        rank % max(1, n_partitions - 1),
         n_partitions - 1,
     )
     return part.astype(np.int32)

@@ -174,6 +174,17 @@ def test_partition_by_contig():
     assert sorted(np.concatenate(shards).tolist()) == list(range(6))
 
 
+def test_partition_by_contig_sparse_ids():
+    """Sparse/high contig ids must not collide while partitions sit empty:
+    ranks, not raw ids, feed the modulo (default = one partition per
+    contig present)."""
+    ci = np.array([7, 7, 40, 40, 1000, -1])
+    part = partitioner.partition_by_contig(ci)
+    mapped = part[[0, 2, 4]]
+    assert len(set(mapped.tolist())) == 3  # distinct contigs, distinct parts
+    assert part[5] == part.max()  # unplaced -> dedicated last partition
+
+
 def test_host_shuffle_bam_to_shards(tmp_path):
     """Out-of-core genome shuffle: windowed BAM -> per-bin Parquet shards
     with no whole-dataset residency (SURVEY §2.6's host-level exchange
