@@ -30,6 +30,13 @@ Package layout (mirrors the reference's layer map, SURVEY.md §1):
 
 import os
 
+# pyarrow's bundled mimalloc segfaults in mi_thread_init when arrow spawns
+# IO threads after short-lived Python threads that touched mimalloc TLS
+# have exited (exactly the streamed transform's writer-pool shape) — pin
+# the system allocator before pyarrow initializes.  io/parquet.py repeats
+# this via set_memory_pool for processes that imported pyarrow first.
+os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+
 import jax
 
 # Genomic coordinates, flattened genome offsets and 2-bit packed k-mers all

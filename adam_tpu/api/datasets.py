@@ -122,6 +122,21 @@ class AlignmentDataset:
         """Drop invalid (padding/filtered) rows."""
         return self.take_rows(np.flatnonzero(np.asarray(self.batch.valid)))
 
+    @staticmethod
+    def concat(parts: list["AlignmentDataset"]) -> "AlignmentDataset":
+        """Splice datasets sharing a header (window/shard reassembly)."""
+        if not parts:
+            from adam_tpu.io.sam import SamHeader
+
+            return AlignmentDataset(ReadBatch.empty(), ReadSidecar(), SamHeader())
+        if len(parts) == 1:
+            return parts[0]
+        return AlignmentDataset(
+            ReadBatch.concat([p.batch for p in parts]),
+            ReadSidecar.concat([p.sidecar for p in parts]),
+            parts[0].header,
+        )
+
     # ---------------------------------------------------------- transforms
     def sort_by_reference_position(self) -> "AlignmentDataset":
         from adam_tpu.pipelines import sort

@@ -241,13 +241,9 @@ class Transform(Command):
             elif args.force_load_parquet:
                 ds = context.load_parquet_alignments(args.input)
             else:
-                kw = {}
-                base = str(args.input)
-                if base.endswith(".gz"):
-                    base = base[:-3]
-                if base.endswith(".ifq"):
-                    kw["stringency"] = args.stringency
-                ds = context.load_alignments(args.input, **kw)
+                ds = context.load_alignments(
+                    args.input, stringency=args.stringency
+                )
 
         if args.repartition != -1 or args.coalesce != -1:
             import logging

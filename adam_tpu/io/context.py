@@ -111,7 +111,12 @@ def load_genotypes(path: str, **kw):
     return load_vcf(path, **kw)
 
 
-def load_alignments(path: str, **kw) -> AlignmentDataset:
+def load_alignments(
+    path: str, stringency: Optional[str] = None, **kw
+) -> AlignmentDataset:
+    """``stringency`` is forwarded to the loaders that validate pairing
+    (interleaved FASTQ); other formats ignore it — callers (the CLI's
+    common ``-stringency`` flag) need not know the dispatch rule."""
     p = str(path)
     base = p[:-3] if p.endswith(".gz") else p
     if base.endswith(".sam"):
@@ -119,6 +124,8 @@ def load_alignments(path: str, **kw) -> AlignmentDataset:
     if base.endswith(".bam"):
         return load_bam(path, **kw)
     if base.endswith(".ifq"):
+        if stringency is not None:
+            kw["stringency"] = stringency
         return load_interleaved_fastq(path, **kw)
     if base.endswith((".fq", ".fastq")):
         return load_fastq(path, **kw)

@@ -20,6 +20,14 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+# See adam_tpu/__init__: arrow's bundled mimalloc corrupts its TLS list
+# under short-lived-thread churn; force the system pool even when pyarrow
+# was imported (and the env default missed) before adam_tpu.
+try:
+    pa.set_memory_pool(pa.system_memory_pool())
+except Exception:
+    pass
+
 from adam_tpu.formats import schema
 from adam_tpu.formats.batch import ReadBatch, ReadSidecar, pack_reads
 from adam_tpu.io.sam import SamHeader

@@ -191,6 +191,57 @@ def _columns_to_batch(
     return batch, side
 
 
+def iter_sam_batches(path: str, batch_reads: int = 262_144):
+    """Windowed SAM reader: yields (ReadBatch, ReadSidecar, SamHeader)
+    chunks of ~``batch_reads`` records each (line-exact windowing).
+
+    The text-SAM twin of :func:`iter_bam_batches`, sized so a streamed
+    transform can overlap tokenization of window i+1 with compute on
+    window i (the Bam2ADAM queue design, adam-cli Bam2ADAM.scala:55-111).
+    Requires the native tokenizer; whole-file :func:`read_sam` is the
+    fallback.
+    """
+    from adam_tpu import native
+
+    if not native.available():
+        batch, side, header = read_sam(path)
+        yield batch, side, header
+        return
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rb") as fh:
+        data = fh.read()
+    body_off = 0
+    header_lines = []
+    while body_off < len(data) and data[body_off : body_off + 1] == b"@":
+        nl = data.find(b"\n", body_off)
+        end = nl if nl >= 0 else len(data)
+        line = data[body_off:end]
+        if line.endswith(b"\r"):
+            line = line[:-1]
+        header_lines.append(line.decode("utf-8", "replace"))
+        body_off = end + 1
+    header = SamHeader.parse(header_lines)
+    buf = np.frombuffer(data, np.uint8)
+    ends = np.flatnonzero(buf[body_off:] == 10) + body_off + 1
+    starts = np.concatenate([[body_off], ends])
+    if starts[-1] < len(data):  # unterminated final line
+        starts = np.concatenate([starts, [len(data)]])
+    n_lines = len(starts) - 1
+    if n_lines <= 0:
+        yield ReadBatch.empty(), ReadSidecar(), header
+        return
+    for lo in range(0, n_lines, batch_reads):
+        hi = min(lo + batch_reads, n_lines)
+        chunk = data[starts[lo] : starts[hi]]
+        out = native.tokenize_sam(
+            chunk, 0, header.seq_dict.names, header.read_groups.names
+        )
+        if out is None:
+            raise ValueError(f"{path}: malformed SAM records in window")
+        batch, side = _columns_to_batch(out, 1)
+        yield batch, side, header
+
+
 def read_sam(
     path: str, round_rows_to: int = 1
 ) -> tuple[ReadBatch, ReadSidecar, SamHeader]:

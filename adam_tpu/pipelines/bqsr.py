@@ -481,6 +481,35 @@ def recalibrate_kernel(
     return jnp.where(apply_mask, new_q, quals).astype(jnp.uint8)
 
 
+def merge_observations(parts: list[tuple]) -> tuple:
+    """Sum per-window (total, mism, gl) histograms into one global
+    (total, mism, gl) — the host-side analog of the sharded psum.
+
+    Cycle slots are centered (index = cycle + gl, table width 2*gl+1),
+    so windows with smaller lmax pad into the middle of the widest
+    window's table.
+    """
+    gl = max(p[2] for p in parts)
+    n_cyc = 2 * gl + 1
+    t0 = np.asarray(parts[0][0])
+    shape = (t0.shape[0], t0.shape[1], n_cyc, t0.shape[3])
+    total = np.zeros(shape, np.int64)
+    mism = np.zeros(shape, np.int64)
+    for t, m, g in parts:
+        off = gl - g
+        total[:, :, off : off + 2 * g + 1, :] += np.asarray(t)
+        mism[:, :, off : off + 2 * g + 1, :] += np.asarray(m)
+    return total, mism, gl
+
+
+def solve_recalibration_table(total, mism) -> np.ndarray:
+    """Observation histograms -> compact u8 phred table (the global
+    barrier step between the observe and apply passes)."""
+    if isinstance(total, np.ndarray):
+        return recalibration_phred_table_np(total, mism).astype(np.uint8)
+    return np.asarray(recalibration_phred_table(total, mism).astype(jnp.uint8))
+
+
 def recalibrate_base_qualities(
     ds: AlignmentDataset,
     known_snps: Optional[SnpTable] = None,
@@ -491,7 +520,6 @@ def recalibrate_base_qualities(
         obs = ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
         with open(dump_observation_table, "w") as fh:
             fh.write(obs.to_csv())
-    b = ds.batch.to_numpy()
     # the delta-stack table is built on device from the psum-able
     # histograms, but the per-residue application is a pure GATHER — run
     # it host-side from the compact u8 table (n_rg x 94 x cycles x 17,
@@ -500,13 +528,18 @@ def recalibrate_base_qualities(
     # table math runs wherever the histograms live: host arrays (the
     # single-chip native-observe path) stay host; device arrays (the
     # sharded psum path) use the device kernel and fetch the tiny table
-    if isinstance(total, np.ndarray):
-        phred_table = recalibration_phred_table_np(total, mism).astype(np.uint8)
-    else:
-        phred_table = np.asarray(
-            recalibration_phred_table(total, mism).astype(jnp.uint8)
-        )
-    gl = lmax  # _observe_device's grid-aligned lane count (table width)
+    phred_table = solve_recalibration_table(total, mism)
+    return apply_recalibration(ds, phred_table, lmax)
+
+
+def apply_recalibration(
+    ds: AlignmentDataset, phred_table: np.ndarray, gl: int
+) -> AlignmentDataset:
+    """Apply a solved recalibration table to one batch/window (the
+    Recalibrator.scala:28-60 pass): gather new quals from the compact
+    table, stash originals as OQ.  ``gl`` is the table's grid-aligned
+    lane count (cycle slots span [-gl, gl])."""
+    b = ds.batch.to_numpy()
     n_rg = phred_table.shape[0]
     n_cyc = phred_table.shape[2]
     L = b.lmax

@@ -16,65 +16,22 @@ Picard-style semantics matching ``rdd/read/MarkDuplicates.scala:66-128``:
    fragment-subgroup is wholly marked when pair-subgroups co-exist at the
    same left position; unmapped reads are never marked.
 
-TPU formulation: 5' keys and bucket scores are device kernels (fused
-CIGAR walks + masked segment sums); the group-subgroup-argmax cascade is
-one lexsort + run-boundary scan over the bucket table (no hash
-shuffles), fully vectorized on host — the same sort-and-segment shape
-the distributed path shards by genome position.  No per-read Python
-anywhere.
+TPU formulation: 5' keys and bucket scores are vectorized per-window
+(masked CIGAR walks + masked segment sums) so they pipeline with ingest;
+the group-subgroup-argmax cascade is one lexsort + run-boundary scan
+over the *global* bucket table (no hash shuffles).  The split is the
+same shape the sharded path uses: compact per-row summaries travel,
+[N, L] matrices never do.  No per-read Python anywhere.
 """
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from adam_tpu.api.datasets import AlignmentDataset
 from adam_tpu.formats import schema
-from adam_tpu.formats.batch import ReadBatch
 from adam_tpu.formats.strings import StringColumn
 from adam_tpu.ops import cigar as cigar_ops
-
-
-def _device_read_columns(b: ReadBatch):
-    """Per-read key prep: 5' clipped position and quality score.
-
-    Runs host-side (vectorized numpy): the computation is two masked
-    reductions, and on a tunneled chip even the small outputs' fetch
-    costs more than computing them locally.  The sharded pipeline's
-    device variant lives in parallel/dist.py.
-    """
-    bb = b.to_numpy()
-    five_prime = cigar_ops.five_prime_position_np(
-        bb.start, bb.end, bb.flags, bb.cigar_ops, bb.cigar_lens, bb.cigar_n
-    )
-    quals = np.asarray(bb.quals)
-    in_read = np.arange(bb.lmax)[None, :] < np.asarray(bb.lengths)[:, None]
-    score = np.where(in_read & (quals >= 15), quals, 0).sum(
-        axis=1, dtype=np.int32
-    )
-    return five_prime, score
-
-
-def _bucket_ids(ds: AlignmentDataset) -> tuple[np.ndarray, int]:
-    """(rg, name) -> dense bucket id per row (-1 for invalid rows).
-
-    Vectorized: exact fixed-width-bytes unique over names, combined with
-    the read-group index into one integer key.
-    """
-    b = ds.batch.to_numpy()
-    valid = np.asarray(b.valid)
-    names = StringColumn.of(ds.sidecar.names)
-    _, name_inv = names.unique_inverse()
-    rg = np.asarray(b.read_group_idx).astype(np.int64)
-    key = (rg + 1) * (name_inv.max() + 1 if len(name_inv) else 1) + name_inv
-    key = np.where(valid, key, -1)
-    vrows = np.flatnonzero(valid)
-    uniq, inv = np.unique(key[vrows], return_inverse=True)
-    ids = np.full(b.n_rows, -1, dtype=np.int64)
-    ids[vrows] = inv
-    return ids, len(uniq)
 
 
 def _sequence_hashes(bases: np.ndarray, lengths: np.ndarray) -> np.ndarray:
@@ -93,45 +50,122 @@ def _sequence_hashes(bases: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     return h & 0x7FFFFFFFFFFFFFFF
 
 
-def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
-    b = ds.batch.to_numpy()
+def row_summary(ds: AlignmentDataset, b=None) -> dict:
+    """Compact per-row duplicate-marking summary (host numpy).
+
+    Everything :func:`resolve_duplicates` needs, and nothing [N, L]-
+    shaped except the transient masked reductions here: the 5'-clipped
+    position, the quality score, the row key columns, the bucket key
+    inputs (read-group, name bytes), and the library id.  Windows of a
+    streamed ingest each produce one of these; :func:`concat_summaries`
+    splices them for the global resolve.  Pass ``b`` when the batch is
+    already fetched to numpy — a device-resident batch is copied across
+    the link exactly once.
+    """
+    if b is None:
+        b = ds.batch.to_numpy()
     n = b.n_rows
-    if n == 0:
-        return ds
-    from adam_tpu.utils.transfer import device_fetch
-
-    five_prime, read_score = jax.tree.map(
-        device_fetch, _device_read_columns(ds.batch)
+    five_prime = cigar_ops.five_prime_position_np(
+        b.start, b.end, b.flags, b.cigar_ops, b.cigar_lens, b.cigar_n
     )
-
-    bucket_of, n_buckets = _bucket_ids(ds)
-    if n_buckets == 0:
-        return ds
+    quals = np.asarray(b.quals)
+    in_read = np.arange(b.lmax)[None, :] < np.asarray(b.lengths)[:, None]
+    score = np.where(in_read & (quals >= 15), quals, 0).sum(
+        axis=1, dtype=np.int32
+    )
 
     flags = np.asarray(b.flags)
     valid = np.asarray(b.valid)
     mapped = (flags & schema.FLAG_UNMAPPED) == 0
-    primary = (flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0
-    first = (flags & schema.FLAG_FIRST_OF_PAIR) != 0
-    second = (flags & schema.FLAG_SECOND_OF_PAIR) != 0
-    reverse = (flags & schema.FLAG_REVERSE) != 0
 
-    # ----- per-row candidate keys (ReferencePositionPair.apply) ---------
-    # Key encoding columns: (kind, contig_or_hash, pos, strand);
-    # kind 0 = none, 1 = mapped position, 2 = sequence-keyed (unmapped).
-    # Only unmapped rows consume the sequence hash — skip the O(N*L)
-    # polynomial for the (typical) mostly-mapped batch.
+    # per-row candidate keys (ReferencePositionPair.apply):
+    # (kind, contig_or_hash, pos, strand); kind 0 = none, 1 = mapped
+    # position, 2 = sequence-keyed (unmapped).  Only unmapped rows
+    # consume the sequence hash — skip the O(N*L) polynomial for the
+    # (typical) mostly-mapped batch.
     seq_hash = np.zeros(n, dtype=np.int64)
     um = np.flatnonzero(~mapped)
     if len(um):
         seq_hash[um] = _sequence_hashes(
             np.asarray(b.bases)[um], np.asarray(b.lengths)[um]
         )
+    reverse = (flags & schema.FLAG_REVERSE) != 0
     row_key = np.zeros((n, 4), dtype=np.int64)
     row_key[:, 0] = np.where(mapped, 1, 2)
     row_key[:, 1] = np.where(mapped, np.asarray(b.contig_idx), seq_hash)
     row_key[:, 2] = np.where(mapped, five_prime, 0)
     row_key[:, 3] = np.where(mapped, reverse.astype(np.int64), 0)
+
+    lib_ids = (
+        ds.read_groups.library_ids()
+        if len(ds.read_groups)
+        else np.array([], np.int32)
+    )
+    rgidx = np.asarray(b.read_group_idx)
+    lib_per_row = np.where(
+        rgidx >= 0,
+        lib_ids[np.clip(rgidx, 0, None)] if len(lib_ids) else -1,
+        -1,
+    ).astype(np.int64)
+
+    return dict(
+        flags=flags,
+        valid=valid,
+        score=score,
+        row_key=row_key,
+        rg_idx=rgidx.astype(np.int64),
+        lib_per_row=lib_per_row,
+        name_bytes=StringColumn.of(ds.sidecar.names).to_fixed_bytes(),
+    )
+
+
+def concat_summaries(parts: list[dict]) -> dict:
+    """Splice window summaries into one global summary (names re-padded
+    to a common byte width so the fixed-width unique stays exact)."""
+    if len(parts) == 1:
+        return parts[0]
+    w = max(p["name_bytes"].dtype.itemsize for p in parts)
+    dt = np.dtype(f"S{max(w, 1)}")
+    out = {}
+    for k in parts[0]:
+        cols = [p[k] for p in parts]
+        if k == "name_bytes":
+            cols = [c.astype(dt) for c in cols]
+        out[k] = np.concatenate(cols)
+    return out
+
+
+def resolve_duplicates(s: dict) -> np.ndarray:
+    """Global group-subgroup-argmax cascade over row summaries -> bool[N]
+    duplicate mask.  One lexsort over the bucket table; row order across
+    windows is the tie-break order, matching the reference's partition
+    concatenation."""
+    flags = s["flags"]
+    valid = s["valid"]
+    n = len(flags)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+
+    # ----- bucket ids: dense (rg, name) -> id (SingleReadBucket) -------
+    names = s["name_bytes"]
+    _, name_inv = np.unique(names, return_inverse=True)
+    rg = s["rg_idx"]
+    key = (rg + 1) * (name_inv.max() + 1 if len(name_inv) else 1) + name_inv
+    key = np.where(valid, key, -1)
+    vrows = np.flatnonzero(valid)
+    uniq, inv = np.unique(key[vrows], return_inverse=True)
+    bucket_of = np.full(n, -1, dtype=np.int64)
+    bucket_of[vrows] = inv
+    n_buckets = len(uniq)
+    if n_buckets == 0:
+        return np.zeros(n, dtype=bool)
+
+    mapped = (flags & schema.FLAG_UNMAPPED) == 0
+    primary = (flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0
+    first = (flags & schema.FLAG_FIRST_OF_PAIR) != 0
+    second = (flags & schema.FLAG_SECOND_OF_PAIR) != 0
+    row_key = s["row_key"]
+    read_score = s["score"]
 
     in_bucket = bucket_of >= 0
     candidate = in_bucket & (((mapped & primary)) | ~mapped)
@@ -159,17 +193,7 @@ def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
     np.add.at(bucket_score, bucket_of[sc_rows], read_score[sc_rows].astype(np.int64))
 
     # library per bucket (library of the first read, in row order)
-    lib_ids = (
-        ds.read_groups.library_ids()
-        if len(ds.read_groups)
-        else np.array([], np.int32)
-    )
-    rgidx = np.asarray(b.read_group_idx)
-    lib_per_row = np.where(
-        rgidx >= 0,
-        lib_ids[np.clip(rgidx, 0, None)] if len(lib_ids) else -1,
-        -1,
-    ).astype(np.int64)
+    lib_per_row = s["lib_per_row"]
     lead = first_row(in_bucket)
     bucket_lib = np.where(lead >= 0, lib_per_row[np.clip(lead, 0, None)], -1)
 
@@ -236,7 +260,7 @@ def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
     primary_dup[go] = primary_dup_sorted
     secondary_dup[go] = secondary_dup_sorted
 
-    # ----- apply to reads ----------------------------------------------
+    # ----- back to rows ------------------------------------------------
     row_bucket = np.clip(bucket_of, 0, None)
     dup = np.where(
         mapped & primary,
@@ -244,7 +268,20 @@ def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
         np.where(mapped, secondary_dup[row_bucket], False),
     )
     dup &= valid & (bucket_of >= 0)
-    new_flags = np.where(
+    return dup
+
+
+def apply_duplicate_flags(flags: np.ndarray, dup: np.ndarray) -> np.ndarray:
+    return np.where(
         dup, flags | schema.FLAG_DUPLICATE, flags & ~schema.FLAG_DUPLICATE
     ).astype(np.int32)
-    return ds.with_batch(ds.batch.to_numpy().replace(flags=new_flags))
+
+
+def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
+    b = ds.batch.to_numpy()
+    if b.n_rows == 0:
+        return ds
+    s = row_summary(ds, b)
+    dup = resolve_duplicates(s)
+    new_flags = apply_duplicate_flags(np.asarray(b.flags), dup)
+    return ds.with_batch(b.replace(flags=new_flags))

@@ -224,10 +224,23 @@ def find_targets(ds: AlignmentDataset, max_target_size: int = MAX_TARGET_SIZE):
     """Sorted, merged, deduped target list."""
     b = ds.batch.to_numpy()
     events = extract_indel_events(b)
+    return merge_events(events, ds.seq_dict.names, max_target_size)
+
+
+def merge_events(
+    events: list[RealignmentTarget],
+    names: list[str],
+    max_target_size: int = MAX_TARGET_SIZE,
+):
+    """Sort + overlap-merge + dedupe per-read indel events into targets
+    (the global barrier of the streamed/sharded paths: per-window event
+    lists concatenate here, so targets spanning window or shard edges
+    merge exactly as in the single-batch path)."""
     if not events:
         return []
-    names = ds.seq_dict.names
-    events.sort(key=lambda t: (names[t.contig_idx], t.range_start, t.range_end))
+    events = sorted(
+        events, key=lambda t: (names[t.contig_idx], t.range_start, t.range_end)
+    )
     merged: list[RealignmentTarget] = []
     for t in events:
         if merged and _targets_overlap(merged[-1], t):
@@ -288,6 +301,33 @@ def map_reads_to_targets(
     )
     empty = (-1 - read_start // 3000).astype(np.int64)
     return np.where(contains, t, empty)
+
+
+def map_batch_to_targets(b, targets, names) -> np.ndarray:
+    """Target index per row of a batch (-k spreading for unmatched rows,
+    matching mapToTarget).  The candidate filter of the streamed/sharded
+    paths: rows with tidx >= 0 are gathered for realignment, everything
+    else passes through untouched."""
+    if not targets:
+        return np.full(b.n_rows, -1, dtype=np.int64)
+    rank_of_name = {nm: i for i, nm in enumerate(sorted(names))}
+    contig_rank = np.array([rank_of_name[nm] for nm in names], dtype=np.int64)
+    t_rank = np.array(
+        [contig_rank[t.contig_idx] for t in targets], dtype=np.int64
+    )
+    t_start = np.array([t.range_start for t in targets], dtype=np.int64)
+    t_end = np.array([t.range_end for t in targets], dtype=np.int64)
+    flags = np.asarray(b.flags)
+    mapped = ((flags & schema.FLAG_UNMAPPED) == 0) & np.asarray(b.valid)
+    read_rank = np.where(
+        mapped,
+        contig_rank[np.clip(np.asarray(b.contig_idx), 0, len(names) - 1)],
+        -1,
+    )
+    return map_reads_to_targets(
+        read_rank, np.asarray(b.start).astype(np.int64),
+        np.asarray(b.end).astype(np.int64), mapped, t_rank, t_start, t_end,
+    )
 
 
 # --------------------------------------------------------------------------
@@ -449,22 +489,9 @@ def realign_indels(
     if not targets:
         return ds
     names = ds.seq_dict.names
-    rank_of_name = {nm: i for i, nm in enumerate(sorted(names))}
-    contig_rank = np.array([rank_of_name[nm] for nm in names], dtype=np.int64)
-
-    t_rank = np.array([contig_rank[t.contig_idx] for t in targets], dtype=np.int64)
-    t_start = np.array([t.range_start for t in targets], dtype=np.int64)
-    t_end = np.array([t.range_end for t in targets], dtype=np.int64)
-
     flags = np.asarray(b.flags)
     mapped = ((flags & schema.FLAG_UNMAPPED) == 0) & np.asarray(b.valid)
-    read_rank = np.where(
-        mapped, contig_rank[np.clip(np.asarray(b.contig_idx), 0, len(names) - 1)], -1
-    )
-    tidx = map_reads_to_targets(
-        read_rank, np.asarray(b.start).astype(np.int64),
-        np.asarray(b.end).astype(np.int64), mapped, t_rank, t_start, t_end,
-    )
+    tidx = map_batch_to_targets(b, targets, names)
 
     # group rows by target, position-sorted within the group (the
     # reference sorts the RDD before target mapping) — vectorized:

@@ -135,9 +135,9 @@ def test_read_pairs_with_fragments():
 
 def test_quality_score():
     """Score = sum of phred >= 15 (MarkDuplicates.scala:45-47)."""
-    from adam_tpu.pipelines.markdup import _device_read_columns
+    from adam_tpu.pipelines.markdup import row_summary
 
-    batch, _ = pack_reads(
+    batch, side = pack_reads(
         [
             mapped_read("0", 1, phred=20),
             dict(name="mixed", flags=0, contig_idx=0, start=1, mapq=60,
@@ -145,7 +145,8 @@ def test_quality_score():
                  read_group_idx=0),
         ]
     )
-    _, score = _device_read_columns(batch.to_device())
+    ds = AlignmentDataset(batch, side, SamHeader())
+    score = row_summary(ds)["score"]
     assert int(np.asarray(score)[0]) == 2000
     assert int(np.asarray(score)[1]) == 40  # phred-10 bases don't count
 
