@@ -200,6 +200,13 @@ class Transform(Command):
             "over re-shardable columnar stores)",
         )
         p.add_argument(
+            "-streaming", action="store_true",
+            help="run the transform as the streamed, overlapped windowed "
+            "pipeline (ingest || device kernels || part-file writes; "
+            "pipelines/streamed.py) — output becomes a Parquet part-file "
+            "directory; requires a markdup/BQSR/realign stage set",
+        )
+        p.add_argument(
             "-backend", default="tpu", choices=["tpu", "spark"],
             help="execution backend: 'tpu' runs the pipeline here; "
             "'spark' is the embedding mode — the caller (a Spark "
@@ -228,6 +235,59 @@ class Transform(Command):
                 "only runs with -backend tpu",
             )
             return 2
+
+        if args.streaming:
+            import sys
+
+            supported = not (
+                args.trimReads or args.qualityBasedTrim or args.sort_reads
+            )
+            if not supported:
+                print(
+                    "transform -streaming supports the markdup/BQSR/realign "
+                    "stage set; drop -streaming for trim/sort pipelines",
+                    file=sys.stderr,
+                )
+                return 2
+            base = str(args.input)
+            if base.endswith(".gz"):
+                base = base[:-3]
+            if not base.endswith((".sam", ".bam")) or args.force_load_fastq \
+                    or args.force_load_ifastq or args.force_load_parquet:
+                print(
+                    "transform -streaming ingests windowed SAM/BAM only "
+                    f"({args.input!r} is not); drop -streaming for other "
+                    "formats",
+                    file=sys.stderr,
+                )
+                return 2
+            from adam_tpu.api.datasets import GenotypeDataset as _GD
+            from adam_tpu.pipelines.streamed import transform_streamed
+
+            known = None
+            contig_names = None
+            if args.known_snps or args.known_indels:
+                contig_names = context.load_header(args.input).seq_dict.names
+            if args.known_snps:
+                known = _GD.load(
+                    args.known_snps, contig_names=contig_names
+                ).snp_table()
+            kw = {}
+            if args.known_indels:
+                kw["consensus_model"] = "knowns"
+                kw["known_indels"] = _GD.load(
+                    args.known_indels, contig_names=contig_names
+                ).indel_table()
+            transform_streamed(
+                args.input, args.output,
+                mark_duplicates=bool(args.mark_duplicate_reads),
+                recalibrate=bool(args.recalibrate_base_qualities),
+                realign=bool(args.realign_indels),
+                known_snps=known,
+                compression=args.parquet_compression_codec,
+                **kw,
+            )
+            return 0
 
         with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):
             if args.force_load_bam:

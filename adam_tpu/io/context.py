@@ -111,6 +111,25 @@ def load_genotypes(path: str, **kw):
     return load_vcf(path, **kw)
 
 
+def load_header(path: str) -> SamHeader:
+    """Header-only peek (sequence dictionary / read groups) without
+    materializing the reads — the role of SAMFileHeader probes in the
+    reference's loaders (ADAMContext.scala:236-257)."""
+    p = str(path)
+    base = p[:-3] if p.endswith(".gz") else p
+    if base.endswith(".sam"):
+        from adam_tpu.io import sam
+
+        return sam.peek_sam_header(p)
+    if base.endswith(".bam"):
+        from adam_tpu.io import sam
+
+        for _, _, header in sam.iter_bam_batches(p, batch_reads=1):
+            return header
+        return SamHeader()
+    return load_alignments(path).header
+
+
 def load_alignments(
     path: str, stringency: Optional[str] = None, **kw
 ) -> AlignmentDataset:

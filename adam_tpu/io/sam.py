@@ -191,6 +191,34 @@ def _columns_to_batch(
     return batch, side
 
 
+def _split_header_lines(data: bytes) -> tuple[list[str], int]:
+    """'@'-prefixed header lines + body offset of a SAM byte buffer
+    (the one header scan shared by every SAM entry point)."""
+    body_off = 0
+    header_lines = []
+    while body_off < len(data) and data[body_off : body_off + 1] == b"@":
+        nl = data.find(b"\n", body_off)
+        end = nl if nl >= 0 else len(data)
+        line = data[body_off:end]
+        if line.endswith(b"\r"):
+            line = line[:-1]
+        header_lines.append(line.decode("utf-8", "replace"))
+        body_off = end + 1
+    return header_lines, body_off
+
+
+def peek_sam_header(path: str) -> SamHeader:
+    """Header-only SAM read: stream lines until the first record."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    lines = []
+    with opener(path, "rt") as fh:
+        for line in fh:
+            if not line.startswith("@"):
+                break
+            lines.append(line.rstrip("\r\n"))
+    return SamHeader.parse(lines)
+
+
 def iter_sam_batches(path: str, batch_reads: int = 262_144):
     """Windowed SAM reader: yields (ReadBatch, ReadSidecar, SamHeader)
     chunks of ~``batch_reads`` records each (line-exact windowing).
@@ -210,16 +238,7 @@ def iter_sam_batches(path: str, batch_reads: int = 262_144):
     opener = gzip.open if str(path).endswith(".gz") else open
     with opener(path, "rb") as fh:
         data = fh.read()
-    body_off = 0
-    header_lines = []
-    while body_off < len(data) and data[body_off : body_off + 1] == b"@":
-        nl = data.find(b"\n", body_off)
-        end = nl if nl >= 0 else len(data)
-        line = data[body_off:end]
-        if line.endswith(b"\r"):
-            line = line[:-1]
-        header_lines.append(line.decode("utf-8", "replace"))
-        body_off = end + 1
+    header_lines, body_off = _split_header_lines(data)
     header = SamHeader.parse(header_lines)
     buf = np.frombuffer(data, np.uint8)
     ends = np.flatnonzero(buf[body_off:] == 10) + body_off + 1
@@ -249,16 +268,7 @@ def read_sam(
     with opener(path, "rb") as fh:
         data = fh.read()
     # split the header prefix off without touching the body
-    body_off = 0
-    header_lines = []
-    while body_off < len(data) and data[body_off : body_off + 1] == b"@":
-        nl = data.find(b"\n", body_off)
-        end = nl if nl >= 0 else len(data)
-        line = data[body_off:end]
-        if line.endswith(b"\r"):
-            line = line[:-1]
-        header_lines.append(line.decode("utf-8", "replace"))
-        body_off = end + 1
+    header_lines, body_off = _split_header_lines(data)
     header = SamHeader.parse(header_lines)
 
     from adam_tpu import native

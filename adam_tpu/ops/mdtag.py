@@ -224,30 +224,33 @@ class MdTag:
 
     # ------------------------------------------------------------- emission
     def to_string(self) -> str:
+        """Event-walk emission: O(mismatches + deletions), not
+        O(span x match-intervals) — positions between events are match
+        run length by construction."""
         if not self.matches and not self.mismatches and not self.deletions:
             return "0"
+        start, end = self.start, self.end()
+        events = sorted(
+            [(p, False, b) for p, b in self.mismatches.items()]
+            + [(p, True, b) for p, b in self.deletions.items()]
+        )
         out = []
-        last_was_match = False
+        prev_end = start  # next unemitted reference position
         last_was_deletion = False
-        match_run = 0
-        for i in range(self.start, self.end() + 1):
-            if self.is_match(i):
-                match_run = match_run + 1 if last_was_match else 1
-                last_was_match = True
-                last_was_deletion = False
-            elif i in self.deletions:
-                if not last_was_deletion:
-                    out.append(str(match_run) if last_was_match else "0")
+        for p, is_del, base in events:
+            run = p - prev_end
+            if is_del:
+                if run > 0 or not last_was_deletion:
+                    out.append(str(run))
                     out.append("^")
-                    last_was_match = False
-                    last_was_deletion = True
-                out.append(self.deletions[i])
+                out.append(base)
+                last_was_deletion = True
             else:
-                out.append(str(match_run) if last_was_match else "0")
-                out.append(self.mismatches[i])
-                last_was_match = False
+                out.append(str(run))
+                out.append(base)
                 last_was_deletion = False
-        out.append(str(match_run) if last_was_match else "0")
+            prev_end = p + 1
+        out.append(str(end + 1 - prev_end))
         return "".join(out)
 
     __str__ = to_string

@@ -299,15 +299,73 @@ def map_reads_to_targets(
         & (target_end[t] > read_start)
         & (read_end > target_start[t])
     )
-    empty = (-1 - read_start // 3000).astype(np.int64)
+    # Scala's `/` truncates toward zero, so the reference's unmapped
+    # (start = -1) sentinel is -1 - 0 = -1; Python's floor division
+    # would give -1 - (-1) = 0, a *valid* target index
+    empty = np.where(
+        read_start >= 0, -1 - read_start // 3000, -1
+    ).astype(np.int64)
     return np.where(contains, t, empty)
 
 
-def map_batch_to_targets(b, targets, names) -> np.ndarray:
-    """Target index per row of a batch (-k spreading for unmatched rows,
-    matching mapToTarget).  The candidate filter of the streamed/sharded
-    paths: rows with tidx >= 0 are gathered for realignment, everything
-    else passes through untouched."""
+def map_reads_to_targets_overlap(
+    read_contig_rank, read_start, read_end, mapped_mask,
+    target_rank, target_start, target_end,
+) -> np.ndarray:
+    """Interval mapping: each read goes to the *first target whose read
+    range it overlaps* (GATK's IntervalListReferenceOrderedData walk).
+
+    The reference's set-halving search (:func:`map_reads_to_targets`)
+    keeps the *head* half when the probe orders before the read
+    (RealignIndels.scala:87-91), so with more than one target most
+    overlapping reads land on a non-overlapping probe and fall out of
+    realignment entirely; its own suite only exercises single-target
+    sets (RealignIndelsSuite.scala:54-55).  This mode restores the
+    stated semantics; ``map_reads_to_targets`` remains for bit-parity.
+
+    Vectorized: targets sorted by (rank, start); with a composite
+    coordinate and a running max of target ends, the first overlapping
+    target is one searchsorted (cummax is monotone) + one bounds check.
+    """
+    nt = len(target_start)
+    n = len(read_start)
+    if nt == 0:
+        return np.where(
+            read_start >= 0, -1 - read_start // 3000, -1
+        ).astype(np.int64)
+    BIG = np.int64(1) << 40
+    t_s = target_rank * BIG + target_start
+    t_e = target_rank * BIG + target_end
+    order = np.argsort(t_s, kind="stable")
+    t_s, t_e = t_s[order], t_e[order]
+    cummax_e = np.maximum.accumulate(t_e)
+    r_s = read_contig_rank * BIG + read_start
+    r_e = read_contig_rank * BIG + read_end
+    j = np.searchsorted(cummax_e, r_s, side="right")
+    jc = np.clip(j, 0, nt - 1)
+    contains = (
+        mapped_mask & (j < nt) & (t_s[jc] < r_e) & (t_e[jc] > r_s)
+    )
+    # Scala's `/` truncates toward zero, so the reference's unmapped
+    # (start = -1) sentinel is -1 - 0 = -1; Python's floor division
+    # would give -1 - (-1) = 0, a *valid* target index
+    empty = np.where(
+        read_start >= 0, -1 - read_start // 3000, -1
+    ).astype(np.int64)
+    return np.where(contains, order[jc], empty)
+
+
+def map_batch_to_targets(b, targets, names, mode: str = "overlap") -> np.ndarray:
+    """Target index per row of a batch (-k spreading for unmatched rows).
+    The candidate filter of the streamed/sharded paths: rows with
+    tidx >= 0 are gathered for realignment, everything else passes
+    through untouched.
+
+    ``mode="overlap"`` (default) maps every read to the first target it
+    overlaps; ``mode="faithful"`` replicates the reference's set-halving
+    search bit-for-bit, quirks included (see
+    :func:`map_reads_to_targets_overlap` for why they differ).
+    """
     if not targets:
         return np.full(b.n_rows, -1, dtype=np.int64)
     rank_of_name = {nm: i for i, nm in enumerate(sorted(names))}
@@ -324,7 +382,12 @@ def map_batch_to_targets(b, targets, names) -> np.ndarray:
         contig_rank[np.clip(np.asarray(b.contig_idx), 0, len(names) - 1)],
         -1,
     )
-    return map_reads_to_targets(
+    fn = (
+        map_reads_to_targets_overlap
+        if mode == "overlap"
+        else map_reads_to_targets
+    )
+    return fn(
         read_rank, np.asarray(b.start).astype(np.int64),
         np.asarray(b.end).astype(np.int64), mapped, t_rank, t_start, t_end,
     )
@@ -390,15 +453,27 @@ def _sum_mismatch_quality(seq: str, ref: str, quals) -> int:
 # --------------------------------------------------------------------------
 @dataclass
 class _Read:
-    """Host view of one read under realignment."""
+    """Host view of one read under realignment.
+
+    ``md`` is parsed lazily — only reads whose CIGAR is not a single M
+    run need it (left-alignment, reference slices through indels); for
+    the pure-M majority the precomputed ``ref`` string (from the
+    vectorized MD tokenizer) and per-row mismatch-qual sums replace all
+    per-read MD work.  ``dirty`` marks reads whose alignment changed in
+    preprocessing (left-align / SW), which must be written back even
+    when the consensus pass leaves them alone.
+    """
 
     row: int
     seq: str
-    quals: list
+    quals: np.ndarray
     start: int
     cigar: list  # [(len, op)]
     md: Optional[MdTag]
     mapq: int
+    ref: Optional[str] = None  # implied reference over the aligned span
+    pure: bool = False  # single-M CIGAR
+    dirty: bool = False
 
     @property
     def end(self) -> int:
@@ -409,9 +484,11 @@ def _get_reference_from_reads(reads: list[_Read]):
     """RealignIndels.getReferenceFromReads (:185-215)."""
     refs = []
     for r in reads:
-        if r.md is not None:
-            refs.append((r.md.get_reference(r.seq, cigar_to_string(r.cigar)),
-                         r.start, r.end))
+        ref = r.ref
+        if ref is None and r.md is not None:  # directly-built _Reads
+            ref = r.md.get_reference(r.seq, cigar_to_string(r.cigar))
+        if ref is not None:
+            refs.append((ref, r.start, r.end))
     if not refs:
         raise ValueError("no reads with MD tags in target group")
     refs.sort(key=lambda x: x[1])
@@ -480,6 +557,7 @@ def realign_indels(
     max_target_size: int = MAX_TARGET_SIZE,
     sw_weights: tuple = (1.0, -0.333, -0.5, -0.5),
     rng: Optional[random.Random] = None,
+    target_mapping: str = "overlap",
 ) -> AlignmentDataset:
     b = ds.batch.to_numpy()
     n = b.n_rows
@@ -491,7 +569,7 @@ def realign_indels(
     names = ds.seq_dict.names
     flags = np.asarray(b.flags)
     mapped = ((flags & schema.FLAG_UNMAPPED) == 0) & np.asarray(b.valid)
-    tidx = map_batch_to_targets(b, targets, names)
+    tidx = map_batch_to_targets(b, targets, names, mode=target_mapping)
 
     # group rows by target, position-sorted within the group (the
     # reference sorts the RDD before target mapping) — vectorized:
@@ -510,6 +588,17 @@ def realign_indels(
 
     new_batch = jax.tree.map(np.array, b)  # writable copies
     side = ds.sidecar
+
+    # vectorized per-row MD columns (one native tokenize, no per-read
+    # parse): mismatch mask -> to_clean membership + positional orig-qual
+    # sums; ref codes -> implied reference for every single-M read
+    from adam_tpu.ops.mdtag import batch_md_arrays
+
+    is_mm, ref_codes, has_md_vec = batch_md_arrays(
+        ds.batch, side, need_ref_codes=True
+    )
+    row_has_mm = is_mm.any(axis=1)
+    mm_qual = np.where(is_mm, np.asarray(b.quals), 0).sum(axis=1)
     # sparse overrides: only realigned rows get new MD/attrs — the full
     # sidecar is never materialized as python strings (8M reads would
     # cost ~30s just in string churn)
@@ -524,24 +613,47 @@ def realign_indels(
         reads = []
         for i in rows:
             L = int(b.lengths[i])
+            seq = schema.decode_bases(b.bases[i], L)
+            pure = (
+                int(b.cigar_n[i]) == 1
+                and b.cigar_ops[i, 0] == schema.CIGAR_M
+            )
+            has_md_i = bool(has_md_vec[i])
+            if pure or not has_md_i:
+                md = None  # pure-M rows never need a parsed MdTag
+            else:
+                md = MdTag.parse(side.md[i], int(b.start[i]))
+            if not has_md_i:
+                ref = None
+            elif pure:
+                ref = schema.decode_bases(ref_codes[i], L)
+            else:
+                ref = md.get_reference(
+                    seq,
+                    schema.decode_cigar(
+                        b.cigar_ops[i], b.cigar_lens[i], int(b.cigar_n[i])
+                    ),
+                )
             reads.append(
                 _Read(
                     row=i,
-                    seq=schema.decode_bases(b.bases[i], L),
-                    quals=[int(q) for q in b.quals[i][:L]],
+                    seq=seq,
+                    quals=np.asarray(b.quals[i][:L], np.int32),
                     start=int(b.start[i]),
                     cigar=parse_cigar(
                         schema.decode_cigar(b.cigar_ops[i], b.cigar_lens[i],
                                             int(b.cigar_n[i]))
                     ),
-                    md=MdTag.parse(side.md[i], int(b.start[i]))
-                    if side.md[i] is not None
-                    else None,
+                    md=md,
                     mapq=int(b.mapq[i]),
+                    ref=ref,
+                    pure=pure,
                 )
             )
         # reads that already match the reference pass through untouched
-        to_clean = [r for r in reads if r.md is None or r.md.mismatches]
+        to_clean = [
+            r for r in reads if not has_md_vec[r.row] or row_has_mm[r.row]
+        ]
         if not to_clean:
             continue
         try:
@@ -560,11 +672,15 @@ def realign_indels(
         for r in to_clean:
             if cigar_num_alignment_blocks(r.cigar) == 2:
                 new_cigar = left_align_indel(r.seq, r.cigar, r.md)
-                md = MdTag.move_alignment(
-                    r.md.get_reference(r.seq, cigar_to_string(r.cigar)),
-                    r.seq, cigar_to_string(new_cigar), r.start,
-                ) if r.md is not None else None
-                processed.append(dc_replace(r, cigar=new_cigar, md=md))
+                if new_cigar != r.cigar:
+                    md = MdTag.move_alignment(
+                        r.ref, r.seq, cigar_to_string(new_cigar), r.start,
+                    ) if r.md is not None else None
+                    processed.append(
+                        dc_replace(r, cigar=new_cigar, md=md, dirty=True)
+                    )
+                else:
+                    processed.append(r)
             else:
                 processed.append(r)
         to_clean = processed
@@ -655,14 +771,18 @@ def realign_indels(
 
     # ---- phase 3 (host): consensus choice + rewrite ----
     for t, (to_clean, consensuses, reference, ref_start, ref_end) in group_ctx.items():
-        orig_quals = [
-            _sum_mismatch_quality(
-                r.seq,
-                r.md.get_reference(r.seq, cigar_to_string(r.cigar)) if r.md else "",
-                r.quals,
-            )
-            for r in to_clean
-        ]
+        def _orig_qual(r):
+            if r.dirty and r.md is not None:
+                return _sum_mismatch_quality(
+                    r.seq,
+                    r.md.get_reference(r.seq, cigar_to_string(r.cigar)),
+                    r.quals,
+                )
+            if r.pure:  # positional mismatch-qual sum, precomputed
+                return int(mm_qual[r.row])
+            return _sum_mismatch_quality(r.seq, r.ref or "", r.quals)
+
+        orig_quals = [_orig_qual(r) for r in to_clean]
         pre_total = sum(orig_quals)
         outcomes = []
         for ci in range(len(consensuses)):
@@ -707,12 +827,19 @@ def realign_indels(
                     id_elem = (cons.index_end - 1 - cons.index_start, "D")
                     end_len = len(r.seq) - (cons.index_start - new_start)
                     end_penalty = len(cons.consensus)
-                new_cigar = [
-                    (cons.index_start - new_start, "M"),
-                    id_elem,
-                    (end_len, "M"),
-                ]
-                new_end = new_start + len(r.seq) + end_penalty
+                head_len = cons.index_start - new_start
+                if head_len > 0 and end_len > 0:
+                    new_cigar = [(head_len, "M"), id_elem, (end_len, "M")]
+                    new_end = new_start + len(r.seq) + end_penalty
+                else:
+                    # the swept position doesn't span the consensus indel
+                    # (read entirely before/after it): a plain gapless
+                    # alignment at the new offset.  The reference emits a
+                    # negative-length M here (RealignIndels.scala:344-360,
+                    # never hit by its single-target suite) — an invalid
+                    # CIGAR we decline to reproduce.
+                    new_cigar = [(len(r.seq), "M")]
+                    new_end = new_start + len(r.seq)
                 md = MdTag.move_alignment(
                     reference[o:], r.seq, cigar_to_string(new_cigar), new_start
                 )
@@ -749,7 +876,8 @@ def _sw_preprocess(reads, reference, ref_start, weights):
                 r.seq, reference[aln.x_start :], aln.cigar_x, ref_start
             )
             out.append(
-                dc_replace(r, start=aln.x_start + ref_start, cigar=cigar, md=md)
+                dc_replace(r, start=aln.x_start + ref_start, cigar=cigar,
+                           md=md, dirty=True)
             )
         else:
             out.append(r)
@@ -774,6 +902,8 @@ def _write_back(new_batch, side, new_md, new_attrs, to_clean, realigned):
             tag = f"OC:Z:{old_cigar}\tOP:i:{old_start + 1}"
             cur = new_attrs.get(rr.row, side.attrs[rr.row]) or ""
             new_attrs[rr.row] = cur + "\t" + tag if cur else tag
+        elif not r.dirty:
+            continue  # alignment untouched: nothing to write
         else:
             rr, new_end = r, None
         cig = cigar_to_string(rr.cigar)

@@ -247,6 +247,36 @@ def test_sw_batch_padded():
     assert res[1].cigar_x == "2M2I2M"
 
 
+def test_sw_score_only_parity():
+    """The striped score-only fills (the GCUPS path) agree with the
+    trackback fill's best scores bit-for-bit — scan and Pallas
+    (interpret) backends, padded variable lengths."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    B, lx, ly = 24, 31, 45
+    xc = rng.integers(0, 4, (B, lx)).astype(np.int32)
+    yc = rng.integers(0, 4, (B, ly)).astype(np.int32)
+    xl = rng.integers(4, lx + 1, B).astype(np.int32)
+    yl = rng.integers(4, ly + 1, B).astype(np.int32)
+    args = (1.0, -0.333, -0.5, -0.5)
+    _, bs, _ = sw._sw_fill_scan_best(
+        jnp.asarray(xc), jnp.asarray(xl), jnp.asarray(yc), jnp.asarray(yl),
+        *args, lx, ly,
+    )
+    ref = np.asarray(bs).max(axis=1)
+    got_scan = np.asarray(sw.sw_best_scores(xc, xl, yc, yl, *args,
+                                            backend="scan"))
+    np.testing.assert_array_equal(ref, got_scan)
+    got_pl = np.asarray(
+        sw._sw_score_pallas(
+            jnp.asarray(xc), jnp.asarray(xl), jnp.asarray(yc),
+            jnp.asarray(yl), lx, ly, *args, interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(ref, got_pl)
+
+
 # ------------------------------------------------------------------ mdtag
 def test_mdtag_parse_and_tostring_roundtrip():
     for md in ["75", "10A5", "0A74", "10^AC5", "5A0C5", "0C0C10", "10^AC0T5"]:

@@ -19,7 +19,9 @@ from adam_tpu.formats.strings import StringColumn
 from adam_tpu.io import context
 from adam_tpu.pipelines.streamed import transform_streamed
 
-sys.path.insert(0, "/root/repo/tools")
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "tools")
+)
 
 
 def _row_table(ds):

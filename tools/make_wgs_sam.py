@@ -1,0 +1,336 @@
+"""Generate realistic WGS-shaped benchmark data from a true reference.
+
+Unlike make_synth_sam (uniform reads, random sequences, contradictory MD
+tags), reads here are *sampled from a simulated genome*, so every stage
+does representative work:
+
+* multiple contigs, ~30x coverage (dense pileups -> real duplicate
+  groups and realignment targets with many reads);
+* planted heterozygous indels every ~2 kb: half the reads over a site
+  carry the indel (CIGAR I/D + correct MD), half don't — consensus
+  generation, sweeps and LOD decisions all engage;
+* planted SNPs (the dbSNP analog) written to a known-sites VCF for
+  BQSR config 3, plus quality-correlated sequencing errors with exact
+  MD tags — the empirical-quality signal BQSR is supposed to recover;
+* read-length variation, soft-clips, unmapped pairs, two libraries;
+* coordinate-sorted SAM (and optionally BAM) output.
+
+The mirror of the reference's benchmark inputs (BASELINE.md configs 2-4:
+chr20-shaped BAM, dbSNP known sites, indel-dense realignment).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+_BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+def _phred_profile(rng, n, read_len):
+    """Position-dependent declining quality with per-read jitter."""
+    pos = np.arange(read_len)
+    base = 38.0 - 12.0 * (pos / max(read_len - 1, 1)) ** 2
+    jitter = rng.normal(0, 3, (n, read_len))
+    q = np.clip(base[None, :] + jitter, 2, 40).astype(np.uint8)
+    return q
+
+
+def _md_for(ref_slice: np.ndarray, read: np.ndarray) -> str:
+    """MD tag for an M-only alignment span (mismatches vs ref); callers
+    pass aligned arrays only (soft clips already stripped)."""
+    mism = np.flatnonzero(ref_slice != read)
+    out = []
+    last = 0
+    for m in mism:
+        out.append(str(m - last))
+        out.append("ACGT"[ref_slice[m]])
+        last = m + 1
+    out.append(str(len(read) - last))
+    return "".join(out)
+
+
+def make_wgs(
+    path: str,
+    n_reads: int,
+    read_len: int = 100,
+    seed: int = 0,
+    n_contigs: int = 4,
+    contig_len: int = 800_000,
+    known_sites_out: str | None = None,
+    indel_every: int = 2_000,
+    snp_every: int = 900,
+    error_rate: float = 0.004,
+    dup_frac: float = 0.10,
+    clip_frac: float = 0.05,
+    unmapped_frac: float = 0.01,
+) -> None:
+    rng = np.random.default_rng(seed)
+    contigs = [f"chr{i + 17}" for i in range(n_contigs)]
+    refs = [rng.integers(0, 4, contig_len).astype(np.uint8)
+            for _ in range(n_contigs)]
+
+    # ---- planted variants ---------------------------------------------
+    # indels: alternating insertion/deletion, lengths 1..8, every ~2 kb
+    indels = []  # (contig, pos, is_ins, seq_codes or del_len)
+    for c in range(n_contigs):
+        p = int(rng.integers(500, indel_every))
+        k = 0
+        while p < contig_len - 2 * read_len:
+            ln = int(rng.integers(1, 9))
+            if k % 2 == 0:
+                indels.append((c, p, True, rng.integers(0, 4, ln).astype(np.uint8)))
+            else:
+                indels.append((c, p, False, ln))
+            p += int(rng.integers(indel_every // 2, indel_every * 3 // 2))
+            k += 1
+    # SNPs (known sites): alt differs from ref
+    snps = []  # (contig, pos, alt_code)
+    for c in range(n_contigs):
+        p = int(rng.integers(100, snp_every))
+        while p < contig_len - read_len:
+            alt = (int(refs[c][p]) + int(rng.integers(1, 4))) % 4
+            snps.append((c, p, alt))
+            p += int(rng.integers(snp_every // 2, snp_every * 3 // 2))
+    snp_by_contig = [
+        {p: a for (c, p, a) in snps if c == ci} for ci in range(n_contigs)
+    ]
+    snp_pos_sorted = [np.array(sorted(d)) for d in snp_by_contig]
+    indel_by_contig: list[dict] = [dict() for _ in range(n_contigs)]
+    for (c, p, is_ins, payload) in indels:
+        indel_by_contig[c][p] = (is_ins, payload)
+    indel_pos_sorted = [np.array(sorted(d)) for d in indel_by_contig]
+
+    if known_sites_out:
+        with open(known_sites_out, "w") as fh:
+            fh.write("##fileformat=VCFv4.2\n")
+            for c, nm in enumerate(contigs):
+                fh.write(f"##contig=<ID={nm},length={contig_len}>\n")
+            fh.write("#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+            for (c, p, a) in sorted(snps):
+                ref_b = "ACGT"[refs[c][p]]
+                fh.write(
+                    f"{contigs[c]}\t{p + 1}\t.\t{ref_b}\t{'ACGT'[a]}\t50\tPASS\t.\n"
+                )
+
+    # ---- pair sampling -------------------------------------------------
+    n_pairs = n_reads // 2
+    n_sites = max(1, int(n_pairs * (1.0 - dup_frac)))
+    site_contig = rng.integers(0, n_contigs, n_sites)
+    site_start = rng.integers(0, contig_len - 3 * read_len, n_sites)
+    site_of_pair = np.concatenate(
+        [np.arange(n_sites), rng.integers(0, n_sites, n_pairs - n_sites)]
+    )
+    rng.shuffle(site_of_pair)
+    pr_contig = site_contig[site_of_pair]
+    pr_start = site_start[site_of_pair]
+    # isize is a property of the *fragment site*: PCR duplicates share
+    # both 5' keys, which is what makes them duplicates
+    site_isize = rng.integers(int(read_len * 2.2), int(read_len * 4), n_sites)
+    isize = site_isize[site_of_pair]
+    hap = rng.random(n_pairs) < 0.5  # haplotype carrying the variants
+    # half the indel-spanning reads are emitted the way an indel-unaware
+    # aligner would map them: all-M CIGAR, the indel smeared into tail
+    # mismatches — the reads indel realignment exists to fix
+    misalign = rng.random(n_reads) < 0.5
+    # read-length variation: 88% full length, rest 60-99%
+    lens = np.where(
+        rng.random(n_reads) < 0.88,
+        read_len,
+        rng.integers(int(read_len * 0.6), read_len, n_reads),
+    ).astype(np.int32)
+    clip = np.where(
+        rng.random(n_reads) < clip_frac, rng.integers(3, 12, n_reads), 0
+    ).astype(np.int32)
+    unmapped_pair = rng.random(n_pairs) < unmapped_frac
+    quals = _phred_profile(rng, n_reads, read_len)
+    # quality-correlated errors: P(err) scaled by 10^(-q/10) shape
+    err_p = error_rate * np.power(10.0, (25.0 - quals.astype(np.float32)) / 30.0)
+    err_mask = rng.random((n_reads, read_len)) < err_p
+
+    records = []  # (contig, start, line_parts...) for sorting
+
+    def aln_start(ri, anchor, neg):
+        """Aligned-span start from the fragment anchor, aligner-style:
+        a forward read's POS advances past its leading soft clip; a
+        reverse read anchors its 3'-most aligned base at the fragment
+        end — so PCR duplicates of one fragment share 5'-clipped keys
+        regardless of per-copy clipping/length (RichAlignmentRecord's
+        fivePrimePosition contract, rich/RichAlignmentRecord.scala:104-126)."""
+        L = int(lens[ri])
+        cl = int(clip[ri])
+        if not neg:
+            return anchor + cl
+        # reverse: sequencing starts at the fragment end and runs down;
+        # the clipped (fragment-end-side) bases occupy [anchor-cl, anchor),
+        # so the aligned span is [anchor - L, anchor - cl)
+        return anchor - L
+
+    def build_read(ri, c, start, hap_i, mate_start, first, neg, tlen):
+        L = int(lens[ri])
+        cl = int(clip[ri])
+        aln_len = L - cl
+        ref = refs[c]
+        snpd = snp_by_contig[c]
+        ipos = indel_pos_sorted[c]
+        # nearest planted indel strictly inside the aligned span
+        lo = np.searchsorted(ipos, start + 1)
+        use_indel = None
+        if hap_i and lo < len(ipos) and ipos[lo] < start + aln_len - 1:
+            use_indel = int(ipos[lo])
+        # build aligned sequence from the haplotype
+        if use_indel is None:
+            seq = ref[start : start + aln_len].copy()
+            cig_mid = f"{aln_len}M"
+            md_core_len = aln_len
+            ref_span = aln_len
+            md_parts = None
+        else:
+            a = use_indel - start  # M bases before the indel
+            is_ins, payload = indel_by_contig[c][use_indel]
+            if is_ins:
+                ins = payload
+                b = min(len(ins), aln_len - a - 1)
+                rest = aln_len - a - b
+                seq = np.concatenate(
+                    [ref[start : start + a], ins[:b],
+                     ref[start + a : start + a + rest]]
+                )
+                cig_mid = f"{a}M{b}I{rest}M"
+                md_core_len = aln_len - b
+                ref_span = a + rest
+                md_parts = None
+            else:
+                dl = int(payload)
+                rest = aln_len - a
+                seq = np.concatenate(
+                    [ref[start : start + a],
+                     ref[start + a + dl : start + a + dl + rest]]
+                )
+                cig_mid = f"{a}M{dl}D{rest}M"
+                ref_span = a + dl + rest
+                md_parts = (a, ref[start + a : start + a + dl], rest)
+                md_core_len = aln_len
+        # apply het SNPs on this haplotype (they are real variants: they
+        # mismatch the reference and land in MD, and BQSR should mask
+        # them via the known-sites table); read offset approximates ref
+        # offset on indel reads — MD stays exact either way, computed
+        # from the final sequence below
+        if hap_i:
+            sp = snp_pos_sorted[c]
+            for rp in sp[np.searchsorted(sp, start):
+                         np.searchsorted(sp, start + len(seq))]:
+                off = int(rp - start)
+                if 0 <= off < len(seq):
+                    seq[off] = snpd[int(rp)]
+        # sequencing errors
+        errs = np.flatnonzero(err_mask[ri][:len(seq)])
+        for e in errs:
+            seq[e] = (int(seq[e]) + int(1 + (ri + e) % 3)) % 4
+        # MD vs the reference
+        if use_indel is not None and misalign[ri]:
+            # indel-unaware alignment: all-M, mismatch smear in the MD
+            cig_mid = f"{len(seq)}M"
+            md = _md_for(ref[start : start + len(seq)], seq)
+        elif use_indel is None or md_parts is None:
+            ref_slice = ref[start : start + len(seq)].copy()
+            if cig_mid.endswith("M") and "I" in cig_mid:
+                # insertion: MD covers the two M runs only
+                a = int(cig_mid.split("M")[0])
+                b = int(cig_mid.split("M")[1].split("I")[0])
+                rd = np.concatenate([seq[:a], seq[a + b :]])
+                rf = ref[start : start + len(rd)]
+                md = _md_for(rf, rd)
+            else:
+                md = _md_for(ref_slice, seq)
+        else:
+            a, dseq, rest = md_parts
+            md_a = _md_for(ref[start : start + a], seq[:a])
+            md_r = _md_for(
+                ref[start + a + len(dseq) : start + a + len(dseq) + rest],
+                seq[a:],
+            )
+            md = f"{md_a}^{''.join('ACGT'[x] for x in dseq)}{md_r}"
+        # soft clip: junk bases on the fragment-5' side of the read —
+        # left (before POS) for forward reads, right for reverse reads
+        if cl:
+            junk = rng.integers(0, 4, cl).astype(np.uint8)
+            if not neg:
+                seq = np.concatenate([junk, seq])
+                cigar = f"{cl}S{cig_mid}"
+            else:
+                seq = np.concatenate([seq, junk])
+                cigar = f"{cig_mid}{cl}S"
+        else:
+            cigar = cig_mid
+        if neg:
+            flags = 0x1 | 0x10 | (0x40 if first else 0x80) | 0x2
+        else:
+            flags = 0x1 | 0x20 | (0x40 if first else 0x80) | 0x2
+        q = quals[ri][: len(seq)]
+        seq_s = _BASES[seq].tobytes().decode()
+        q_s = (q + 33).tobytes().decode()
+        # read group follows the *fragment*: PCR copies of one fragment
+        # are in the same library, which is what makes them markable
+        rg = "rg1" if site_of_pair[ri // 2] % 3 else "rg2"
+        nm = len(np.flatnonzero(err_mask[ri][: len(seq) - cl]))
+        return (
+            c, start,
+            f"\t{flags}\t{contigs[c]}\t{start + 1}\t60\t{cigar}\t=\t"
+            f"{mate_start + 1}\t{tlen}\t{seq_s}\t{q_s}\tRG:Z:{rg}\t"
+            f"MD:Z:{md}\tNM:i:{nm}",
+        )
+
+    for p in range(n_pairs):
+        c = int(pr_contig[p])
+        s1 = int(pr_start[p])
+        name = f"r{p}"
+        if unmapped_pair[p]:
+            L = int(lens[2 * p])
+            seq = _BASES[rng.integers(0, 4, L)].tobytes().decode()
+            q = (quals[2 * p][:L] + 33).tobytes().decode()
+            records.append((n_contigs, 0,
+                            f"{name}\t77\t*\t0\t0\t*\t*\t0\t0\t{seq}\t{q}\tRG:Z:rg1"))
+            L = int(lens[2 * p + 1])
+            seq = _BASES[rng.integers(0, 4, L)].tobytes().decode()
+            q = (quals[2 * p + 1][:L] + 33).tobytes().decode()
+            records.append((n_contigs, 0,
+                            f"{name}\t141\t*\t0\t0\t*\t*\t0\t0\t{seq}\t{q}\tRG:Z:rg1"))
+            continue
+        hp = bool(hap[p])
+        tl = int(isize[p])
+        frag_end = s1 + tl
+        st1 = aln_start(2 * p, s1, False)
+        st2 = aln_start(2 * p + 1, frag_end, True)
+        c1, st1, tail1 = build_read(2 * p, c, st1, hp, st2, True, False, tl)
+        c2, st2, tail2 = build_read(2 * p + 1, c, st2, hp, st1, False, True, -tl)
+        records.append((c1, st1, name + tail1))
+        records.append((c2, st2, name + tail2))
+
+    records.sort(key=lambda r: (r[0], r[1]))
+    with open(path, "w") as fh:
+        fh.write("@HD\tVN:1.5\tSO:coordinate\n")
+        for nm in contigs:
+            fh.write(f"@SQ\tSN:{nm}\tLN:{contig_len}\n")
+        fh.write("@RG\tID:rg1\tSM:sample\tLB:lib1\tPL:ILLUMINA\n")
+        fh.write("@RG\tID:rg2\tSM:sample\tLB:lib2\tPL:ILLUMINA\n")
+        buf = []
+        for (_, _, line) in records:
+            buf.append(line + "\n")
+            if len(buf) >= 20000:
+                fh.write("".join(buf))
+                buf = []
+        fh.write("".join(buf))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path")
+    ap.add_argument("--reads", type=int, default=1_000_000)
+    ap.add_argument("--len", type=int, default=100, dest="read_len")
+    ap.add_argument("--known-sites", default=None)
+    args = ap.parse_args()
+    make_wgs(args.path, args.reads, args.read_len,
+             known_sites_out=args.known_sites)
+    print(f"wrote {args.path}: {args.reads} reads x {args.read_len}bp")
