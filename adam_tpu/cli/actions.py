@@ -225,16 +225,29 @@ class Transform(Command):
         from adam_tpu.io import context
 
         if args.backend == "spark":
-            # embedding mode: this process is the per-partition executor;
-            # the Spark driver moves data through the Arrow seam
-            # (AlignmentDataset.from_arrow / to_arrow), not through files
-            print(
-                "transform -backend spark: drive this process from Spark "
-                "mapPartitions via AlignmentDataset.from_arrow(record_batches)"
-                " -> (transforms) -> .to_arrow(); the file-path CLI mode "
-                "only runs with -backend tpu",
+            # embedding mode: this process is the per-partition executor —
+            # the Spark driver pipes Arrow IPC partition batches through
+            # stdin/stdout (AlignmentDataset.from_arrow -> stages ->
+            # to_arrow); file paths are ignored (pass "-" "-")
+            from adam_tpu.api.datasets import GenotypeDataset as _GD
+            from adam_tpu.api.spark_executor import StageConfig, serve
+
+            cfg = StageConfig(
+                mark_duplicates=bool(args.mark_duplicate_reads),
+                recalibrate=bool(args.recalibrate_base_qualities),
+                realign=bool(args.realign_indels),
             )
-            return 2
+            if args.known_snps:
+                cfg.known_snps = _GD.load(args.known_snps).snp_table()
+            if args.known_indels:
+                cfg.known_indels = _GD.load(args.known_indels).indel_table()
+            import logging
+
+            served = serve(cfg)
+            logging.getLogger(__name__).info(
+                "spark executor drained: %d partitions", served
+            )
+            return 0
 
         if args.streaming:
             import sys

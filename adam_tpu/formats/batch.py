@@ -171,8 +171,11 @@ class ReadBatch:
         )
 
     def take(self, idx: Array) -> "ReadBatch":
-        """Row gather (device-friendly: same op on every column)."""
-        return jax.tree.map(lambda x: jnp.asarray(x)[idx], self)
+        """Row gather preserving residency: numpy batches gather on the
+        host, device batches on the device.  (Coercing to jnp here used
+        to ship every host window through the tunneled chip — a 9x pass
+        regression on the flagship bench.)"""
+        return jax.tree.map(lambda x: x[idx], self)
 
     def replace(self, **kw) -> "ReadBatch":
         return dataclasses.replace(self, **kw)

@@ -130,12 +130,91 @@ def load_header(path: str) -> SamHeader:
     return load_alignments(path).header
 
 
+def _expand_multi(path: str) -> Optional[list[str]]:
+    """Glob patterns and directories of SAM/BAM files -> ordered file
+    list (None = single-source path).  A directory whose entries are
+    Parquet parts stays a single source (pyarrow reads it as one
+    dataset)."""
+    import glob as _glob
+    import os
+
+    p = str(path)
+    if any(ch in p for ch in "*?["):
+        hits = sorted(_glob.glob(p))
+        return hits or None
+    if os.path.isdir(p):
+        entries = sorted(
+            os.path.join(p, e) for e in os.listdir(p)
+            if e.endswith((".sam", ".bam", ".sam.gz", ".bam.gz"))
+        )
+        return entries or None
+    return None
+
+
+def load_alignments_multi(paths: Sequence[str], **kw) -> AlignmentDataset:
+    """Load several alignment files as one dataset, merging their
+    headers (loadBam's header union, rdd/ADAMContext.scala:236-257:
+    every file's SequenceDictionary and RecordGroupDictionary merge,
+    conflicting contig lengths fail) and re-indexing each batch's
+    contig/mate-contig/read-group columns into the merged dictionaries.
+    """
+    import numpy as np
+
+    from adam_tpu.formats.batch import ReadBatch, ReadSidecar
+
+    parts = [load_alignments(p, **kw) for p in paths]
+    sd = parts[0].header.seq_dict
+    rgd = parts[0].header.read_groups
+    for part in parts[1:]:
+        sd = sd.merge(part.header.seq_dict)
+        rgd = rgd.merge(part.header.read_groups)
+
+    def remap(idx, m):
+        idx = np.asarray(idx)
+        if not len(m):
+            return idx.astype(np.int32)
+        return np.where(
+            idx >= 0, m[np.clip(idx, 0, len(m) - 1)], idx
+        ).astype(np.int32)
+
+    batches, sides = [], []
+    for part in parts:
+        b = part.batch.to_numpy()
+        cmap = np.array(
+            [sd.index(nm) for nm in part.header.seq_dict.names], np.int32
+        )
+        gmap = np.array(
+            [rgd.index(nm) for nm in part.header.read_groups.names], np.int32
+        )
+        batches.append(b.replace(
+            contig_idx=remap(b.contig_idx, cmap),
+            mate_contig_idx=remap(b.mate_contig_idx, cmap),
+            read_group_idx=remap(b.read_group_idx, gmap),
+        ))
+        sides.append(part.sidecar)
+    return AlignmentDataset(
+        ReadBatch.concat(batches),
+        ReadSidecar.concat(sides),
+        SamHeader(seq_dict=sd, read_groups=rgd),
+    )
+
+
 def load_alignments(
     path: str, stringency: Optional[str] = None, **kw
 ) -> AlignmentDataset:
     """``stringency`` is forwarded to the loaders that validate pairing
     (interleaved FASTQ); other formats ignore it — callers (the CLI's
-    common ``-stringency`` flag) need not know the dispatch rule."""
+    common ``-stringency`` flag) need not know the dispatch rule.
+
+    Glob patterns and directories of SAM/BAM files load as ONE dataset
+    with merged dictionaries (:func:`load_alignments_multi`)."""
+    multi = _expand_multi(path)
+    if multi is not None:
+        if len(multi) == 1:
+            return load_alignments(multi[0], stringency=stringency, **kw)
+        if stringency is not None:
+            kw["stringency"] = stringency
+        return load_alignments_multi(multi, **kw)
     p = str(path)
     base = p[:-3] if p.endswith(".gz") else p
     if base.endswith(".sam"):

@@ -56,6 +56,18 @@ class SnpTable:
             table.setdefault(contig, []).append(pos)
         return SnpTable(table)
 
+    def site_keys(self, contig_names: list[str]) -> np.ndarray:
+        """Sorted composite ``contig_index << 40 | position`` site keys
+        for the native observe kernel's in-walk masking."""
+        keys = []
+        for ci, name in enumerate(contig_names):
+            arr = self.table.get(name)
+            if arr is not None and len(arr):
+                keys.append((np.int64(ci) << 40) | arr.astype(np.int64))
+        if not keys:
+            return np.zeros(0, np.int64)
+        return np.sort(np.concatenate(keys))
+
     def contains(self, contig: str, pos: int) -> bool:
         arr = self.table.get(contig)
         if arr is None or not len(arr):

@@ -140,6 +140,7 @@ def _lib() -> Optional[ct.CDLL]:
             lib.bqsr_observe.argtypes = [
                 _u8p, _u8p, _i32p, _i32p, _i32p,
                 _u8p, _i32p, _i32p, ct.c_int64,
+                _i32p, _i64p, _i64p, ct.c_int64,
                 _u8p, _u8p, _u8p,
                 ct.c_int64, ct.c_int64, ct.c_int32, ct.c_int64,
                 _i64p, _i64p, ct.c_int,
@@ -688,13 +689,17 @@ def bqsr_apply(bases, quals, lengths, flags, rg_idx, has_qual, valid,
 
 def bqsr_observe(bases, quals, lengths, flags, rg_idx,
                  cigar_ops, cigar_lens, cigar_n,
-                 residue_ok, is_mm, read_ok, n_rg: int, gl: int):
+                 residue_ok, is_mm, read_ok, n_rg: int, gl: int,
+                 contig_idx=None, start=None, snp_keys=None):
     """Threaded host covariate histogram -> (total, mism) i64 arrays of
     shape [n_rg, 94, 2*gl+1, 17]; None if native unavailable.
 
     ``residue_ok`` may be None: the aligned/q>0/base<4 residue filter is
     then derived from the cigar columns inside the kernel, so no [N, L]
-    mask ever materializes (pass an explicit mask for known-SNP runs)."""
+    mask ever materializes.  Known-SNP masking likewise runs in-kernel:
+    pass ``contig_idx``/``start`` plus ``snp_keys`` (sorted i64
+    ``contig << 40 | pos`` site keys) and masked residues are skipped
+    during the same cigar walk — no host-side [N, L] position matrix."""
     lib = _lib()
     if lib is None:
         return None
@@ -712,6 +717,19 @@ def bqsr_observe(bases, quals, lengths, flags, rg_idx,
         rok_ptr = _u8_ptr(rok_arr)
     else:
         rok_ptr = ct.cast(None, _u8p)
+    if snp_keys is not None and len(snp_keys) and residue_ok is None:
+        ci_arr = np.ascontiguousarray(contig_idx, np.int32)
+        st_arr = np.ascontiguousarray(start, np.int64)
+        sk_arr = np.ascontiguousarray(snp_keys, np.int64)
+        ci_ptr = ci_arr.ctypes.data_as(_i32p)
+        st_ptr = st_arr.ctypes.data_as(_i64p)
+        sk_ptr = sk_arr.ctypes.data_as(_i64p)
+        n_snps = len(sk_arr)
+    else:
+        ci_ptr = ct.cast(None, _i32p)
+        st_ptr = ct.cast(None, _i64p)
+        sk_ptr = ct.cast(None, _i64p)
+        n_snps = 0
     lib.bqsr_observe(
         _u8_ptr(bases.reshape(-1)), _u8_ptr(quals.reshape(-1)),
         np.ascontiguousarray(lengths, np.int32).ctypes.data_as(_i32p),
@@ -721,6 +739,7 @@ def bqsr_observe(bases, quals, lengths, flags, rg_idx,
         np.ascontiguousarray(cigar_lens, np.int32).ctypes.data_as(_i32p),
         np.ascontiguousarray(cigar_n, np.int32).ctypes.data_as(_i32p),
         ct.c_int64(cmax),
+        ci_ptr, st_ptr, sk_ptr, ct.c_int64(n_snps),
         rok_ptr,
         _u8_ptr(np.ascontiguousarray(is_mm, np.uint8).reshape(-1)),
         _u8_ptr(np.ascontiguousarray(read_ok, np.uint8)),

@@ -14,6 +14,7 @@
 // buffers (attrs/MD/OQ, which can shrink vs. their scan-pass capacity)
 // are compacted serially.
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
@@ -730,11 +731,16 @@ int adamtok_version() { return 5; }
 // spans) plus q>0 / base<4 checks are then computed from the cigar
 // columns in-loop — no [N, L] mask or position array ever materializes
 // on the host (known-SNP masking passes an explicit mask instead).
+// snp_keys (may be null): sorted (contig << 40 | ref_pos) known-SNP site
+// keys; residues at those reference positions are skipped (the dbSNP
+// masking of BaseQualityRecalibration) without any [N, L] host mask.
 void bqsr_observe(
     const uint8_t* bases, const uint8_t* quals, const int32_t* lengths,
     const int32_t* flags, const int32_t* rg_idx,
     const uint8_t* cigar_ops, const int32_t* cigar_lens,
     const int32_t* cigar_n, int64_t cmax,
+    const int32_t* contig_idx, const int64_t* start,
+    const int64_t* snp_keys, int64_t n_snps,
     const uint8_t* residue_ok, const uint8_t* is_mm, const uint8_t* read_ok,
     int64_t N, int64_t lmax, int32_t n_rg, int64_t gl,
     int64_t* total, int64_t* mism, int nthreads) {
@@ -758,8 +764,10 @@ void bqsr_observe(
     auto& lm = loc_m[t];
     lt.assign(size_t(size), 0);
     lm.assign(size_t(size), 0);
-    // per-thread scratch: aligned-span flags for one read
+    // per-thread scratch: aligned-span flags + reference positions
     std::vector<uint8_t> aligned(static_cast<size_t>(lmax), 0);
+    std::vector<int64_t> refp(static_cast<size_t>(lmax), -1);
+    const bool mask_snps = snp_keys && n_snps > 0;
     for (int64_t i = lo; i < hi; ++i) {
       if (!read_ok[i]) continue;
       const uint8_t* bs = bases + i * lmax;
@@ -774,11 +782,13 @@ void bqsr_observe(
       int64_t inc = rev ? (second ? 1 : -1) : (second ? -1 : 1);
       int32_t rg = rg_idx[i] >= 0 && rg_idx[i] < n_rg ? rg_idx[i] : n_rg - 1;
       if (!rok) {
-        // mark query positions consumed by reference-aligned ops (M/=/X)
+        // mark query positions consumed by reference-aligned ops (M/=/X),
+        // recording each one's reference position for SNP masking
         static const uint8_t kQ[16] = {1, 1, 0, 0, 1, 0, 0, 1, 1,
                                        0, 0, 0, 0, 0, 0, 0};
         memset(aligned.data(), 0, size_t(lmax));
         int64_t qp = 0;
+        int64_t rp = start ? start[i] : 0;
         int nc = cigar_n[i] > cmax ? int(cmax) : cigar_n[i];
         for (int k = 0; k < nc && qp < lmax; ++k) {
           uint8_t op = cigar_ops[i * cmax + k] & 15;
@@ -789,9 +799,13 @@ void bqsr_observe(
           if (cq && cr) {
             int64_t stop = qp + len;
             if (stop > lmax) stop = lmax;
-            for (int64_t j2 = qp; j2 < stop; ++j2) aligned[size_t(j2)] = 1;
+            for (int64_t j2 = qp; j2 < stop; ++j2) {
+              aligned[size_t(j2)] = 1;
+              refp[size_t(j2)] = rp + (j2 - qp);
+            }
           }
           if (cq) qp += len;
+          if (cr) rp += len;
         }
       }
       for (int64_t j = 0; j < L && j < lmax; ++j) {
@@ -801,6 +815,14 @@ void bqsr_observe(
           if (!aligned[size_t(j)] || q[j] == 0 || q[j] >= QUAL_PAD ||
               bs[j] >= 4)
             continue;
+          if (mask_snps) {
+            int64_t key =
+                (int64_t(contig_idx ? contig_idx[i] : 0) << 40) |
+                refp[size_t(j)];
+            const int64_t* e = snp_keys + n_snps;
+            const int64_t* it = std::lower_bound(snp_keys, e, key);
+            if (it != e && *it == key) continue;
+          }
         }
         int64_t cyc = initial + inc * j + gl;
         uint8_t cur = bs[j], prev;

@@ -53,8 +53,18 @@ def shuffle_alignments_to_shards(
         for batch, side, header in batches:
             b = jax.tree.map(np.asarray, batch)
             valid = np.asarray(b.valid)
+            # the 5'-CLIPPED position decides the bin, not `start`
+            # (rich/RichAlignmentRecord.scala:104-126): PCR duplicates of
+            # one fragment then co-locate regardless of per-copy clipping,
+            # which is what makes per-shard duplicate groups whole
+            from adam_tpu.ops import cigar as cigar_ops
+
+            five = cigar_ops.five_prime_position_np(
+                b.start, b.end, b.flags, b.cigar_ops, b.cigar_lens,
+                b.cigar_n,
+            )
             part = position_partition(
-                header.seq_dict, b.contig_idx, b.start, n_shards
+                header.seq_dict, b.contig_idx, np.maximum(five, 0), n_shards
             )
             for s in np.unique(part[valid]):
                 rows = np.flatnonzero(valid & (part == s))

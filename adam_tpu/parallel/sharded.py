@@ -1,0 +1,196 @@
+"""Composed sharded flagship transform (out-of-core, boundary-correct).
+
+The multi-shard form of ``pipelines/streamed.py``'s pass structure, with
+genome-bin Parquet shards (``host_shuffle``) as the unit instead of
+ingest windows — the single-host embodiment of the reference's
+distributed transform (AlignmentRecordRDDFunctions.scala:45-588 over
+GenomicPartitioners.scala:63-85):
+
+1. **Shuffle**: the windowed SAM/BAM reader streams into per-genome-bin
+   shards keyed by the 5'-clipped position (so PCR duplicate groups
+   co-locate; rich/RichAlignmentRecord.scala:104-126).  No whole-dataset
+   residency at any point.
+2. **Pass A** (per shard, loaded then dropped): duplicate-marking
+   summaries + indel events.
+3. **Barrier**: global duplicate resolve + target merge — decisions are
+   taken over compact spliced summaries, so duplicate groups whose
+   mates landed in different bins and realignment targets spanning a
+   bin edge resolve exactly as in one batch.
+4. **Pass B**: per-shard BQSR observation under resolved duplicate
+   flags; histogram merge; table solve.
+5. **Pass C**: per-shard recalibration apply + realignment-candidate
+   split; non-candidates write to the output part for that shard.
+6. **Tail**: candidates from all shards realign together (boundary
+   targets see all their reads) and land in the final part.
+
+Each pass re-reads its shard store rather than holding shards in RAM, so
+peak memory is O(largest shard), not O(dataset) — the property that lets
+one host per shard drive this same structure over DCN.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+from adam_tpu.api.datasets import AlignmentDataset
+
+
+def transform_sharded(
+    path: str,
+    out_path: str,
+    n_shards: int,
+    *,
+    mark_duplicates: bool = True,
+    recalibrate: bool = True,
+    realign: bool = True,
+    known_snps=None,
+    known_indels=None,
+    consensus_model: str = "reads",
+    compression: str = "snappy",
+    shuffle_dir: str | None = None,
+    batch_reads: int = 500_000,
+) -> dict:
+    from adam_tpu.io import context
+    from adam_tpu.io.sam import iter_bam_batches, iter_sam_batches
+    from adam_tpu.parallel import host_shuffle
+    from adam_tpu.pipelines import bqsr as bqsr_mod
+    from adam_tpu.pipelines import markdup as md_mod
+    from adam_tpu.pipelines import realign as realign_mod
+    from adam_tpu.pipelines.streamed import _write_part
+
+    t_start = time.perf_counter()
+    stats: dict = {}
+    os.makedirs(out_path, exist_ok=True)
+    tmp = shuffle_dir or tempfile.mkdtemp(prefix="adam_tpu_shards_")
+    own_tmp = shuffle_dir is None
+
+    try:
+        # ---- 1. shuffle to genome-bin shards --------------------------
+        t = time.perf_counter()
+        p = str(path)
+        base = p[:-3] if p.endswith(".gz") else p
+        reader = (
+            iter_bam_batches(p, batch_reads=batch_reads)
+            if base.endswith(".bam")
+            else iter_sam_batches(p, batch_reads=batch_reads)
+        )
+        shard_paths = host_shuffle.shuffle_alignments_to_shards(
+            reader, n_shards, tmp, compression=compression
+        )
+        stats["shuffle_s"] = time.perf_counter() - t
+        if not shard_paths:
+            stats["n_reads"] = 0
+            stats["total_s"] = time.perf_counter() - t_start
+            return stats
+
+        def load(si: int) -> AlignmentDataset:
+            b, s, h = host_shuffle.iter_shards([shard_paths[si]]).__next__()
+            return AlignmentDataset(b, s, h)
+
+        # ---- 2. pass A: summaries + events ----------------------------
+        t = time.perf_counter()
+        summaries = []
+        events = []
+        counts = []
+        header = None
+        for si in range(len(shard_paths)):
+            ds = load(si)
+            header = ds.header
+            counts.append(ds.batch.n_rows)
+            if mark_duplicates:
+                summaries.append(md_mod.row_summary(ds))
+            if realign:
+                events.extend(
+                    realign_mod.extract_indel_events(ds.batch.to_numpy())
+                )
+        stats["n_reads"] = int(sum(counts))
+        stats["summaries_s"] = time.perf_counter() - t
+
+        # ---- 3. barrier: resolve + targets ----------------------------
+        t = time.perf_counter()
+        dup_slices = [None] * len(shard_paths)
+        if mark_duplicates and summaries:
+            dup = md_mod.resolve_duplicates(
+                md_mod.concat_summaries(summaries)
+            )
+            off = 0
+            for si, n in enumerate(counts):
+                dup_slices[si] = dup[off : off + n]
+                off += n
+            del summaries
+        targets = (
+            realign_mod.merge_events(events, header.seq_dict.names)
+            if realign
+            else []
+        )
+        stats["resolve_s"] = time.perf_counter() - t
+
+        # ---- 4. pass B: observe under dup flags -----------------------
+        t = time.perf_counter()
+        table = None
+        gl = 0
+        if recalibrate:
+            parts = []
+            for si in range(len(shard_paths)):
+                ds = load(si)
+                if dup_slices[si] is not None:
+                    b = ds.batch.to_numpy()
+                    ds = ds.with_batch(
+                        b.replace(flags=md_mod.apply_duplicate_flags(
+                            np.asarray(b.flags), dup_slices[si]
+                        ))
+                    )
+                total, mism, _rg, g = bqsr_mod._observe_device(ds, known_snps)
+                parts.append((np.asarray(total), np.asarray(mism), g))
+            total, mism, gl = bqsr_mod.merge_observations(parts)
+            table = bqsr_mod.solve_recalibration_table(total, mism)
+        stats["observe_s"] = time.perf_counter() - t
+
+        # ---- 5. pass C: apply + split + write -------------------------
+        t = time.perf_counter()
+        candidates = []
+        for si in range(len(shard_paths)):
+            ds = load(si)
+            if dup_slices[si] is not None:
+                b = ds.batch.to_numpy()
+                ds = ds.with_batch(
+                    b.replace(flags=md_mod.apply_duplicate_flags(
+                        np.asarray(b.flags), dup_slices[si]
+                    ))
+                )
+            if table is not None:
+                ds = bqsr_mod.apply_recalibration(ds, table, gl)
+            if targets:
+                b = ds.batch.to_numpy()
+                tidx = realign_mod.map_batch_to_targets(
+                    b, targets, header.seq_dict.names
+                )
+                cand = tidx >= 0
+                if cand.any():
+                    candidates.append(ds.take_rows(np.flatnonzero(cand)))
+                    ds = ds.take_rows(np.flatnonzero(~cand))
+            if ds.batch.n_rows:
+                _write_part(out_path, si, ds, compression)
+        stats["apply_split_s"] = time.perf_counter() - t
+
+        # ---- 6. tail: realign candidates across shard edges -----------
+        t = time.perf_counter()
+        if candidates:
+            cand = AlignmentDataset.concat(candidates)
+            cand = realign_mod.realign_indels(
+                cand,
+                consensus_model=consensus_model,
+                known_indels=known_indels,
+            )
+            _write_part(out_path, len(shard_paths), cand, compression)
+        stats["realign_s"] = time.perf_counter() - t
+        stats["total_s"] = time.perf_counter() - t_start
+        return stats
+    finally:
+        if own_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)

@@ -259,34 +259,45 @@ def _observe_device(
 
     snp_active = known_snps is not None and len(known_snps)
     residue_ok = None
-    if snp_active or not native.available():
-        # residue filter: q>0, ACGT base, aligned to reference, not a
-        # known SNP — built host-side only when actually needed (the
-        # int64 [N, L] position array is ~3 GB at WGS batch sizes)
+    snp_keys = None
+    if snp_active and native.available():
+        # known-SNP masking runs inside the native kernel's cigar walk
+        # (sorted site-key binary search per residue) — the [N, L] i64
+        # position matrix (~3 GB at WGS batch sizes) never materializes
+        snp_keys = known_snps.site_keys(ds.seq_dict.names)
+
+    def _python_residue_mask():
+        # jax fallback: residue filter built host-side — q>0, ACGT base,
+        # aligned to reference, not a known SNP
         ref_pos = cigar_ops.reference_positions_np(
             b.cigar_ops, b.cigar_lens, b.cigar_n, b.start, lmax
         )
         quals = np.asarray(b.quals)
-        residue_ok = (
+        rok = (
             (quals > 0) & (quals < schema.QUAL_PAD)
             & (np.asarray(b.bases) < 4) & (ref_pos >= 0)
         )
         if snp_active:
-            masked = known_snps.mask_positions(
+            rok &= ~known_snps.mask_positions(
                 ds.seq_dict.names, np.asarray(b.contig_idx), ref_pos
             )
-            residue_ok &= ~masked
-        del ref_pos
+        return rok
+
+    if not native.available():
+        residue_ok = _python_residue_mask()
 
     nat = native.bqsr_observe(
         b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
         b.cigar_ops, b.cigar_lens, b.cigar_n,
         residue_ok & read_ok[:, None] if residue_ok is not None else None,
         is_mm, read_ok, n_rg, gl,
+        contig_idx=b.contig_idx, start=b.start, snp_keys=snp_keys,
     )
     if nat is not None:
         total, mism = nat  # host arrays: downstream table math stays host
     else:
+        if residue_ok is None:
+            residue_ok = _python_residue_mask()
         total, mism = observe_kernel(
             jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
             jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),

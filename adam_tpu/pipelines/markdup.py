@@ -50,7 +50,8 @@ def _sequence_hashes(bases: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     return h & 0x7FFFFFFFFFFFFFFF
 
 
-def row_summary(ds: AlignmentDataset, b=None) -> dict:
+def row_summary(ds: AlignmentDataset, b=None, five_prime=None,
+                score=None) -> dict:
     """Compact per-row duplicate-marking summary (host numpy).
 
     Everything :func:`resolve_duplicates` needs, and nothing [N, L]-
@@ -60,19 +61,22 @@ def row_summary(ds: AlignmentDataset, b=None) -> dict:
     streamed ingest each produce one of these; :func:`concat_summaries`
     splices them for the global resolve.  Pass ``b`` when the batch is
     already fetched to numpy — a device-resident batch is copied across
-    the link exactly once.
+    the link exactly once — and ``five_prime``/``score`` when the [N, L]
+    reductions already ran on the mesh (parallel/dist.distributed_markdup).
     """
     if b is None:
         b = ds.batch.to_numpy()
     n = b.n_rows
-    five_prime = cigar_ops.five_prime_position_np(
-        b.start, b.end, b.flags, b.cigar_ops, b.cigar_lens, b.cigar_n
-    )
-    quals = np.asarray(b.quals)
-    in_read = np.arange(b.lmax)[None, :] < np.asarray(b.lengths)[:, None]
-    score = np.where(in_read & (quals >= 15), quals, 0).sum(
-        axis=1, dtype=np.int32
-    )
+    if five_prime is None:
+        five_prime = cigar_ops.five_prime_position_np(
+            b.start, b.end, b.flags, b.cigar_ops, b.cigar_lens, b.cigar_n
+        )
+    if score is None:
+        quals = np.asarray(b.quals)
+        in_read = np.arange(b.lmax)[None, :] < np.asarray(b.lengths)[:, None]
+        score = np.where(in_read & (quals >= 15), quals, 0).sum(
+            axis=1, dtype=np.int32
+        )
 
     flags = np.asarray(b.flags)
     valid = np.asarray(b.valid)

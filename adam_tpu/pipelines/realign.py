@@ -474,6 +474,7 @@ class _Read:
     ref: Optional[str] = None  # implied reference over the aligned span
     pure: bool = False  # single-M CIGAR
     dirty: bool = False
+    codes: Optional[np.ndarray] = None  # base codes (sweep input, cached)
 
     @property
     def end(self) -> int:
@@ -607,8 +608,10 @@ def realign_indels(
     rng = rng or random.Random(0)
 
     # ---- phase 1 (host): per group, rebuild reference + consensuses ----
-    sweep_tasks = []  # (group_key, read, consensus, reference, ref_start)
+    sweep_tasks = []  # (target, read idx, consensus idx, read, cons codes)
     group_ctx = {}
+    res_q: dict[int, np.ndarray] = {}  # per target: [n_reads, n_cons]
+    res_o: dict[int, np.ndarray] = {}
     for t, rows in groups.items():
         reads = []
         for i in rows:
@@ -648,6 +651,7 @@ def realign_indels(
                     mapq=int(b.mapq[i]),
                     ref=ref,
                     pure=pure,
+                    codes=np.asarray(b.bases[i][:L]),
                 )
             )
         # reads that already match the reference pass through untouched
@@ -724,10 +728,15 @@ def realign_indels(
             continue
 
         group_ctx[t] = (to_clean, consensuses, reference, ref_start, ref_end)
+        res_q[t] = np.full(
+            (len(to_clean), len(consensuses)), np.inf, np.float32
+        )
+        res_o[t] = np.full((len(to_clean), len(consensuses)), -1, np.int32)
         for ci, c in enumerate(consensuses):
             cons_seq = c.insert_into_reference(reference, ref_start, ref_end)
+            cons_codes = schema.encode_bases(cons_seq)  # once per consensus
             for ri, r in enumerate(to_clean):
-                sweep_tasks.append((t, ri, ci, r, cons_seq))
+                sweep_tasks.append((t, ri, ci, r, cons_codes))
 
     # ---- phase 2 (device): batched sweeps, length-bucketed ----
     # tasks are grouped into power-of-two (read, consensus) length
@@ -735,39 +744,45 @@ def realign_indels(
     # every (read x consensus) pair in the batch (SURVEY §7's
     # length-bucketed/padded/masked stance), and so the compiled sweep
     # shapes are stable across inputs for the persistent compile cache
-    sweep_results = {}
     if sweep_tasks:
         def _pow2(n: int, minimum: int) -> int:
             return max(minimum, 1 << (max(int(n), 1) - 1).bit_length())
 
         buckets: dict[tuple[int, int], list] = {}
         for task in sweep_tasks:
-            lr_b = _pow2(len(task[3].seq), 32)
-            lc_b = _pow2(max(len(task[4]), len(task[3].seq) + 1), 64)
+            lr_b = _pow2(len(task[3].codes), 32)
+            lc_b = _pow2(max(len(task[4]), len(task[3].codes) + 1), 64)
             buckets.setdefault((lr_b, lc_b), []).append(task)
 
         for (lr, lc), tasks in buckets.items():
-            B = _pow2(len(tasks), 64)  # stable row counts too
-            rc = np.full((B, lr), schema.BASE_PAD, np.uint8)
-            rq = np.zeros((B, lr), np.int32)
-            rl = np.zeros(B, np.int32)
-            cc = np.full((B, lc), schema.BASE_PAD, np.uint8)
-            cl = np.zeros(B, np.int32)
-            for k, (t, ri, ci, r, cons_seq) in enumerate(tasks):
-                rc[k, : len(r.seq)] = schema.encode_bases(r.seq)
-                rq[k, : len(r.quals)] = r.quals
-                rl[k] = len(r.seq)
-                cc[k, : len(cons_seq)] = schema.encode_bases(cons_seq)
-                cl[k] = len(cons_seq)
-            best_q, best_o = jax.tree.map(
-                np.asarray,
-                sweep_kernel(
-                    jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
-                    jnp.asarray(cc), jnp.asarray(cl), lr, lc,
-                ),
-            )
-            for k, (t, ri, ci, _, _) in enumerate(tasks):
-                sweep_results[(t, ri, ci)] = (float(best_q[k]), int(best_o[k]))
+            # fixed row-chunk size: ONE compiled shape per (lr, lc)
+            # bucket regardless of dataset scale (a per-dataset pow2
+            # batch dim compiled a fresh kernel per size — 20-40s each
+            # through the tunneled compile service)
+            CH = min(2048, _pow2(len(tasks), 64))
+            for lo in range(0, len(tasks), CH):
+                chunk = tasks[lo : lo + CH]
+                rc = np.full((CH, lr), schema.BASE_PAD, np.uint8)
+                rq = np.zeros((CH, lr), np.int32)
+                rl = np.zeros(CH, np.int32)
+                cc = np.full((CH, lc), schema.BASE_PAD, np.uint8)
+                cl = np.zeros(CH, np.int32)
+                for k, (t, ri, ci, r, cons_codes) in enumerate(chunk):
+                    rc[k, : len(r.codes)] = r.codes
+                    rq[k, : len(r.quals)] = r.quals
+                    rl[k] = len(r.codes)
+                    cc[k, : len(cons_codes)] = cons_codes
+                    cl[k] = len(cons_codes)
+                best_q, best_o = jax.tree.map(
+                    np.asarray,
+                    sweep_kernel(
+                        jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
+                        jnp.asarray(cc), jnp.asarray(cl), lr, lc,
+                    ),
+                )
+                for k, (t, ri, ci, _, _) in enumerate(chunk):
+                    res_q[t][ri, ci] = best_q[k]
+                    res_o[t][ri, ci] = best_o[k]
 
     # ---- phase 3 (host): consensus choice + rewrite ----
     for t, (to_clean, consensuses, reference, ref_start, ref_end) in group_ctx.items():
@@ -784,24 +799,23 @@ def realign_indels(
 
         orig_quals = [_orig_qual(r) for r in to_clean]
         pre_total = sum(orig_quals)
-        outcomes = []
-        for ci in range(len(consensuses)):
-            total = 0
-            mappings = []
-            for ri, r in enumerate(to_clean):
-                q, o = sweep_results.get((t, ri, ci), (np.inf, -1))
-                if q < orig_quals[ri]:
-                    total += int(q)
-                    mappings.append(o)
-                else:
-                    total += orig_quals[ri]
-                    mappings.append(-1)
-            outcomes.append((total, ci, mappings))
-        # best = min total; reference's fold keeps the later-generated
-        # consensus on ties (list prepend + left fold)
-        best_total, best_ci, best_map = min(
-            reversed(outcomes), key=lambda x: x[0]
-        )
+        # vectorized consensus scoring over the [n_reads, n_cons] sweep
+        # result arrays: per cell take min(sweep, orig) (sweep value
+        # truncated to int, as the reference's Int sum does), column
+        # totals, best = min with the LATER consensus winning ties
+        # (the reference's list-prepend + left fold)
+        q = res_q[t]
+        o = res_o[t]
+        orig = np.asarray(orig_quals, np.int64)
+        use = q < orig[:, None]
+        qi = np.zeros_like(q, dtype=np.int64)
+        qi[use] = q[use].astype(np.int64)
+        contrib = np.where(use, qi, orig[:, None])
+        totals = contrib.sum(axis=0)
+        nc = len(consensuses)
+        best_ci = int(nc - 1 - np.argmin(totals[::-1]))
+        best_total = int(totals[best_ci])
+        best_map = np.where(use[:, best_ci], o[:, best_ci], -1)
         lod = (pre_total - best_total) / 10.0
         # per-target decision logs, the RealignIndels.scala:317-379 trail
         _log = logging.getLogger(__name__)
@@ -840,6 +854,13 @@ def realign_indels(
                     # CIGAR we decline to reproduce.
                     new_cigar = [(len(r.seq), "M")]
                     new_end = new_start + len(r.seq)
+                # a swept offset near the region edge can consume more
+                # reference than the rebuilt window holds (insertion
+                # consensuses are longer than the reference, so valid
+                # consensus offsets can overrun it — another walk the
+                # reference leaves unguarded): leave the read unrealigned
+                if o + (new_end - new_start) > len(reference):
+                    continue
                 md = MdTag.move_alignment(
                     reference[o:], r.seq, cigar_to_string(new_cigar), new_start
                 )

@@ -115,3 +115,41 @@ def test_phred_table_host_device_parity(ref_resources):
         )
     )
     np.testing.assert_array_equal(host, dev)
+
+
+def test_known_sites_native_masking_matches_python(ref_resources):
+    """The native kernel's in-walk SNP masking (sorted site-key binary
+    search) produces the same observation table as the explicit python
+    [N, L] mask path."""
+    from adam_tpu import native
+    from adam_tpu.pipelines import bqsr as bqsr_mod
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    ds = load_alignments(str(ref_resources / "bqsr1.sam"))
+    b = ds.batch.to_numpy()
+    # mask a handful of real covered positions on every contig
+    table = {}
+    for ci, name in enumerate(ds.seq_dict.names):
+        rows = np.flatnonzero((np.asarray(b.contig_idx) == ci) & b.valid)
+        if len(rows):
+            starts = np.asarray(b.start)[rows[:50]]
+            table[name] = np.concatenate(
+                [starts + k for k in range(20)]
+            )
+    snps = SnpTable(table)
+    native_tab = build_observation_table(ds, known_snps=snps)
+
+    # python-mask path: disable native for the observation pass
+    orig = native.bqsr_observe
+    native.bqsr_observe = lambda *a, **k: None
+    try:
+        py_tab = build_observation_table(ds, known_snps=snps)
+    finally:
+        native.bqsr_observe = orig
+    assert sorted(native_tab.to_csv().splitlines()) == sorted(
+        py_tab.to_csv().splitlines()
+    )
+    # and masking actually removed observations vs the unmasked table
+    unmasked = build_observation_table(ds)
+    assert native_tab.total.sum() < unmasked.total.sum()

@@ -569,3 +569,76 @@ def test_native_fastq_writer_bytewise(ref_resources, tmp_path):
     finally:
         native.fastq_encode = orig
     assert p_nat.read_bytes() == p_py.read_bytes()
+
+
+def test_multi_file_load_merges_dictionaries(tmp_path):
+    """Directory/glob loads union every file's sequence + read-group
+    dictionaries and re-index the batches (loadBam's header merge,
+    rdd/ADAMContext.scala:236-257, SequenceDictionary.scala:96-119)."""
+    import numpy as np
+
+    from adam_tpu.api.datasets import AlignmentDataset
+    from adam_tpu.formats.batch import pack_reads
+    from adam_tpu.io import context
+    from adam_tpu.io.sam import SamHeader, write_sam
+    from adam_tpu.models.dictionaries import (
+        RecordGroup, RecordGroupDictionary, SequenceDictionary,
+        SequenceRecord,
+    )
+
+    def mk(path, contigs, rg, names):
+        sd = SequenceDictionary(
+            tuple(SequenceRecord(n, 10_000) for n in contigs)
+        )
+        rgd = RecordGroupDictionary((RecordGroup(rg, library="lib_" + rg),))
+        recs = [
+            dict(name=nm, flags=0, contig_idx=len(contigs) - 1, start=100 + i,
+                 mapq=60, cigar="4M", seq="ACGT", qual="IIII",
+                 read_group_idx=0)
+            for i, nm in enumerate(names)
+        ]
+        batch, side = pack_reads(recs)
+        write_sam(path, batch, side, SamHeader(seq_dict=sd, read_groups=rgd))
+
+    d = tmp_path / "multi"
+    d.mkdir()
+    # disjoint read groups; partially overlapping contigs
+    mk(str(d / "a.sam"), ["chr1", "chr2"], "rgA", ["a1", "a2"])
+    mk(str(d / "b.sam"), ["chr2", "chr3"], "rgB", ["b1"])
+
+    for src in [str(d), str(d / "*.sam")]:
+        ds = context.load_alignments(src)
+        assert ds.seq_dict.names == ["chr1", "chr2", "chr3"]
+        assert sorted(ds.read_groups.names) == ["rgA", "rgB"]
+        b = ds.batch.to_numpy()
+        by_name = {ds.sidecar.names[i]: i for i in range(b.n_rows)}
+        # a-reads sat on their file's last contig (chr2), b's on chr3
+        assert ds.seq_dict.names[b.contig_idx[by_name["a1"]]] == "chr2"
+        assert ds.seq_dict.names[b.contig_idx[by_name["b1"]]] == "chr3"
+        rg_names = ds.read_groups.names
+        assert rg_names[b.read_group_idx[by_name["a2"]]] == "rgA"
+        assert rg_names[b.read_group_idx[by_name["b1"]]] == "rgB"
+
+
+def test_multi_file_load_conflicting_contigs(tmp_path):
+    """Same contig name with different lengths must fail the merge."""
+    import pytest as _pytest
+
+    from adam_tpu.formats.batch import pack_reads
+    from adam_tpu.io import context
+    from adam_tpu.io.sam import SamHeader, write_sam
+    from adam_tpu.models.dictionaries import (
+        SequenceDictionary, SequenceRecord,
+    )
+
+    d = tmp_path / "bad"
+    d.mkdir()
+    for i, ln in enumerate([10_000, 20_000]):
+        sd = SequenceDictionary((SequenceRecord("chr1", ln),))
+        batch, side = pack_reads([
+            dict(name=f"r{i}", flags=0, contig_idx=0, start=10, mapq=60,
+                 cigar="4M", seq="ACGT", qual="IIII")
+        ])
+        write_sam(str(d / f"{i}.sam"), batch, side, SamHeader(seq_dict=sd))
+    with _pytest.raises(ValueError):
+        context.load_alignments(str(d))
