@@ -37,8 +37,10 @@ from typing import BinaryIO, Optional
 
 @dataclass
 class StageConfig:
+    """Every stage is opt-in, matching the reference Transform flags."""
+
     mark_duplicates: bool = False
-    recalibrate: bool = True
+    recalibrate: bool = False
     realign: bool = False
     known_snps: object = None
     known_indels: object = None

@@ -84,6 +84,18 @@ def decode_bases(codes: np.ndarray, length: int | None = None) -> str:
     return BASE_DECODE_LUT[np.minimum(codes, BASE_PAD)].tobytes().decode("ascii")
 
 
+def decode_bases_bulk(codes: np.ndarray, lengths: np.ndarray) -> list[str]:
+    """Decode many rows at once: one LUT pass over the [N, L] code matrix,
+    one bytes->str decode, then per-row string slicing — ~20x cheaper than
+    N ``decode_bases`` calls (each of which pays numpy-call overhead)."""
+    codes = np.asarray(codes, dtype=np.uint8)
+    if codes.ndim != 2:
+        codes = codes.reshape(len(lengths), -1)
+    L = codes.shape[1]
+    s = BASE_DECODE_LUT[np.minimum(codes, BASE_PAD)].tobytes().decode("ascii")
+    return [s[i * L : i * L + int(l)] for i, l in enumerate(lengths)]
+
+
 def encode_quals(qual: str | bytes) -> np.ndarray:
     """Sanger phred+33 string -> u8 phred values."""
     if isinstance(qual, str):

@@ -112,12 +112,18 @@ class StringColumn:
         lens = np.diff(self.offsets)[idx]
         new_off = np.zeros(len(idx) + 1, dtype=np.int64)
         np.cumsum(lens, out=new_off[1:])
-        out = np.empty(int(new_off[-1]), dtype=np.uint8)
-        # gather spans via a flat index build (vectorized, no per-row Python)
+        total = int(new_off[-1])
         if len(idx):
             starts = self.offsets[idx]
-            flat = _span_gather_indices(starts, lens)
-            out[:] = self.buf[flat]
+            from adam_tpu import native
+
+            out = native.span_gather(self.buf, starts, lens, total)
+            if out is None:
+                # fallback: flat index build (vectorized, no per-row Python)
+                out = np.empty(total, dtype=np.uint8)
+                out[:] = self.buf[_span_gather_indices(starts, lens)]
+        else:
+            out = np.empty(0, dtype=np.uint8)
         return StringColumn(out, new_off, self.valid[idx])
 
     @staticmethod

@@ -189,6 +189,7 @@ def _lib() -> Optional[ct.CDLL]:
                 _i32p, _u8p, _i64p, ct.c_int32, ct.c_int32,
                 ct.c_int64, _u8p, ct.c_int64, ct.c_int,
             ]
+            lib.span_gather.argtypes = [_u8p, _i64p, _i64p, ct.c_int64, _u8p]
             _LIB = lib
         except Exception:
             _LOAD_FAILED = True
@@ -808,3 +809,22 @@ def cigar_strings(cigar_ops, cigar_lens, cigar_n):
     if got < 0:
         return None
     return out[:got], offsets
+
+
+def span_gather(src: np.ndarray, starts: np.ndarray, lens: np.ndarray,
+                total: int):
+    """Packed gather of byte spans [starts[i], starts[i]+lens[i]) ->
+    u8[total]; None if native unavailable.  The StringColumn.take hot
+    path."""
+    lib = _lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, np.uint8)
+    starts = np.ascontiguousarray(starts, np.int64)
+    lens = np.ascontiguousarray(lens, np.int64)
+    out = np.empty(int(total), np.uint8)
+    lib.span_gather(
+        _u8_ptr(src), starts.ctypes.data_as(_i64p),
+        lens.ctypes.data_as(_i64p), ct.c_int64(len(starts)), _u8_ptr(out),
+    )
+    return out

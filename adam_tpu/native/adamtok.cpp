@@ -1947,4 +1947,20 @@ int bamtok_fill(
 
 void bamtok_free(void* vh) { delete static_cast<BamHandle*>(vh); }
 
+// Gather variable-width byte spans [starts[i], starts[i]+lens[i]) from src
+// into a packed destination — the StringColumn row-gather (take) kernel.
+// One memcpy per row beats the numpy repeat/arange index machinery (three
+// full-size int64 temporaries) on the single-core hosts this runs on.
+void span_gather(const uint8_t* src, const int64_t* starts,
+                 const int64_t* lens, int64_t n, uint8_t* out) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t l = lens[i];
+    if (l > 0) {
+      memcpy(out + off, src + starts[i], size_t(l));
+      off += l;
+    }
+  }
+}
+
 }  // extern "C"

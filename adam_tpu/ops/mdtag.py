@@ -193,18 +193,33 @@ class MdTag:
         candidates += list(self.deletions)
         return max(candidates) if candidates else self.start
 
-    def get_reference(self, read_sequence: str, cigar: str) -> str:
-        """Reconstruct the reference over the aligned span from the read."""
+    def get_reference(self, read_sequence: str, cigar) -> str:
+        """Reconstruct the reference over the aligned span from the read.
+
+        ``cigar`` may be a string or an already-parsed ``[(len, op)]``
+        list.  M/=/X segments are emitted as one slice patched at the
+        (few) recorded mismatch positions rather than a per-base loop."""
         ref_pos = self.start
         read_pos = 0
         out = []
-        for length, op in parse_cigar(cigar):
+        elems = parse_cigar(cigar) if isinstance(cigar, str) else cigar
+        for length, op in elems:
             if op in "M=X":
-                for _ in range(length):
-                    base = self.mismatches.get(ref_pos)
-                    out.append(base if base else read_sequence[read_pos])
-                    read_pos += 1
-                    ref_pos += 1
+                seg = read_sequence[read_pos : read_pos + length]
+                if self.mismatches:
+                    patches = [
+                        (p - ref_pos, base)
+                        for p, base in self.mismatches.items()
+                        if ref_pos <= p < ref_pos + length and base
+                    ]
+                    if patches:
+                        lseg = list(seg)
+                        for off, base in patches:
+                            lseg[off] = base
+                        seg = "".join(lseg)
+                out.append(seg)
+                read_pos += length
+                ref_pos += length
             elif op == "D":
                 for _ in range(length):
                     base = self.deletions.get(ref_pos)

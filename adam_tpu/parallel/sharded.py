@@ -68,6 +68,11 @@ def transform_sharded(
     os.makedirs(out_path, exist_ok=True)
     tmp = shuffle_dir or tempfile.mkdtemp(prefix="adam_tpu_shards_")
     own_tmp = shuffle_dir is None
+    if known_indels is not None and consensus_model == "reads":
+        # supplying known indels implies the knowns consensus model (the
+        # reference's -known_indels flag semantics; realign_indels only
+        # consults the table under that model)
+        consensus_model = "knowns"
 
     try:
         # ---- 1. shuffle to genome-bin shards --------------------------
@@ -91,6 +96,16 @@ def transform_sharded(
         def load(si: int) -> AlignmentDataset:
             b, s, h = host_shuffle.iter_shards([shard_paths[si]]).__next__()
             return AlignmentDataset(b, s, h)
+
+        def with_dup_flags(ds: AlignmentDataset, si: int) -> AlignmentDataset:
+            if dup_slices[si] is None:
+                return ds
+            b = ds.batch.to_numpy()
+            return ds.with_batch(
+                b.replace(flags=md_mod.apply_duplicate_flags(
+                    np.asarray(b.flags), dup_slices[si]
+                ))
+            )
 
         # ---- 2. pass A: summaries + events ----------------------------
         t = time.perf_counter()
@@ -137,14 +152,7 @@ def transform_sharded(
         if recalibrate:
             parts = []
             for si in range(len(shard_paths)):
-                ds = load(si)
-                if dup_slices[si] is not None:
-                    b = ds.batch.to_numpy()
-                    ds = ds.with_batch(
-                        b.replace(flags=md_mod.apply_duplicate_flags(
-                            np.asarray(b.flags), dup_slices[si]
-                        ))
-                    )
+                ds = with_dup_flags(load(si), si)
                 total, mism, _rg, g = bqsr_mod._observe_device(ds, known_snps)
                 parts.append((np.asarray(total), np.asarray(mism), g))
             total, mism, gl = bqsr_mod.merge_observations(parts)
@@ -155,14 +163,7 @@ def transform_sharded(
         t = time.perf_counter()
         candidates = []
         for si in range(len(shard_paths)):
-            ds = load(si)
-            if dup_slices[si] is not None:
-                b = ds.batch.to_numpy()
-                ds = ds.with_batch(
-                    b.replace(flags=md_mod.apply_duplicate_flags(
-                        np.asarray(b.flags), dup_slices[si]
-                    ))
-                )
+            ds = with_dup_flags(load(si), si)
             if table is not None:
                 ds = bqsr_mod.apply_recalibration(ds, table, gl)
             if targets:

