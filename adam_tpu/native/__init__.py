@@ -822,6 +822,12 @@ def span_gather(src: np.ndarray, starts: np.ndarray, lens: np.ndarray,
     src = np.ascontiguousarray(src, np.uint8)
     starts = np.ascontiguousarray(starts, np.int64)
     lens = np.ascontiguousarray(lens, np.int64)
+    if len(starts) and (
+        int((starts + lens).max()) > src.size or int(starts.min()) < 0
+    ):
+        # corrupt offsets: preserve the numpy path's fail-safe IndexError
+        # instead of memcpy'ing out of bounds
+        return None
     out = np.empty(int(total), np.uint8)
     lib.span_gather(
         _u8_ptr(src), starts.ctypes.data_as(_i64p),

@@ -412,23 +412,27 @@ def _sw_score_kernel(x_ref, y_ref, xmask_ref, ymask_ref, best_ref,
                      h_ref, *, lx: int, ly: int, L: int,
                      w_match: float, w_mismatch: float, w_insert: float,
                      w_delete: float):
-    """Grid-less Mosaic kernel: one call scores a whole batch tile.
+    """Mosaic kernel body for one batch tile, transposed layout.
 
-    State (rolling row + running best) lives in VMEM; per step it reads
-    one y row off the untiled leading dimension and does ~12 [TB, L]
-    VPU ops — static lane shifts only (see module notes on Mosaic's
-    dynamic-slice and grid constraints)."""
-    TB = x_ref.shape[0]
+    Arrays are [L, TB] — read position in SUBLANES, batch pair in LANES —
+    so the per-step y row reads as a clean [1, TB] dynamic slice off the
+    leading dimension and broadcasts against the [L, TB] state for free
+    (a [TB, 1]-shaped slice tiles its size-1 minor dim out to 128 lanes
+    in VMEM: 128x memory for nothing).  State (rolling column + running
+    best) lives in VMEM; the same-row delete chain resolves with log2(L)
+    static sublane shifts."""
+    from jax.experimental import pallas as pl
+
     wm = jnp.float32(w_match)
     wx = jnp.float32(w_mismatch)
     wi = jnp.float32(w_insert)
     wd = jnp.float32(w_delete)
     zf = jnp.float32(0.0)
     ninf = jnp.float32(-jnp.inf)
-    xc = x_ref[:]  # [TB, L] i32, lane i = x[i] (-2 padding)
-    xmask = xmask_ref[:]  # [TB, L] f32 1/0: lane i+1 <= x_len
-    h_ref[:] = jnp.zeros((TB, L), jnp.float32)
-    best_ref[:] = jnp.zeros((TB, L), jnp.float32)
+    xc = x_ref[:]  # [L, TB] i32, sublane i = x[i] (-2 padding)
+    xmask = xmask_ref[:]  # [L, TB] f32 1/0: row i+1 <= x_len
+    h_ref[:] = jnp.zeros_like(h_ref)
+    best_ref[:] = jnp.zeros_like(best_ref)
 
     shifts = []
     s = 1
@@ -437,11 +441,11 @@ def _sw_score_kernel(x_ref, y_ref, xmask_ref, ymask_ref, best_ref,
         s *= 2
 
     def body(j, c):
-        h_prev = h_ref[:]  # lane i holds H[row i+1]... boundary handled by shift
-        yj = y_ref[j, :, :]  # [TB, 1] i32
-        jok = ymask_ref[j, :, :]  # [TB, 1] f32 1/0
+        h_prev = h_ref[:]  # sublane i holds H[row i+1] of previous column
+        yj = y_ref[pl.ds(j, 1), :]  # [1, TB] i32
+        jok = ymask_ref[pl.ds(j, 1), :]  # [1, TB] f32 1/0
         sub = jnp.where(xc == yj, wm, wx)
-        hp_shift = jnp.pad(h_prev[:, : L - 1], ((0, 0), (1, 0)))
+        hp_shift = jnp.pad(h_prev[: L - 1, :], ((1, 0), (0, 0)))
         m = hp_shift + sub
         inn = h_prev + wi
         tmp = jnp.maximum(jnp.maximum(m, inn), zf)
@@ -449,7 +453,7 @@ def _sw_score_kernel(x_ref, y_ref, xmask_ref, ymask_ref, best_ref,
         for s in shifts:
             h = jnp.maximum(
                 h,
-                jnp.pad(h[:, : L - s], ((0, 0), (s, 0)),
+                jnp.pad(h[: L - s, :], ((s, 0), (0, 0)),
                         constant_values=ninf) + jnp.float32(s) * wd,
             )
         h = jnp.maximum(h, zf)
@@ -479,28 +483,27 @@ def _sw_score_pallas(
 
     B = x_codes.shape[0]
     L = _round_up(lx, _LANE)
-    # TB=1024 fails in the remote Mosaic compile service; 512 is the
-    # largest tile that compiles (and big enough to hide the VPU's
-    # latency) — larger batches run tiles under lax.map
-    TB = max(32, min(_round_up(B, 32), 512))
+    TB = max(_LANE, min(_round_up(B, _LANE), 1024))
     Bp = _round_up(B, TB)
 
-    # lane i holds x[i] (the kernel's row i+1); -2 never matches y codes
-    x = jnp.full((Bp, L), -2, jnp.int32).at[:B, :lx].set(
-        x_codes.astype(jnp.int32)
+    # transposed layout (see kernel docstring): [L, Bp] with batch in
+    # lanes; sublane i holds x[i] (the kernel's row i+1); -2 never
+    # matches y codes
+    x = jnp.full((L, Bp), -2, jnp.int32).at[:lx, :B].set(
+        x_codes.astype(jnp.int32).T
     )
     xmask = (
-        jnp.arange(1, L + 1, dtype=jnp.int32)[None, :]
-        <= jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(
+        jnp.arange(1, L + 1, dtype=jnp.int32)[:, None]
+        <= jnp.zeros((1, Bp), jnp.int32).at[0, :B].set(
             x_len.astype(jnp.int32)
         )
     ).astype(jnp.float32)
-    yT = jnp.full((ly, Bp, 1), -1, jnp.int32).at[:, :B, 0].set(
+    yT = jnp.full((ly, Bp), -1, jnp.int32).at[:, :B].set(
         y_codes.astype(jnp.int32).T
     )
     ymask = (
-        jnp.arange(1, ly + 1, dtype=jnp.int32)[:, None, None]
-        <= jnp.zeros((1, Bp, 1), jnp.int32).at[0, :B, 0].set(
+        jnp.arange(1, ly + 1, dtype=jnp.int32)[:, None]
+        <= jnp.zeros((1, Bp), jnp.int32).at[0, :B].set(
             y_len.astype(jnp.int32)
         )
     ).astype(jnp.float32)
@@ -510,26 +513,36 @@ def _sw_score_pallas(
         w_match=w_match, w_mismatch=w_mismatch,
         w_insert=w_insert, w_delete=w_delete,
     )
+    nt = Bp // TB
+    # one pallas_call with a grid over batch (lane) tiles — each grid
+    # step owns a distinct output block, the Mosaic-legal grid shape:
+    # the runtime pipelines tile i+1's HBM->VMEM copies under tile i's
+    # compute, and the whole batch is a single dispatch through the
+    # device tunnel instead of nt sequential kernel launches
     fill = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((TB, L), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((TB, L), jnp.float32)],
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((L, TB), lambda i: (0, i)),
+            pl.BlockSpec((ly, TB), lambda i: (0, i)),
+            pl.BlockSpec((L, TB), lambda i: (0, i)),
+            pl.BlockSpec((ly, TB), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((L, TB), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((L, Bp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((L, TB), jnp.float32)],
         interpret=interpret,
     )
-    nt = Bp // TB
-    if nt == 1:
-        best = fill(x, yT, xmask, ymask)
+    # under jax_enable_x64 the grid machinery traces i64 indices, which
+    # Mosaic fails to legalize ("func.return (i32, i64)"); every dtype in
+    # this kernel is explicit, so tracing the call with x64 off is
+    # semantics-preserving
+    if jax.config.jax_enable_x64:
+        with jax.enable_x64(False):
+            best = fill(x, yT, xmask, ymask)
     else:
-        best = jax.lax.map(
-            lambda t: fill(*t),
-            (
-                x.reshape(nt, TB, L),
-                jnp.transpose(yT.reshape(ly, nt, TB, 1), (1, 0, 2, 3)),
-                xmask.reshape(nt, TB, L),
-                jnp.transpose(ymask.reshape(ly, nt, TB, 1), (1, 0, 2, 3)),
-            ),
-        ).reshape(Bp, L)
-    return best.max(axis=1)[:B]
+        best = fill(x, yT, xmask, ymask)
+    return best.max(axis=0)[:B]
 
 
 def sw_best_scores(
@@ -617,16 +630,18 @@ def _use_pallas() -> bool:
     conflicting claims): the **score-only** striped fills above are the
     benchmark path — :func:`benchmark_gcups` measured on the shared
     v5e bench chip (2026-07-30, chained-rep on-device loop, best of 3):
-    pallas ~5.1 GCUPS / scan ~4.5 GCUPS at B=8192/127x127, while the
-    same chip sustained 2.0 of its 197 TFLOP/s bf16 peak (~1%% granted —
-    it is time-sliced; identical runs vary 0.5-5 GCUPS).  Earlier
-    numbers — "154 GCUPS" (commit 6129bde, an axon-memoization
-    artifact), "12.4 scan / 0.9 pallas" (a moves-path measurement), and
-    the driver's 0.03 (BENCH_r02: [B, D, L] move+score materialization
-    plus x64-emulated index math inside the rep loop) — are obsolete;
-    bench.py now records GCUPS per backend alongside the chip's
-    same-moment matmul fraction so the number can be read against the
-    hardware actually granted.
+    pallas (transposed [L, TB] grid kernel, single dispatch) 5.4-7.5
+    GCUPS ~= scan 5.5-7.4 at B=8192/127x127, while the same chip
+    sustained 20 of its 197 TFLOP/s bf16 peak (~10%% granted — it is
+    time-sliced; identical runs vary several-x).  Both backends sit at
+    the granted-slice ceiling (the kernel's op count puts its
+    full-chip bound at ~127 GCUPS).  Earlier numbers — "154 GCUPS"
+    (commit 6129bde, an axon-memoization artifact), "12.4 scan / 0.9
+    pallas" (a moves-path measurement), and the driver's 0.03
+    (BENCH_r02: [B, D, L] move+score materialization plus x64-emulated
+    index math inside the rep loop) — are obsolete; bench.py records
+    GCUPS per backend alongside the chip's same-moment matmul fraction
+    so the number can be read against the hardware actually granted.
     """
     return os.environ.get("ADAM_TPU_SW_BACKEND", "scan") == "pallas"
 

@@ -655,9 +655,10 @@ def realign_indels(
     # device); results stay on device and one fetch pass drains them
     # after the last flush — the chip sweeps target k's pairs while the
     # single-core host rebuilds target k+1's reference.
-    CH = 2048
+    CH = 8192
     _buckets: dict[tuple[int, int], list] = {}
     _pending = []  # (chunk tasks, device (best_q, best_o))
+    _remaining: dict[int, int] = {}  # target -> sweep results outstanding
 
     def _pow2(n: int, minimum: int) -> int:
         return max(minimum, 1 << (max(int(n), 1) - 1).bit_length())
@@ -803,24 +804,28 @@ def realign_indels(
             (len(to_clean), len(consensuses)), np.inf, np.float32
         )
         res_o[t] = np.full((len(to_clean), len(consensuses)), -1, np.int32)
+        _remaining[t] = len(to_clean) * len(consensuses)
         for ci, c in enumerate(consensuses):
             cons_seq = c.insert_into_reference(reference, ref_start, ref_end)
             cons_codes = schema.encode_bases(cons_seq)  # once per consensus
             for ri, r in enumerate(to_clean):
                 _enqueue_sweep((t, ri, ci, r, cons_codes))
 
-    # ---- phase 2 drain: flush residual chunks, fetch all results ----
+    del seq_of, ref_of  # decoded strings live only through phase 1
+
+    # ---- phase 2 drain + phase 3, interleaved ----
+    # flush residual chunks, then finish each target the moment its last
+    # sweep result lands — the host rewrites completed targets (phase 3)
+    # while the device is still computing later chunks, instead of
+    # blocking through the whole fetch tail first.  Targets write to
+    # disjoint rows, so completion order doesn't affect the output.
     for (lr, lc), lst in _buckets.items():
         if lst:
             _flush_chunk(lr, lc, lst)
-    for chunk, out in _pending:
-        best_q, best_o = jax.tree.map(np.asarray, out)
-        for k, (t, ri, ci, _, _) in enumerate(chunk):
-            res_q[t][ri, ci] = best_q[k]
-            res_o[t][ri, ci] = best_o[k]
 
-    # ---- phase 3 (host): consensus choice + rewrite ----
-    for t, (to_clean, consensuses, reference, ref_start, ref_end) in group_ctx.items():
+    def _finish_target(t: int) -> None:
+        to_clean, consensuses, reference, ref_start, ref_end = group_ctx[t]
+
         def _orig_qual(r):
             if r.dirty and r.md is not None:
                 return _sum_mismatch_quality(
@@ -903,6 +908,15 @@ def realign_indels(
                     r, start=new_start, cigar=new_cigar, md=md, mapq=r.mapq + 10
                 ), new_end
         _write_back(new_batch, side, new_md, new_attrs, to_clean, realigned)
+
+    for chunk, out in _pending:
+        best_q, best_o = jax.tree.map(np.asarray, out)
+        for k, (t, ri, ci, _, _) in enumerate(chunk):
+            res_q[t][ri, ci] = best_q[k]
+            res_o[t][ri, ci] = best_o[k]
+            _remaining[t] -= 1
+            if _remaining[t] == 0:
+                _finish_target(t)
 
     from adam_tpu.formats.strings import StringColumn, with_overrides
 

@@ -132,7 +132,9 @@ def _cpu_child() -> None:
         pass
     known = _known_table()
     _warmup_compiles(known)
-    stats = _run_streamed(known, trials=2)
+    # one trial: the forced-CPU child is deterministic (no time-sliced
+    # chip variance) and a second 1M run risks the caller's timeout
+    stats = _run_streamed(known, trials=1)
     print(json.dumps(stats))
 
 
