@@ -823,10 +823,13 @@ def span_gather(src: np.ndarray, starts: np.ndarray, lens: np.ndarray,
     starts = np.ascontiguousarray(starts, np.int64)
     lens = np.ascontiguousarray(lens, np.int64)
     if len(starts) and (
-        int((starts + lens).max()) > src.size or int(starts.min()) < 0
+        int((starts + lens).max()) > src.size
+        or int(starts.min()) < 0
+        or int(lens.min()) < 0
     ):
-        # corrupt offsets: preserve the numpy path's fail-safe IndexError
-        # instead of memcpy'ing out of bounds
+        # corrupt offsets: preserve the numpy path's fail-safe error
+        # instead of memcpy'ing out of bounds (negative lens from
+        # non-monotonic offsets would otherwise overflow the out buffer)
         return None
     out = np.empty(int(total), np.uint8)
     lib.span_gather(
