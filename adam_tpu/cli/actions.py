@@ -207,6 +207,16 @@ class Transform(Command):
             "directory; requires a markdup/BQSR/realign stage set",
         )
         p.add_argument(
+            "-shards", type=int, default=0,
+            help="run as the composed out-of-core sharded pipeline over N "
+            "genome-bin shards (parallel/sharded.py): windowed ingest "
+            "shuffles to 5'-clipped-position bins, per-shard passes with "
+            "global duplicate/target barriers, boundary-correct realign "
+            "tail — the one-host embodiment of the multi-host execution "
+            "shape; supports the markdup/BQSR/realign stage set on "
+            "SAM/BAM input",
+        )
+        p.add_argument(
             "-backend", default="tpu", choices=["tpu", "spark"],
             help="execution backend: 'tpu' runs the pipeline here; "
             "'spark' is the embedding mode — the caller (a Spark "
@@ -249,35 +259,36 @@ class Transform(Command):
             )
             return 0
 
-        if args.streaming:
+        if (args.shards and args.shards > 0) or args.streaming:
+            # windowed execution modes share validation and knowns/tuning
+            # plumbing: -shards N routes through the composed sharded
+            # pipeline, -streaming through the overlapped windowed one
             import sys
 
-            supported = not (
+            mode = "-shards" if args.shards and args.shards > 0 else "-streaming"
+            ok_stages = not (
                 args.trimReads or args.qualityBasedTrim or args.sort_reads
             )
-            if not supported:
-                print(
-                    "transform -streaming supports the markdup/BQSR/realign "
-                    "stage set; drop -streaming for trim/sort pipelines",
-                    file=sys.stderr,
-                )
-                return 2
             base = str(args.input)
             if base.endswith(".gz"):
                 base = base[:-3]
-            if not base.endswith((".sam", ".bam")) or args.force_load_fastq \
-                    or args.force_load_ifastq or args.force_load_parquet:
+            if (
+                not ok_stages
+                or not base.endswith((".sam", ".bam"))
+                or args.force_load_fastq
+                or args.force_load_ifastq
+                or args.force_load_parquet
+            ):
                 print(
-                    "transform -streaming ingests windowed SAM/BAM only "
-                    f"({args.input!r} is not); drop -streaming for other "
-                    "formats",
+                    f"transform {mode} supports the markdup/BQSR/realign "
+                    "stage set on windowed SAM/BAM input; drop it for "
+                    "trim/sort pipelines or other formats",
                     file=sys.stderr,
                 )
                 return 2
             from adam_tpu.api.datasets import GenotypeDataset as _GD
-            from adam_tpu.pipelines.streamed import transform_streamed
 
-            known = None
+            known = indels = None
             contig_names = None
             if args.known_snps or args.known_indels:
                 contig_names = context.load_header(args.input).seq_dict.names
@@ -285,21 +296,33 @@ class Transform(Command):
                 known = _GD.load(
                     args.known_snps, contig_names=contig_names
                 ).snp_table()
-            kw = {}
             if args.known_indels:
-                kw["consensus_model"] = "knowns"
-                kw["known_indels"] = _GD.load(
+                indels = _GD.load(
                     args.known_indels, contig_names=contig_names
                 ).indel_table()
-            transform_streamed(
-                args.input, args.output,
+            kw = dict(
                 mark_duplicates=bool(args.mark_duplicate_reads),
                 recalibrate=bool(args.recalibrate_base_qualities),
                 realign=bool(args.realign_indels),
                 known_snps=known,
+                known_indels=indels,
                 compression=args.parquet_compression_codec,
-                **kw,
+                max_indel_size=args.max_indel_size,
+                max_consensus_number=args.max_consensus_number,
+                lod_threshold=args.log_odds_threshold,
+                max_target_size=args.max_target_size,
+                dump_observations=args.dump_observations,
             )
+            if mode == "-shards":
+                from adam_tpu.parallel.sharded import transform_sharded
+
+                transform_sharded(
+                    args.input, args.output, args.shards, **kw
+                )
+            else:
+                from adam_tpu.pipelines.streamed import transform_streamed
+
+                transform_streamed(args.input, args.output, **kw)
             return 0
 
         with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):

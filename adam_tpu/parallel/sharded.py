@@ -54,6 +54,11 @@ def transform_sharded(
     compression: str = "snappy",
     shuffle_dir: str | None = None,
     batch_reads: int = 500_000,
+    max_indel_size: int | None = None,
+    max_consensus_number: int | None = None,
+    lod_threshold: float | None = None,
+    max_target_size: int | None = None,
+    dump_observations: str | None = None,
 ) -> dict:
     from adam_tpu.io import context
     from adam_tpu.io.sam import iter_bam_batches, iter_sam_batches
@@ -73,6 +78,13 @@ def transform_sharded(
         # reference's -known_indels flag semantics; realign_indels only
         # consults the table under that model)
         consensus_model = "knowns"
+    mis = (realign_mod.MAX_INDEL_SIZE if max_indel_size is None
+           else max_indel_size)
+    mcn = (realign_mod.MAX_CONSENSUS_NUMBER if max_consensus_number is None
+           else max_consensus_number)
+    lod = realign_mod.LOD_THRESHOLD if lod_threshold is None else lod_threshold
+    mts = (realign_mod.MAX_TARGET_SIZE if max_target_size is None
+           else max_target_size)
 
     try:
         # ---- 1. shuffle to genome-bin shards --------------------------
@@ -121,7 +133,9 @@ def transform_sharded(
                 summaries.append(md_mod.row_summary(ds))
             if realign:
                 events.extend(
-                    realign_mod.extract_indel_events(ds.batch.to_numpy())
+                    realign_mod.extract_indel_events(
+                        ds.batch.to_numpy(), max_indel_size=mis
+                    )
                 )
         stats["n_reads"] = int(sum(counts))
         stats["summaries_s"] = time.perf_counter() - t
@@ -139,7 +153,7 @@ def transform_sharded(
                 off += n
             del summaries
         targets = (
-            realign_mod.merge_events(events, header.seq_dict.names)
+            realign_mod.merge_events(events, header.seq_dict.names, mts)
             if realign
             else []
         )
@@ -156,6 +170,13 @@ def transform_sharded(
                 total, mism, _rg, g = bqsr_mod._observe_device(ds, known_snps)
                 parts.append((np.asarray(total), np.asarray(mism), g))
             total, mism, gl = bqsr_mod.merge_observations(parts)
+            if dump_observations:
+                obs = bqsr_mod.ObservationTable(
+                    np.asarray(total), np.asarray(mism),
+                    header.read_groups.names + ["null"], gl,
+                )
+                with open(dump_observations, "w") as fh:
+                    fh.write(obs.to_csv())
             table = bqsr_mod.solve_recalibration_table(total, mism)
         stats["observe_s"] = time.perf_counter() - t
 
@@ -187,6 +208,10 @@ def transform_sharded(
                 cand,
                 consensus_model=consensus_model,
                 known_indels=known_indels,
+                max_indel_size=mis,
+                max_consensus_number=mcn,
+                lod_threshold=lod,
+                max_target_size=mts,
             )
             _write_part(out_path, len(shard_paths), cand, compression)
         stats["realign_s"] = time.perf_counter() - t

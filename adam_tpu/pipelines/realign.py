@@ -172,7 +172,9 @@ class RealignmentTarget:
         return self.var_start >= 0
 
 
-def extract_indel_events(b) -> list[RealignmentTarget]:
+def extract_indel_events(
+    b, max_indel_size: int = MAX_INDEL_SIZE
+) -> list[RealignmentTarget]:
     """Per-read I/D targets (IndelRealignmentTarget.apply), vectorized
     over the cigar columns."""
     n, C = b.cigar_ops.shape
@@ -188,8 +190,8 @@ def extract_indel_events(b) -> list[RealignmentTarget]:
     for k in range(C):
         op = ops[:, k]
         ln = lens[:, k]
-        ins = active & (op == schema.CIGAR_I) & (ln <= MAX_INDEL_SIZE)
-        dele = active & (op == schema.CIGAR_D) & (ln <= MAX_INDEL_SIZE)
+        ins = active & (op == schema.CIGAR_I) & (ln <= max_indel_size)
+        dele = active & (op == schema.CIGAR_D) & (ln <= max_indel_size)
         for i in np.flatnonzero(ins):
             out.append(
                 RealignmentTarget(int(contigs[i]), int(ref_pos[i]),
@@ -678,7 +680,10 @@ def realign_indels(
     # after the last flush — the chip sweeps target k's pairs while the
     # single-core host rebuilds target k+1's reference.
     CH = 8192   # tasks per dispatch (fixed -> one compiled shape/bucket)
-    NC = 1024   # unique consensus slots per dispatch
+    # consensus slots: large enough that dense data (tasks-per-consensus
+    # = group size >= 4) never flushes early on the cons trigger, small
+    # enough that the always-full-size table transfer stays ~1 MB
+    NC = 2048
     _buckets: dict[tuple[int, int], dict] = {}
     _pending = []  # (chunk tasks, device (best_q, best_o))
     _remaining: dict[int, int] = {}  # target -> sweep results outstanding

@@ -108,6 +108,11 @@ def transform_streamed(
     window_reads: int = 262_144,
     compression: str = "snappy",
     n_writers: int = 3,
+    max_indel_size: int | None = None,
+    max_consensus_number: int | None = None,
+    lod_threshold: float | None = None,
+    max_target_size: int | None = None,
+    dump_observations: Optional[str] = None,
 ) -> dict:
     """Run the flagship transform as a streamed, overlapped pipeline.
 
@@ -127,6 +132,13 @@ def transform_streamed(
         # reference's -known_indels flag semantics; realign_indels only
         # consults the table under that model)
         consensus_model = "knowns"
+    from adam_tpu.pipelines import realign as _rm
+
+    mis = _rm.MAX_INDEL_SIZE if max_indel_size is None else max_indel_size
+    mcn = (_rm.MAX_CONSENSUS_NUMBER if max_consensus_number is None
+           else max_consensus_number)
+    lod = _rm.LOD_THRESHOLD if lod_threshold is None else lod_threshold
+    mts = _rm.MAX_TARGET_SIZE if max_target_size is None else max_target_size
 
     # ---- pass A: ingest || summaries + events --------------------------
     in_q: queue.Queue = queue.Queue(maxsize=3)
@@ -156,7 +168,9 @@ def transform_streamed(
                 summaries.append(md_mod.row_summary(ds))
             if realign:
                 events.extend(
-                    realign_mod.extract_indel_events(batch.to_numpy())
+                    realign_mod.extract_indel_events(
+                        batch.to_numpy(), max_indel_size=mis
+                    )
                 )
     except BaseException:
         abort.set()
@@ -184,7 +198,7 @@ def transform_streamed(
             off += n
         del summaries
     targets = (
-        realign_mod.merge_events(events, header.seq_dict.names)
+        realign_mod.merge_events(events, header.seq_dict.names, mts)
         if realign
         else []
     )
@@ -200,6 +214,13 @@ def transform_streamed(
             total, mism, _rg, g = bqsr_mod._observe_device(w, known_snps)
             parts.append((np.asarray(total), np.asarray(mism), g))
         total, mism, gl = bqsr_mod.merge_observations(parts)
+        if dump_observations:
+            obs = bqsr_mod.ObservationTable(
+                np.asarray(total), np.asarray(mism),
+                header.read_groups.names + ["null"], gl,
+            )
+            with open(dump_observations, "w") as fh:
+                fh.write(obs.to_csv())
         table = bqsr_mod.solve_recalibration_table(total, mism)
     stats["observe_s"] = time.perf_counter() - t
 
@@ -238,6 +259,10 @@ def transform_streamed(
                 cand,
                 consensus_model=consensus_model,
                 known_indels=known_indels,
+                max_indel_size=mis,
+                max_consensus_number=mcn,
+                lod_threshold=lod,
+                max_target_size=mts,
             )
             futures.append(
                 pool.submit(
