@@ -259,13 +259,19 @@ class Transform(Command):
             )
             return 0
 
-        if (args.shards and args.shards > 0) or args.streaming:
+        if args.shards and args.shards < 0:
+            import sys
+
+            print(f"transform -shards must be positive (got {args.shards})",
+                  file=sys.stderr)
+            return 2
+        if args.shards or args.streaming:
             # windowed execution modes share validation and knowns/tuning
             # plumbing: -shards N routes through the composed sharded
             # pipeline, -streaming through the overlapped windowed one
             import sys
 
-            mode = "-shards" if args.shards and args.shards > 0 else "-streaming"
+            mode = "-shards" if args.shards else "-streaming"
             ok_stages = not (
                 args.trimReads or args.qualityBasedTrim or args.sort_reads
             )

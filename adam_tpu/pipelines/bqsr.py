@@ -521,6 +521,15 @@ def solve_recalibration_table(total, mism) -> np.ndarray:
     return np.asarray(recalibration_phred_table(total, mism).astype(jnp.uint8))
 
 
+def dump_observation_csv(total, mism, rg_names, lmax, path) -> None:
+    """Write the merged observation histogram as the reference's
+    ObservationTable CSV (shared by the monolithic, streamed and sharded
+    drivers so the format lives in one place)."""
+    obs = ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
+    with open(path, "w") as fh:
+        fh.write(obs.to_csv())
+
+
 def recalibrate_base_qualities(
     ds: AlignmentDataset,
     known_snps: Optional[SnpTable] = None,
@@ -528,9 +537,7 @@ def recalibrate_base_qualities(
 ) -> AlignmentDataset:
     total, mism, rg_names, lmax = _observe_device(ds, known_snps)
     if dump_observation_table:
-        obs = ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
-        with open(dump_observation_table, "w") as fh:
-            fh.write(obs.to_csv())
+        dump_observation_csv(total, mism, rg_names, lmax, dump_observation_table)
     # the delta-stack table is built on device from the psum-able
     # histograms, but the per-residue application is a pure GATHER — run
     # it host-side from the compact u8 table (n_rg x 94 x cycles x 17,

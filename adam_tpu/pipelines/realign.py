@@ -222,11 +222,30 @@ def _targets_overlap(a: RealignmentTarget, b: RealignmentTarget) -> bool:
     )
 
 
-def find_targets(ds: AlignmentDataset, max_target_size: int = MAX_TARGET_SIZE):
+def find_targets(
+    ds: AlignmentDataset,
+    max_target_size: int = MAX_TARGET_SIZE,
+    max_indel_size: int = MAX_INDEL_SIZE,
+):
     """Sorted, merged, deduped target list."""
     b = ds.batch.to_numpy()
-    events = extract_indel_events(b)
+    events = extract_indel_events(b, max_indel_size)
     return merge_events(events, ds.seq_dict.names, max_target_size)
+
+
+def resolve_tuning(
+    max_indel_size=None, max_consensus_number=None,
+    lod_threshold=None, max_target_size=None,
+) -> tuple[int, int, float, int]:
+    """None-coalesce the realignment tuning knobs against the module
+    defaults (shared by the streamed/sharded/monolithic drivers)."""
+    return (
+        MAX_INDEL_SIZE if max_indel_size is None else max_indel_size,
+        MAX_CONSENSUS_NUMBER if max_consensus_number is None
+        else max_consensus_number,
+        LOD_THRESHOLD if lod_threshold is None else lod_threshold,
+        MAX_TARGET_SIZE if max_target_size is None else max_target_size,
+    )
 
 
 def merge_events(
@@ -588,7 +607,7 @@ def realign_indels(
     n = b.n_rows
     if n == 0:
         return ds
-    targets = find_targets(ds, max_target_size)
+    targets = find_targets(ds, max_target_size, max_indel_size)
     if not targets:
         return ds
     names = ds.seq_dict.names
@@ -695,15 +714,21 @@ def realign_indels(
         lr, lc = key
         st = _buckets.pop(key)
         tasks = st["tasks"]
-        rc = np.full((CH, lr), schema.BASE_PAD, np.uint8)
-        rq = np.zeros((CH, lr), np.uint8)
-        rl = np.zeros(CH, np.int32)
-        ct = np.full((NC, lc), schema.BASE_PAD, np.uint8)
-        cl = np.zeros(NC, np.int32)
+        # two shape tiers per bucket: small flushes (residuals, small
+        # inputs) use a 1024-task shape so a near-empty chunk doesn't
+        # pay the full 8192-row compute on slow backends; both tiers
+        # stay fixed so the compile-shape set is bounded at two
+        ch = CH if len(tasks) > 1024 else 1024
+        nc = NC if ch == CH else 1024
+        rc = np.full((ch, lr), schema.BASE_PAD, np.uint8)
+        rq = np.zeros((ch, lr), np.uint8)
+        rl = np.zeros(ch, np.int32)
+        ct = np.full((nc, lc), schema.BASE_PAD, np.uint8)
+        cl = np.zeros(nc, np.int32)
         for s, codes in enumerate(st["cons"]):
             ct[s, : len(codes)] = codes
             cl[s] = len(codes)
-        cidx = np.zeros(CH, np.int32)
+        cidx = np.zeros(ch, np.int32)
         for k, (_t, _ri, _ci, r, cs) in enumerate(tasks):
             rc[k, : len(r.codes)] = r.codes
             rq[k, : len(r.quals)] = r.quals

@@ -132,13 +132,9 @@ def transform_streamed(
         # reference's -known_indels flag semantics; realign_indels only
         # consults the table under that model)
         consensus_model = "knowns"
-    from adam_tpu.pipelines import realign as _rm
-
-    mis = _rm.MAX_INDEL_SIZE if max_indel_size is None else max_indel_size
-    mcn = (_rm.MAX_CONSENSUS_NUMBER if max_consensus_number is None
-           else max_consensus_number)
-    lod = _rm.LOD_THRESHOLD if lod_threshold is None else lod_threshold
-    mts = _rm.MAX_TARGET_SIZE if max_target_size is None else max_target_size
+    mis, mcn, lod, mts = realign_mod.resolve_tuning(
+        max_indel_size, max_consensus_number, lod_threshold, max_target_size
+    )
 
     # ---- pass A: ingest || summaries + events --------------------------
     in_q: queue.Queue = queue.Queue(maxsize=3)
@@ -215,12 +211,10 @@ def transform_streamed(
             parts.append((np.asarray(total), np.asarray(mism), g))
         total, mism, gl = bqsr_mod.merge_observations(parts)
         if dump_observations:
-            obs = bqsr_mod.ObservationTable(
-                np.asarray(total), np.asarray(mism),
-                header.read_groups.names + ["null"], gl,
+            bqsr_mod.dump_observation_csv(
+                total, mism, header.read_groups.names + ["null"], gl,
+                dump_observations,
             )
-            with open(dump_observations, "w") as fh:
-                fh.write(obs.to_csv())
         table = bqsr_mod.solve_recalibration_table(total, mism)
     stats["observe_s"] = time.perf_counter() - t
 

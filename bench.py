@@ -217,7 +217,7 @@ def main() -> None:
     _ensure_synth()
     known = _known_table()
     _warmup_compiles(known)
-    stages = _run_streamed(known, trials=2)
+    stages = _run_streamed(known, trials=3)
     rps = stages["n_reads"] / stages["total_s"]
 
     try:

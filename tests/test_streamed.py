@@ -156,3 +156,29 @@ def test_streamed_stage_toggles(tmp_path):
     )
     back = context.load_alignments(out)
     _assert_equal(mono, back)
+
+
+def test_streamed_tuning_flags_and_dump_observations(tmp_path):
+    """The realign tuning knobs thread through (a prohibitive LOD
+    threshold suppresses all realignment) and -dump_observations writes
+    the merged observation table CSV."""
+    from make_wgs_sam import make_wgs
+
+    path = str(tmp_path / "in.sam")
+    make_wgs(path, 2048, 100, n_contigs=1, contig_len=30_000)
+    obs = str(tmp_path / "obs.csv")
+    out1 = str(tmp_path / "strict.adam")
+    transform_streamed(
+        path, out1, window_reads=512,
+        lod_threshold=1e12, dump_observations=obs,
+    )
+    assert open(obs).read().startswith("ReadGroup,")
+    strict = context.load_alignments(out1)
+    b1 = strict.batch.to_numpy()
+    # nothing clears the absurd LOD bar: no read gets the +10 mapq
+    base_mapq = int(np.asarray(b1.mapq)[np.asarray(b1.valid)].max())
+    out2 = str(tmp_path / "default.adam")
+    transform_streamed(path, out2, window_reads=512)
+    b2 = context.load_alignments(out2).batch.to_numpy()
+    assert int(np.asarray(b2.mapq).max()) == base_mapq + 10  # default realigns
+    assert int(np.asarray(b1.mapq).max()) == base_mapq
