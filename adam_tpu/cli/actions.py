@@ -6,6 +6,8 @@ flatten — each docstring cites the reference command it mirrors.
 
 from __future__ import annotations
 
+import sys
+
 import numpy as np
 
 from adam_tpu.cli.main import Command
@@ -260,8 +262,6 @@ class Transform(Command):
             return 0
 
         if args.shards and args.shards < 0:
-            import sys
-
             print(f"transform -shards must be positive (got {args.shards})",
                   file=sys.stderr)
             return 2
@@ -269,8 +269,6 @@ class Transform(Command):
             # windowed execution modes share validation and knowns/tuning
             # plumbing: -shards N routes through the composed sharded
             # pipeline, -streaming through the overlapped windowed one
-            import sys
-
             mode = "-shards" if args.shards else "-streaming"
             ok_stages = not (
                 args.trimReads or args.qualityBasedTrim or args.sort_reads

@@ -524,9 +524,14 @@ class _Read:
         return self.start + sum(n for n, op in self.cigar if op in "MDN=X")
 
 
-def _get_reference_from_reads(reads: list[_Read]):
-    """RealignIndels.getReferenceFromReads (:185-215)."""
-    refs = []
+def _get_reference_from_reads(reads: list[_Read], extra_refs=()):
+    """RealignIndels.getReferenceFromReads (:185-215).
+
+    ``extra_refs`` carries (ref, start, end) tuples for reads that exist
+    only as columnar rows (the pure clean majority never materialized as
+    ``_Read`` objects); they splice into the window exactly as reads do.
+    """
+    refs = list(extra_refs)
     for r in reads:
         ref = r.ref
         if ref is None and r.md is not None:  # directly-built _Reads
@@ -658,13 +663,6 @@ def realign_indels(
     seq_of: dict[int, str] = {}
     ref_of: dict[int, str] = {}
     if len(all_rows):
-        lens_sub = np.asarray(b.lengths)[all_rows]
-        seq_of = dict(
-            zip(
-                (int(i) for i in all_rows),
-                schema.decode_bases_bulk(np.asarray(b.bases)[all_rows], lens_sub),
-            )
-        )
         purev = (
             (np.asarray(b.cigar_n)[all_rows] == 1)
             & (np.asarray(b.cigar_ops)[all_rows, 0] == schema.CIGAR_M)
@@ -677,6 +675,20 @@ def realign_indels(
                     (int(i) for i in prows),
                     schema.decode_bases_bulk(
                         ref_codes[prows], np.asarray(b.lengths)[prows]
+                    ),
+                )
+            )
+        # sequences are only needed for rows that materialize a _Read —
+        # the pure clean majority (in ref_of, no mismatches) is skipped
+        # by the light path below and never decodes
+        heavy = all_rows[~(purev & ~row_has_mm[all_rows])]
+        if len(heavy):
+            seq_of = dict(
+                zip(
+                    (int(i) for i in heavy),
+                    schema.decode_bases_bulk(
+                        np.asarray(b.bases)[heavy],
+                        np.asarray(b.lengths)[heavy],
                     ),
                 )
             )
@@ -759,7 +771,15 @@ def realign_indels(
             _flush_bucket(key)
     for t, rows in groups.items():
         reads = []
+        extra_refs = []
         for i in rows:
+            if i in ref_of and not row_has_mm[i]:
+                # pure clean majority: never swept, never rewritten —
+                # contributes only its reference slice to the window
+                # rebuild, so no _Read is materialized at all
+                s0 = int(b.start[i])
+                extra_refs.append((ref_of[i], s0, s0 + int(b.lengths[i])))
+                continue
             L = int(b.lengths[i])
             seq = seq_of[i]
             nc = int(b.cigar_n[i])
@@ -800,7 +820,9 @@ def realign_indels(
         if not to_clean:
             continue
         try:
-            reference, ref_start, ref_end = _get_reference_from_reads(reads)
+            reference, ref_start, ref_end = _get_reference_from_reads(
+                reads, extra_refs
+            )
         except ValueError:
             continue
         contig_idx = targets[t].contig_idx
