@@ -251,6 +251,20 @@ def main() -> None:
             }
         )
     )
+    # per-config reads/sec derived from the fused run's stage split
+    # (BASELINE configs 2-4; config 1 is the kmers line).  "derived"
+    # because each config's wall = its stages + the shared ingest cost.
+    n = stages["n_reads"]
+
+    def _cfg(*keys):
+        t = stages.get("ingest_pass_s", 0) + sum(stages.get(k, 0) for k in keys)
+        return round(n / t, 1) if t > 0 else None
+
+    configs = {
+        "cfg2_markdup_derived_rps": _cfg("resolve_s"),
+        "cfg3_bqsr_known_sites_derived_rps": _cfg("observe_s", "apply_split_s"),
+        "cfg4_realign_derived_rps": _cfg("realign_s"),
+    }
     print(
         json.dumps(
             {
@@ -258,6 +272,7 @@ def main() -> None:
                 "sw": sw_info,
                 "kmers_per_sec": round(kps, 1),
                 "cpu_baseline_reads_per_sec": round(cpu_rps, 1),
+                **configs,
                 "chip_stages_s": {
                     k: round(v, 2)
                     for k, v in stages.items() if k.endswith("_s")
