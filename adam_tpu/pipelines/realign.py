@@ -98,12 +98,28 @@ def move_cigar_left(elems: list[tuple[int, str]], index: int):
 
 
 def shift_indel(elems, position: int, shifts: int):
-    """NormalizationUtils.shiftIndel (:142-153)."""
+    """NormalizationUtils.shiftIndel (:142-153).
+
+    The reference's well-formedness guard only compares total element
+    length (RichCigar.isWellFormed:123-125 against the OLD total), so
+    once the element before the indel is fully consumed, further moves
+    start trimming the indel itself — the total can stay equal while the
+    READ span (S+M+I) grows, and the reference then crashes in
+    MdTag.moveAlignment on the out-of-range read index (a walk its
+    suite never reaches; observed here on WGS-shaped data as an M span
+    overrunning the read).  We additionally pin the read length,
+    declining the corrupting move instead of reproducing the crash
+    (test_shift_indel_declines_read_length_corruption)."""
     cur = list(elems)
     total = _cigar_total_len(cur)
+    rlen = cigar_read_len(cur)
     while True:
         new = move_cigar_left(cur, position)
-        if shifts == 0 or _cigar_total_len(new) != total:
+        if (
+            shifts == 0
+            or _cigar_total_len(new) != total
+            or cigar_read_len(new) != rlen
+        ):
             return cur
         cur = new
         shifts -= 1

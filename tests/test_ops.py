@@ -277,6 +277,29 @@ def test_sw_score_only_parity():
     np.testing.assert_array_equal(ref, got_pl)
 
 
+def test_sw_score_long_reads_multi_tile():
+    """Long-read shapes: lx past one 128-lane tile (L=256 sublane
+    state, 9-step delete chains) agrees across backends, N codes
+    included.  (Multi-grid-tile batches run on the real chip in
+    benchmark_gcups; interpret mode keeps this test single-tile.)"""
+    rng = np.random.default_rng(11)
+    B, lx, ly = 300, 250, 310
+    xc = rng.integers(0, 5, (B, lx)).astype(np.int32)  # incl. N codes
+    yc = rng.integers(0, 5, (B, ly)).astype(np.int32)
+    xl = rng.integers(40, lx + 1, B).astype(np.int32)
+    yl = rng.integers(60, ly + 1, B).astype(np.int32)
+    args = (1.0, -0.333, -0.5, -0.5)
+    got_scan = np.asarray(sw.sw_best_scores(xc, xl, yc, yl, *args,
+                                            backend="scan"))
+    got_pl = np.asarray(
+        sw._sw_score_pallas(
+            jnp.asarray(xc), jnp.asarray(xl), jnp.asarray(yc),
+            jnp.asarray(yl), lx, ly, *args, interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(got_scan, got_pl)
+
+
 # ------------------------------------------------------------------ mdtag
 def test_mdtag_parse_and_tostring_roundtrip():
     for md in ["75", "10A5", "0A74", "10^AC5", "5A0C5", "0C0C10", "10^AC0T5"]:

@@ -165,3 +165,15 @@ def test_realign_no_targets_passthrough(ref_resources):
     b0, b1 = ds.batch.to_numpy(), out.batch.to_numpy()
     np.testing.assert_array_equal(b0.start, b1.start)
     np.testing.assert_array_equal(b0.cigar_ops, b1.cigar_ops)
+
+
+def test_shift_indel_declines_read_length_corruption():
+    """A left shift that would eat the element before the indel and trim
+    the indel itself (keeping total element length but changing the read
+    span) stops at the last well-formed cigar instead of emitting one
+    whose M span overruns the read (the walk the reference leaves
+    unguarded: RichCigar.isWellFormed only pins the total)."""
+    cigar = [(6, "S"), (95, "M"), (5, "D"), (1, "M")]
+    out = ra.shift_indel(cigar, 2, 200)  # absurd shift budget
+    assert ra.cigar_read_len(out) == ra.cigar_read_len(cigar) == 102
+    assert ra._cigar_total_len(out) == ra._cigar_total_len(cigar)
