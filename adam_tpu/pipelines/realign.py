@@ -107,18 +107,28 @@ def shift_indel(elems, position: int, shifts: int):
     READ span (S+M+I) grows, and the reference then crashes in
     MdTag.moveAlignment on the out-of-range read index (a walk its
     suite never reaches; observed here on WGS-shaped data as an M span
-    overrunning the read).  We additionally pin the read length,
-    declining the corrupting move instead of reproducing the crash
-    (test_shift_indel_declines_read_length_corruption)."""
+    overrunning the read).  We additionally pin the read span AND the
+    reference span, declining the corrupting move instead of
+    reproducing the crash: a trimmed deletion changes the read span at
+    constant total, while a trimmed insertion keeps both total and read
+    span and silently erases the indel into M, growing the reference
+    walk (tests: test_shift_indel_declines_read_length_corruption /
+    _insertion_erasure)."""
+
+    def _ref_len(es):
+        return sum(n for n, op in es if op in "MDN=X")
+
     cur = list(elems)
     total = _cigar_total_len(cur)
     rlen = cigar_read_len(cur)
+    reflen = _ref_len(cur)
     while True:
         new = move_cigar_left(cur, position)
         if (
             shifts == 0
             or _cigar_total_len(new) != total
             or cigar_read_len(new) != rlen
+            or _ref_len(new) != reflen
         ):
             return cur
         cur = new

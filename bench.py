@@ -111,6 +111,12 @@ def _cpu_baseline() -> dict:
     """Same pipeline, same 1M input, local CPU backend -> stats dict."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # do NOT share the persistent compile cache with tunneled-backend
+    # runs: its "cpu" entries can be AOT results compiled by the remote
+    # service for a different machine profile (+prefer-no-gather etc.) —
+    # loading them silently de-optimizes the baseline's gather-heavy
+    # kernels several-x (tests/conftest.py guards the same hazard)
+    env["ADAM_TPU_NO_COMPILE_CACHE"] = "1"
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--cpu-child"],
         env=env, capture_output=True, text=True, timeout=3600,
@@ -122,6 +128,9 @@ def _cpu_baseline() -> dict:
 
 
 def _cpu_child() -> None:
+    # belt for the parent's env braces: a hermetic CPU process must not
+    # read tunneled-backend compile-cache entries (see _cpu_baseline)
+    os.environ["ADAM_TPU_NO_COMPILE_CACHE"] = "1"
     try:
         import jax
         import jax._src.xla_bridge as _xb

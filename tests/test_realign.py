@@ -177,3 +177,15 @@ def test_shift_indel_declines_read_length_corruption():
     out = ra.shift_indel(cigar, 2, 200)  # absurd shift budget
     assert ra.cigar_read_len(out) == ra.cigar_read_len(cigar) == 102
     assert ra._cigar_total_len(out) == ra._cigar_total_len(cigar)
+
+
+def test_shift_indel_declines_insertion_erasure():
+    """An over-budget shift on an insertion cigar would trim the I into
+    M (total and read span both constant, reference span growing) —
+    the reference-span pin declines that move and the insertion
+    survives."""
+    cigar = [(6, "S"), (5, "M"), (3, "I"), (90, "M")]
+    out = ra.shift_indel(cigar, 2, 200)
+    assert any(op == "I" for _, op in out), out
+    assert sum(n for n, op in out if op in "MDN=X") == 95  # ref span kept
+    assert ra.cigar_read_len(out) == ra.cigar_read_len(cigar)
