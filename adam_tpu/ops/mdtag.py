@@ -145,30 +145,39 @@ class MdTag:
         read_pos = 0
         for length, op in parse_cigar(new_cigar):
             if op == "M":
-                range_start = 0
-                in_match = False
-                for _ in range(length):
-                    if reference[ref_pos] == sequence[read_pos]:
-                        if not in_match:
-                            range_start = ref_pos
-                            in_match = True
-                    else:
-                        if in_match:
-                            tag.matches.append(
-                                (range_start + read_start, ref_pos + read_start)
-                            )
-                            in_match = False
-                        tag.mismatches[ref_pos + read_start] = reference[ref_pos]
-                    read_pos += 1
-                    ref_pos += 1
-                if in_match:
+                rseg = reference[ref_pos : ref_pos + length]
+                sseg = sequence[read_pos : read_pos + length]
+                if len(rseg) < length or len(sseg) < length:
+                    raise IndexError("string index out of range")
+                if rseg == sseg:  # whole-segment match, the common case
                     tag.matches.append(
-                        (range_start + read_start, ref_pos + read_start)
+                        (ref_pos + read_start, ref_pos + length + read_start)
                     )
+                else:
+                    # byte-compare the segment once; match runs are the
+                    # gaps between mismatch positions
+                    a = np.frombuffer(rseg.encode("ascii"), np.uint8)
+                    bb = np.frombuffer(sseg.encode("ascii"), np.uint8)
+                    mm = np.flatnonzero(a != bb)
+                    for j in mm:
+                        tag.mismatches[ref_pos + int(j) + read_start] = rseg[int(j)]
+                    prev = -1
+                    for j in [int(x) for x in mm] + [length]:
+                        if j > prev + 1:
+                            tag.matches.append(
+                                (ref_pos + prev + 1 + read_start,
+                                 ref_pos + j + read_start)
+                            )
+                        prev = j
+                read_pos += length
+                ref_pos += length
             elif op == "D":
-                for _ in range(length):
-                    tag.deletions[ref_pos + read_start] = reference[ref_pos]
-                    ref_pos += 1
+                dseg = reference[ref_pos : ref_pos + length]
+                if len(dseg) < length:
+                    raise IndexError("string index out of range")
+                for j, ch in enumerate(dseg):
+                    tag.deletions[ref_pos + j + read_start] = ch
+                ref_pos += length
             elif op in "ISHP":
                 if op in "IS":
                     read_pos += length

@@ -61,6 +61,10 @@ def cigar_read_len(elems) -> int:
     return sum(n for n, op in elems if op in "MIS=X")
 
 
+def cigar_ref_len(elems) -> int:
+    return sum(n for n, op in elems if op in "MDN=X")
+
+
 def cigar_num_alignment_blocks(elems) -> int:
     return sum(1 for _, op in elems if op == "M")
 
@@ -115,20 +119,17 @@ def shift_indel(elems, position: int, shifts: int):
     walk (tests: test_shift_indel_declines_read_length_corruption /
     _insertion_erasure)."""
 
-    def _ref_len(es):
-        return sum(n for n, op in es if op in "MDN=X")
-
     cur = list(elems)
     total = _cigar_total_len(cur)
     rlen = cigar_read_len(cur)
-    reflen = _ref_len(cur)
+    reflen = cigar_ref_len(cur)
     while True:
         new = move_cigar_left(cur, position)
         if (
             shifts == 0
             or _cigar_total_len(new) != total
             or cigar_read_len(new) != rlen
-            or _ref_len(new) != reflen
+            or cigar_ref_len(new) != reflen
         ):
             return cur
         cur = new
@@ -547,7 +548,7 @@ class _Read:
 
     @property
     def end(self) -> int:
-        return self.start + sum(n for n, op in self.cigar if op in "MDN=X")
+        return self.start + cigar_ref_len(self.cigar)
 
 
 def _get_reference_from_reads(reads: list[_Read], extra_refs=()):
