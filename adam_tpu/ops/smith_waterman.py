@@ -395,7 +395,9 @@ def _sw_score_scan(
                 + jnp.float32(s) * wd,
             )
         h = jnp.where(in_x & jok[:, None], h, 0.0)
-        best = jnp.maximum(best, h.max(axis=1))
+        # keep best as a [B, lx] accumulator — one elementwise max per
+        # step instead of a per-step lane reduction; reduce once at end
+        best = jnp.maximum(best, h)
         hfull = jnp.pad(h, ((0, 0), (1, 0)))  # prepend boundary row 0
         return (hfull, best), None
 
@@ -404,8 +406,10 @@ def _sw_score_scan(
         jnp.arange(1, ly + 1, dtype=jnp.int32)[:, None]
         <= y_len.astype(jnp.int32)[None, :]
     )
-    (_, best), _ = jax.lax.scan(step, (h0, jnp.zeros(B, jnp.float32)), (yT, jok))
-    return best
+    (_, best2d), _ = jax.lax.scan(
+        step, (h0, jnp.zeros((B, L - 1), jnp.float32)), (yT, jok)
+    )
+    return best2d.max(axis=1)
 
 
 def _sw_score_kernel(x_ref, y_ref, xmask_ref, ymask_ref, best_ref,
