@@ -265,6 +265,13 @@ class Transform(Command):
             print(f"transform -shards must be positive (got {args.shards})",
                   file=sys.stderr)
             return 2
+        if args.shards and args.streaming:
+            print(
+                "transform -shards and -streaming are mutually exclusive "
+                "execution modes; pass one or the other",
+                file=sys.stderr,
+            )
+            return 2
         if args.shards or args.streaming:
             # windowed execution modes share validation and knowns/tuning
             # plumbing: -shards N routes through the composed sharded

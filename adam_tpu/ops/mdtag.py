@@ -215,6 +215,15 @@ class MdTag:
         for length, op in elems:
             if op in "M=X":
                 seg = read_sequence[read_pos : read_pos + length]
+                if len(seg) < length:
+                    # corrupt alignment: the CIGAR span overruns the
+                    # read; fail loudly (move_alignment does the same)
+                    # instead of emitting a silently truncated reference
+                    raise IndexError(
+                        f"CIGAR {op}-segment of length {length} overruns "
+                        f"read of length {len(read_sequence)} at read "
+                        f"position {read_pos}"
+                    )
                 if self.mismatches:
                     patches = [
                         (p - ref_pos, base)

@@ -444,6 +444,24 @@ def map_batch_to_targets(b, targets, names, mode: str = "overlap") -> np.ndarray
 # --------------------------------------------------------------------------
 # Batched sweep kernel (device)
 # --------------------------------------------------------------------------
+def _pow2(n: int, minimum: int) -> int:
+    return max(minimum, 1 << (max(int(n), 1) - 1).bit_length())
+
+
+def sweep_bucket_shape(read_len: int, cons_len: int) -> tuple[int, int]:
+    """Padded (lr, lc) bucket for one (read, consensus) sweep task.
+
+    The kernel yields ``lc - lr + 1`` offsets but the reference sweeps
+    offsets ``o < cons_len - read_len``; when ``lr`` rounds up past
+    ``read_len`` the consensus bucket must absorb the padding
+    (``lc >= cons_len + lr - read_len``) or tail offsets are silently
+    lost (e.g. read_len=100 -> lr=128 with cons_len=250 needs lc=512,
+    not 256, to represent offsets 129..149)."""
+    lr = _pow2(read_len, 32)
+    lc = _pow2(max(cons_len + (lr - read_len), lr + 1), 64)
+    return lr, lc
+
+
 @partial(jax.jit, static_argnames=("lr", "lc"))
 def sweep_kernel_gather(read_codes, read_quals, read_len, cons_tbl,
                         clen_tbl, cons_idx, lr: int, lc: int):
@@ -746,9 +764,6 @@ def realign_indels(
     _pending = []  # (chunk tasks, device (best_q, best_o))
     _remaining: dict[int, int] = {}  # target -> sweep results outstanding
 
-    def _pow2(n: int, minimum: int) -> int:
-        return max(minimum, 1 << (max(int(n), 1) - 1).bit_length())
-
     def _flush_bucket(key) -> None:
         lr, lc = key
         st = _buckets.pop(key)
@@ -781,10 +796,7 @@ def realign_indels(
 
     def _enqueue_sweep(task) -> None:
         t, ri, ci, r, cons_codes = task
-        key = (
-            _pow2(len(r.codes), 32),
-            _pow2(max(len(cons_codes), len(r.codes) + 1), 64),
-        )
+        key = sweep_bucket_shape(len(r.codes), len(cons_codes))
         st = _buckets.get(key)
         if st is None:
             st = _buckets[key] = {"tasks": [], "cmap": {}, "cons": []}

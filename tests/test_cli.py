@@ -332,7 +332,29 @@ def test_transform_shards_matches_monolithic(ref_resources, tmp_path):
     a = context.load_alignments(out_sh)
     b = context.load_alignments(out_mono)
     ba, bb = a.batch.to_numpy(), b.batch.to_numpy()
-    # shard output is bin-ordered; compare as (name, flags) keyed sets
-    ka = sorted(zip(a.sidecar.names, ba.flags.tolist(), ba.quals.sum(axis=1).tolist()))
-    kb = sorted(zip(b.sidecar.names, bb.flags.tolist(), bb.quals.sum(axis=1).tolist()))
-    assert ka == kb
+
+    # shard output is bin-ordered; compare full per-row records keyed by
+    # name (start/cigar/bases/quals included so a positional or rewrite
+    # divergence in the sharded path cannot hide behind a weak key)
+    def keyed(ds, nb):
+        rows = []
+        for i, name in enumerate(ds.sidecar.names):
+            nc = int(nb.cigar_n[i])
+            rows.append((
+                name,
+                int(nb.flags[i]),
+                int(nb.start[i]),
+                tuple(nb.cigar_lens[i, :nc].tolist()),
+                tuple(nb.cigar_ops[i, :nc].tolist()),
+                nb.bases[i, : int(nb.lengths[i])].tobytes(),
+                int(nb.quals[i].sum()),
+            ))
+        return sorted(rows)
+
+    assert keyed(a, ba) == keyed(b, bb)
+
+
+def test_transform_shards_streaming_mutually_exclusive(ref_resources, tmp_path):
+    src = str(ref_resources / "bqsr1.sam")
+    out = str(tmp_path / "x.adam")
+    assert run_cli("transform", src, out, "-shards", "2", "-streaming") == 2

@@ -332,6 +332,10 @@ def test_mdtag_get_reference():
     assert tag.get_reference("ACGTGT", "4M2D2M") == "ACGTAAGT"
     tag = MdTag.parse("3A4", 0)
     assert tag.get_reference("ACGTACGT", "8M") == "ACGAACGT"
+    # corrupt alignment: CIGAR span overruns the read -> loud failure,
+    # not a silently truncated reference
+    with pytest.raises(IndexError):
+        MdTag.parse("12", 0).get_reference("ACGT", "12M")
 
 
 def test_mdtag_move_alignment():

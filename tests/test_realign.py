@@ -189,3 +189,45 @@ def test_shift_indel_declines_insertion_erasure():
     assert any(op == "I" for _, op in out), out
     assert sum(n for n, op in out if op in "MDN=X") == 95  # ref span kept
     assert ra.cigar_read_len(out) == ra.cigar_read_len(cigar)
+
+
+def test_sweep_bucket_shape_covers_all_offsets():
+    """Regression: lr rounding up past read_len must grow lc so every
+    reference sweep offset o < cons_len - read_len is representable
+    (read_len=100 -> lr=128 with cons_len=250 previously bucketed to
+    lc=256, losing offsets 129..149)."""
+    for read_len, cons_len in [(100, 250), (100, 101), (32, 33),
+                               (65, 300), (100, 3000), (150, 151)]:
+        lr, lc = ra.sweep_bucket_shape(read_len, cons_len)
+        assert lr >= read_len and lc >= cons_len
+        assert lc - lr + 1 >= cons_len - read_len, (read_len, cons_len)
+
+
+def test_sweep_kernel_finds_tail_offset_match():
+    """A perfect match planted past the old truncated offset range must
+    be found (advisor repro: read_len=100, cons_len=250, match at 140)."""
+    rng = np.random.default_rng(7)
+    read_len, cons_len, planted = 100, 250, 140
+    read = rng.integers(0, 4, read_len).astype(np.uint8)
+    cons = rng.integers(0, 4, cons_len).astype(np.uint8)
+    # make sure no accidental perfect match elsewhere, then plant one
+    cons[planted : planted + read_len] = read
+    lr, lc = ra.sweep_bucket_shape(read_len, cons_len)
+    assert lc - lr + 1 > planted
+
+    import jax.numpy as jnp
+
+    rc = np.full((1, lr), schema.BASE_PAD, np.uint8)
+    rq = np.zeros((1, lr), np.uint8)
+    rc[0, :read_len] = read
+    rq[0, :read_len] = 30
+    ct = np.full((1, lc), schema.BASE_PAD, np.uint8)
+    ct[0, :cons_len] = cons
+    best_q, best_o = ra.sweep_kernel(
+        jnp.asarray(rc), jnp.asarray(rq),
+        jnp.asarray(np.array([read_len], np.int32)),
+        jnp.asarray(ct), jnp.asarray(np.array([cons_len], np.int32)),
+        lr, lc,
+    )
+    assert int(best_o[0]) == planted
+    assert float(best_q[0]) == 0.0
