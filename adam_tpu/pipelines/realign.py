@@ -30,6 +30,7 @@ Faithful semantics of the reference's ``rdd/read/realignment/`` +
 from __future__ import annotations
 
 import logging
+import os
 import random
 from dataclasses import dataclass, replace as dc_replace
 from functools import partial
@@ -462,6 +463,74 @@ def sweep_bucket_shape(read_len: int, cons_len: int) -> tuple[int, int]:
     return lr, lc
 
 
+@partial(jax.jit, static_argnames=("off", "rt", "lr"))
+def sweep_gemm_kernel(bases, quals, lengths, pair_reads, pair_rmask,
+                      cons, cons_len, off: int, rt: int, lr: int):
+    """MXU-shaped sweep: batched GEMM over (target, consensus) pairs.
+
+    Same math as :func:`sweep_kernel` — mismatchQual(b, o) = totalQual -
+    one-hot match correlation, offsets ``o < cons_len - read_len`` — but
+    laid out as ``[P, rt, lr*6] x [P, lr*6, off]`` batched matmuls so the
+    contraction runs on the MXU instead of a degenerate 6-channel conv
+    (measured ~9 GFLOP/s on the conv formulation vs matmul peak).  All
+    values are integers: bf16 inputs are exact (quals <= 93 need 7
+    mantissa bits), the MXU accumulates in f32 (exact to 2^24), so
+    results are bit-identical to the f32 conv path.
+
+    ``bases/quals/lengths`` are the device-resident candidate columns;
+    ``pair_reads [P, rt]`` indexes up to ``rt`` reads of one target that
+    all sweep against ``cons [P, lc]`` (``lc = off + lr``).  Padded rows
+    have ``pair_rmask`` False; padded pairs have ``cons_len`` 0.
+    Returns (best_q f32[P, rt], best_o i32[P, rt])."""
+    L = bases.shape[1]
+    P = pair_reads.shape[0]
+    rc = bases[pair_reads]        # [P, rt, L]
+    q = quals[pair_reads]
+    rl = lengths[pair_reads]      # [P, rt]
+    pos = jnp.arange(L)
+    qf = jnp.where(
+        (pos[None, None, :] < rl[..., None]) & pair_rmask[..., None], q, 0
+    ).astype(jnp.int32)
+    if lr > L:
+        rc = jnp.pad(rc, ((0, 0), (0, 0), (0, lr - L)),
+                     constant_values=schema.BASE_PAD)
+        qf = jnp.pad(qf, ((0, 0), (0, 0), (0, lr - L)))
+    elif lr < L:
+        # batch lanes wider than the longest read present (windowed or
+        # concat-widened batches): lanes beyond lr are PAD with qf 0
+        rc = rc[..., :lr]
+        qf = qf[..., :lr]
+    A = (
+        jax.nn.one_hot(rc, 6, dtype=jnp.bfloat16)
+        * qf[..., None].astype(jnp.bfloat16)
+    ).reshape(P, rt, lr * 6)
+    oh = jax.nn.one_hot(cons, 6, dtype=jnp.bfloat16)       # [P, lc, 6]
+    idx = jnp.arange(lr)[:, None] + jnp.arange(off)[None, :]
+    B = oh[:, idx, :]                                      # [P, lr, off, 6]
+    B = B.transpose(0, 1, 3, 2).reshape(P, lr * 6, off)
+    match = jnp.einsum(
+        "prk,pko->pro", A, B, preferred_element_type=jnp.float32
+    )
+    total_q = qf.sum(-1)[..., None].astype(jnp.float32)    # [P, rt, 1]
+    mismatch = total_q - match
+    valid = (
+        jnp.arange(off)[None, None, :]
+        < (cons_len[:, None] - rl)[..., None]
+    )
+    masked = jnp.where(valid, mismatch, jnp.inf)
+    best_o = jnp.argmin(masked, -1).astype(jnp.int32)
+    best_q = masked.min(-1)
+    has = valid.any(-1)
+    return jnp.where(has, best_q, jnp.inf), jnp.where(has, best_o, -1)
+
+
+# pair-batch size per (off, rt) tier: bounds the im2col temporary
+# [P, lr, off, 6] bf16 while keeping ~4k tasks per dispatch
+def _sweep_gemm_P(off: int, rt: int) -> int:
+    base = max(8, (1 << 17) // off)  # 256 at off=512, halving upward
+    return max(2, base // (rt // 16)) if rt > 16 else base
+
+
 @partial(jax.jit, static_argnames=("lr", "lc"))
 def sweep_kernel_gather(read_codes, read_quals, read_len, cons_tbl,
                         clen_tbl, cons_idx, lr: int, lc: int):
@@ -521,6 +590,33 @@ def sweep_kernel(read_codes, read_quals, read_len, cons_codes, cons_len,
         jnp.where(has_any, best_q, jnp.inf),
         jnp.where(has_any, best_off, -1),
     )
+
+
+def _group_candidates(b, tidx, mapped):
+    """Candidate rows grouped by target, position-sorted within a group
+    (the reference sorts the RDD before target mapping).
+
+    Returns ``(srows, goff, gtid)``: flat row indices, group offsets
+    (``goff[g]:goff[g+1]`` slices ``srows``), and the target id per
+    group.  Shared by the Python and native paths — group iteration
+    order drives the rng.sample call sequence, so both paths MUST use
+    this exact construction for bit-identical output."""
+    sel = np.flatnonzero(mapped & (tidx >= 0))
+    if not len(sel):
+        z = np.zeros(0, np.int64)
+        return z, np.zeros(1, np.int64), z
+    order = np.lexsort(
+        (sel, np.asarray(b.start)[sel].astype(np.int64), tidx[sel])
+    )
+    srows = sel[order]
+    stid = tidx[srows]
+    bounds = np.flatnonzero(np.diff(stid) != 0) + 1
+    goff = np.concatenate(
+        [np.zeros(1, np.int64), bounds.astype(np.int64),
+         np.array([len(srows)], np.int64)]
+    )
+    gtid = stid[goff[:-1]].astype(np.int64)
+    return srows, goff, gtid
 
 
 def _sum_mismatch_quality(seq: str, ref: str, quals) -> int:
@@ -653,6 +749,42 @@ def realign_indels(
     rng: Optional[random.Random] = None,
     target_mapping: str = "overlap",
 ) -> AlignmentDataset:
+    """GATK-style local realignment (RealignIndels.scala:235-387).
+
+    Dispatches to the native-prep path (C++ per-read string walks +
+    vectorized sweep dispatch, native/realign.cpp) when available; the
+    pure-Python implementation below remains the semantic oracle (the
+    two are differentially tested) and the fallback for the
+    ``smithwaterman`` consensus model and native-less installs."""
+    if consensus_model != "smithwaterman" and os.environ.get(
+        "ADAM_TPU_REALIGN", ""
+    ) != "py":
+        out = _realign_indels_native(
+            ds, consensus_model, known_indels, max_indel_size,
+            max_consensus_number, lod_threshold, max_target_size, rng,
+            target_mapping,
+        )
+        if out is not None:
+            return out
+    return _realign_indels_py(
+        ds, consensus_model, known_indels, max_indel_size,
+        max_consensus_number, lod_threshold, max_target_size, sw_weights,
+        rng, target_mapping,
+    )
+
+
+def _realign_indels_py(
+    ds: AlignmentDataset,
+    consensus_model: str = "reads",
+    known_indels: Optional[IndelTable] = None,
+    max_indel_size: int = MAX_INDEL_SIZE,
+    max_consensus_number: int = MAX_CONSENSUS_NUMBER,
+    lod_threshold: float = LOD_THRESHOLD,
+    max_target_size: int = MAX_TARGET_SIZE,
+    sw_weights: tuple = (1.0, -0.333, -0.5, -0.5),
+    rng: Optional[random.Random] = None,
+    target_mapping: str = "overlap",
+) -> AlignmentDataset:
     b = ds.batch.to_numpy()
     n = b.n_rows
     if n == 0:
@@ -665,20 +797,13 @@ def realign_indels(
     mapped = ((flags & schema.FLAG_UNMAPPED) == 0) & np.asarray(b.valid)
     tidx = map_batch_to_targets(b, targets, names, mode=target_mapping)
 
-    # group rows by target, position-sorted within the group (the
-    # reference sorts the RDD before target mapping) — vectorized:
-    # lexsort then split at target boundaries, no per-read python loop
-    sel = np.flatnonzero(mapped & (tidx >= 0))
-    groups: dict[int, list[int]] = {}
-    if len(sel):
-        order = np.lexsort(
-            (sel, np.asarray(b.start)[sel].astype(np.int64), tidx[sel])
-        )
-        srows = sel[order]
-        stid = tidx[srows]
-        bounds = np.flatnonzero(np.diff(stid) != 0) + 1
-        for chunk in np.split(srows, bounds):
-            groups[int(tidx[chunk[0]])] = [int(i) for i in chunk]
+    # group rows by target, position-sorted within the group — the shared
+    # vectorized construction (see _group_candidates for why shared)
+    srows, goff, gtid = _group_candidates(b, tidx, mapped)
+    groups: dict[int, list[int]] = {
+        int(gtid[g]): [int(i) for i in srows[goff[g]:goff[g + 1]]]
+        for g in range(len(gtid))
+    }
 
     new_batch = jax.tree.map(np.array, b)  # writable copies
     side = ds.sidecar
@@ -1119,3 +1244,458 @@ def _write_back(new_batch, side, new_md, new_attrs, to_clean, realigned):
             new_batch.end[rr.row] = rr.end
         if rr.md is not None:
             new_md[rr.row] = rr.md.to_string()
+
+
+# --------------------------------------------------------------------------
+# Native-prep realignment path
+# --------------------------------------------------------------------------
+def _pow2_vec(n: np.ndarray, minimum: int) -> np.ndarray:
+    """Vectorized ``_pow2``: next power of two, floored at ``minimum``."""
+    table = np.int64(1) << np.arange(40, dtype=np.int64)
+    idx = np.searchsorted(table, np.maximum(np.asarray(n, np.int64), 1))
+    return np.maximum(table[idx], minimum)
+
+
+def _realign_indels_native(
+    ds: AlignmentDataset,
+    consensus_model: str,
+    known_indels: Optional[IndelTable],
+    max_indel_size: int,
+    max_consensus_number: int,
+    lod_threshold: float,
+    max_target_size: int,
+    rng: Optional[random.Random],
+    target_mapping: str,
+):
+    """Same decisions as :func:`_realign_indels_py`, with the per-read
+    host work (MD parse / reference rebuild / left-normalization /
+    consensus generation / MD rewrite) in C++ (native/realign.cpp) and
+    the sweep task machinery vectorized.  Returns None when the native
+    library is unavailable (caller falls back to the Python path)."""
+    from adam_tpu import native
+
+    if not native.available():
+        return None
+    b = ds.batch.to_numpy()
+    n = b.n_rows
+    if n == 0:
+        return ds
+    targets = find_targets(ds, max_target_size, max_indel_size)
+    if not targets:
+        return ds
+    names = ds.seq_dict.names
+    flags = np.asarray(b.flags)
+    mapped = ((flags & schema.FLAG_UNMAPPED) == 0) & np.asarray(b.valid)
+    tidx = map_batch_to_targets(b, targets, names, mode=target_mapping)
+    srows, goff, gtid = _group_candidates(b, tidx, mapped)
+    if not len(srows):
+        return ds
+    G = len(goff) - 1
+
+    from adam_tpu.formats.strings import StringColumn, with_overrides
+
+    side = ds.sidecar
+    md_col = StringColumn.of(side.md)
+    if len(md_col) >= n:
+        md_buf, md_off = md_col.buf, md_col.offsets[: n + 1]
+        md_valid = md_col.valid[:n] & np.asarray(b.valid)
+    else:
+        md_buf = np.zeros(0, np.uint8)
+        md_off = np.zeros(n + 1, np.int64)
+        md_valid = np.zeros(n, bool)
+
+    # consensuses come from the indel table only under the knowns model
+    # WITH a table; otherwise (reads model, or knowns without a table)
+    # they are generated from the reads, as the Python path's else-branch
+    # does (realign.py:994)
+    gen_consensus = not (
+        consensus_model == "knowns" and known_indels is not None
+    )
+    prep = native.realign_prep(
+        b, md_buf, md_off, md_valid.astype(np.uint8), srows, goff,
+        gen_consensus,
+    )
+    if prep is None:
+        return None
+
+    t_status = prep["t_status"]
+    t_ref_off = prep["t_ref_off"]
+    t_ref_start = prep["t_ref_start"]
+    t_ref_end = prep["t_ref_end"]
+    ref_all = prep["t_ref_buf"].tobytes().decode("ascii", "replace")
+    r_group = prep["r_group"]
+    r_row = prep["r_row"]
+    r_dirty = prep["r_dirty"].astype(bool)
+    r_md_set = prep["r_md_set"].astype(bool)
+    r_orig = prep["r_orig_qual"]
+    R = len(r_row)
+    rg_off = np.searchsorted(r_group, np.arange(G + 1))
+    c_group = prep["c_group"]
+    cg_off = np.searchsorted(c_group, np.arange(G + 1))
+    c_off = prep["c_seq_off"]
+    c_all = prep["c_seq_buf"].tobytes().decode("ascii", "replace")
+    c_is = prep["c_is"]
+    c_ie = prep["c_ie"]
+
+    rng = rng or random.Random(0)
+    lengths = np.asarray(b.lengths).astype(np.int64)
+    _log = logging.getLogger(__name__)
+
+    # ---- per-group consensus finalize (sampling order == Python path) --
+    # grp_cons[g] = list of (cons_str, index_start, index_end)
+    grp_cons: list = [None] * G
+    for g in range(G):
+        if t_status[g] != 0:
+            continue
+        if rg_off[g + 1] == rg_off[g]:
+            continue
+        if consensus_model == "knowns" and known_indels is not None:
+            from adam_tpu.models.positions import ReferenceRegion
+
+            region_name = names[targets[int(gtid[g])].contig_idx]
+            cons = [
+                (rec.consensus, rec.region.start, rec.region.end)
+                for rec in known_indels.get_indels_in_region(
+                    ReferenceRegion(
+                        region_name, int(t_ref_start[g]), int(t_ref_end[g])
+                    )
+                )
+            ]
+        else:
+            cons = [
+                (c_all[c_off[k]:c_off[k + 1]], int(c_is[k]), int(c_ie[k]))
+                for k in range(cg_off[g], cg_off[g + 1])
+            ]
+        # distinct (native path pre-dedupes the reads model; the knowns
+        # model and the Python path share this exact dedup)
+        seen = set()
+        uniq = []
+        for c in cons:
+            if c not in seen:
+                seen.add(c)
+                uniq.append(c)
+        cons = uniq
+        if len(cons) > max_consensus_number:
+            # random.sample on an index range picks the same positions
+            # as sampling the list itself, preserving rng-state parity
+            cons = [cons[j] for j in
+                    rng.sample(range(len(cons)), max_consensus_number)]
+        grp_cons[g] = cons
+
+    # ---- build the spliced consensus sequences + (target, cons) pairs --
+    # each pair tile sweeps <= rt reads of one target against one
+    # consensus; tiles group by (off, rt) into fixed-shape GEMM batches
+    cons_strs: list = []   # spliced full sequences, global ids
+    grp_cons_base = np.zeros(G + 1, np.int64)
+    for g in range(G):
+        cons = grp_cons[g]
+        grp_cons_base[g + 1] = grp_cons_base[g] + (len(cons) if cons else 0)
+        if not cons:
+            continue
+        ref_start = int(t_ref_start[g])
+        ref_end = int(t_ref_end[g])
+        reference = ref_all[t_ref_off[g]:t_ref_off[g + 1]]
+        for cs, cis, cie in cons:
+            # Consensus.insert_into_reference (realign.py:612-620)
+            if (cis < ref_start or cis > ref_end
+                    or cie - 1 < ref_start or cie - 1 > ref_end):
+                raise ValueError("consensus and reference do not overlap")
+            cons_strs.append(
+                reference[: cis - ref_start] + cs
+                + reference[cie - 1 - ref_start:]
+            )
+
+    # flat result layout: per group, ci-major [nc, nr]
+    grp_task_base = np.zeros(G + 1, np.int64)
+    for g in range(G):
+        nr = int(rg_off[g + 1] - rg_off[g])
+        nc = int(grp_cons_base[g + 1] - grp_cons_base[g])
+        grp_task_base[g + 1] = grp_task_base[g] + nr * nc
+    NT = int(grp_task_base[G])
+    res_q = np.full(NT, np.inf, np.float32)
+    res_o = np.full(NT, -1, np.int32)
+    if NT:
+        cons_lens = np.array([len(s) for s in cons_strs], np.int64)
+        max_cl = int(cons_lens.max()) if len(cons_strs) else 1
+        cons_mat = np.full((len(cons_strs), max_cl), schema.BASE_PAD, np.uint8)
+        for k, s in enumerate(cons_strs):
+            cons_mat[k, : len(s)] = schema.encode_bases(s)
+
+        # pair tiles: rt=16 for small targets, 128-read tiles for large
+        p_res, p_n, p_cid, p_lo, p_off = [], [], [], [], []
+        for g in range(G):
+            cons = grp_cons[g]
+            if not cons:
+                continue
+            nr = int(rg_off[g + 1] - rg_off[g])
+            rl_g = lengths[r_row[rg_off[g]:rg_off[g + 1]]]
+            for ci in range(len(cons)):
+                cid = int(grp_cons_base[g]) + ci
+                clen = int(cons_lens[cid])
+                base = int(grp_task_base[g]) + ci * nr
+                for lo in range(0, nr, 128):
+                    nrt = min(128, nr - lo)
+                    need = clen - int(rl_g[lo:lo + nrt].min())
+                    p_res.append(base + lo)
+                    p_n.append(nrt)
+                    p_cid.append(cid)
+                    p_lo.append(int(rg_off[g]) + lo)
+                    p_off.append(max(need, 1))
+        p_res = np.asarray(p_res, np.int64)
+        p_n = np.asarray(p_n, np.int32)
+        p_cid = np.asarray(p_cid, np.int64)
+        p_lo = np.asarray(p_lo, np.int64)
+        p_rt = np.where(p_n <= 16, 16, 128).astype(np.int32)
+        p_offb = _pow2_vec(p_off, 512).astype(np.int64)
+
+        bases_np = np.asarray(b.bases)
+        quals_np = np.asarray(b.quals)
+        L = bases_np.shape[1]
+        lr = int(_pow2_vec(np.array([max(int(lengths.max()), 1)]), 32)[0])
+        n_pad = int(_pow2_vec(np.array([b.n_rows]), 1024)[0])
+        bases_dev = jnp.asarray(
+            np.pad(bases_np, ((0, n_pad - b.n_rows), (0, 0)),
+                   constant_values=schema.BASE_PAD)
+        )
+        quals_dev = jnp.asarray(
+            np.pad(quals_np, ((0, n_pad - b.n_rows), (0, 0)))
+        )
+        lens_dev = jnp.asarray(
+            np.pad(lengths.astype(np.int32), (0, n_pad - b.n_rows))
+        )
+
+        # rows into the flat to_clean read index -> batch row, as i32
+        r_row32 = r_row.astype(np.int32)
+        pending = []  # (pair slice indices, n per pair, res bases, out)
+        key = p_offb * 1024 + p_rt
+        border = np.argsort(key, kind="stable")
+        ukeys, ustarts = np.unique(key[border], return_index=True)
+        ustarts = np.append(ustarts, len(border))
+        for u in range(len(ukeys)):
+            seg = border[ustarts[u]:ustarts[u + 1]]
+            off = int(ukeys[u] // 1024)
+            rt = int(ukeys[u] % 1024)
+            P = _sweep_gemm_P(off, rt)
+            lc = off + lr
+            for s in range(0, len(seg), P):
+                part = seg[s:s + P]
+                pr = np.zeros((P, rt), np.int32)
+                pm = np.zeros((P, rt), bool)
+                ct = np.full((P, lc), schema.BASE_PAD, np.uint8)
+                cl = np.zeros(P, np.int32)
+                for j, pi in enumerate(part):
+                    nrt = int(p_n[pi])
+                    lo = int(p_lo[pi])
+                    pr[j, :nrt] = r_row32[lo:lo + nrt]
+                    pm[j, :nrt] = True
+                    cid = int(p_cid[pi])
+                    cc = min(int(cons_lens[cid]), lc)
+                    ct[j, :cc] = cons_mat[cid, :cc]
+                    cl[j] = cons_lens[cid]
+                pending.append((part, sweep_gemm_kernel(
+                    bases_dev, quals_dev, lens_dev,
+                    jnp.asarray(pr), jnp.asarray(pm),
+                    jnp.asarray(ct), jnp.asarray(cl), off, rt, lr,
+                )))
+
+        if pending:
+            # one fused fetch: per-chunk fetches each pay a tunnel
+            # round trip on the time-sliced chip
+            all_q = np.asarray(
+                jnp.concatenate([o[0].reshape(-1) for _, o in pending])
+            )
+            all_o = np.asarray(
+                jnp.concatenate([o[1].reshape(-1) for _, o in pending])
+            )
+            pos = 0
+            for part, out in pending:
+                Pc, rtc = out[0].shape
+                q2 = all_q[pos: pos + Pc * rtc].reshape(Pc, rtc)
+                o2 = all_o[pos: pos + Pc * rtc].reshape(Pc, rtc)
+                pos += Pc * rtc
+                for j, pi in enumerate(part):
+                    nrt = int(p_n[pi])
+                    rb = int(p_res[pi])
+                    res_q[rb:rb + nrt] = q2[j, :nrt]
+                    res_o[rb:rb + nrt] = o2[j, :nrt]
+
+    # ---- scoring + rewrite decisions (numpy, one pass per group) -------
+    new_batch = jax.tree.map(np.array, b)
+    new_md: dict[int, Optional[str]] = {}
+    new_attrs: dict[int, str] = {}
+    cmax = new_batch.cmax
+
+    # realigned-read accumulators (one native MD-move call at the end)
+    ra_rows, ra_g, ra_off, ra_head, ra_midl, ra_mido, ra_end = (
+        [], [], [], [], [], [], [])
+    ra_start, ra_newend = [], []
+    realigned_mask = np.zeros(R, bool)
+
+    for g in range(G):
+        cons = grp_cons[g]
+        if not cons:
+            continue
+        nr = int(rg_off[g + 1] - rg_off[g])
+        nc = len(cons)
+        sl = slice(int(grp_task_base[g]), int(grp_task_base[g + 1]))
+        # ci-major flat -> [nr, nc]
+        q = res_q[sl].reshape(nc, nr).T
+        o = res_o[sl].reshape(nc, nr).T
+        orig = r_orig[rg_off[g]:rg_off[g + 1]].astype(np.int64)
+        pre_total = int(orig.sum())
+        use = q < orig[:, None]
+        qi = np.zeros_like(q, dtype=np.int64)
+        qi[use] = q[use].astype(np.int64)
+        contrib = np.where(use, qi, orig[:, None])
+        totals = contrib.sum(axis=0)
+        best_ci = int(nc - 1 - np.argmin(totals[::-1]))
+        best_total = int(totals[best_ci])
+        lod = (pre_total - best_total) / 10.0
+        ref_start = int(t_ref_start[g])
+        ref_len = int(t_ref_off[g + 1] - t_ref_off[g])
+        _log.debug(
+            "On target %d [%d, %d), before realignment, sum was %d; "
+            "best consensus %d has sum %d (LOD %.2f)",
+            int(gtid[g]), ref_start, ref_start + ref_len, pre_total,
+            best_ci, best_total, lod,
+        )
+        if lod <= lod_threshold:
+            continue
+        cons_str, cis, cie = cons[best_ci]
+        best_map = np.where(use[:, best_ci], o[:, best_ci], -1)
+        okm = best_map >= 0
+        if not okm.any():
+            continue
+        ridx = np.flatnonzero(okm) + int(rg_off[g])
+        om = best_map[okm].astype(np.int64)
+        rows_g = r_row[ridx]
+        Lr = lengths[rows_g]
+        new_start = ref_start + om
+        if cis == cie - 1:  # insertion
+            id_len = len(cons_str)
+            id_op = ord("I")
+            end_len = Lr - id_len - (cis - new_start)
+            end_pen = -id_len
+        else:  # deletion
+            id_len = cie - 1 - cis
+            id_op = ord("D")
+            end_len = Lr - (cis - new_start)
+            end_pen = len(cons_str)
+        head_len = cis - new_start
+        three = (head_len > 0) & (end_len > 0)
+        new_end = np.where(three, new_start + Lr + end_pen, new_start + Lr)
+        keep = om + (new_end - new_start) <= ref_len
+        if not keep.any():
+            continue
+        k = np.flatnonzero(keep)
+        realigned_mask[ridx[k]] = True
+        ra_rows.append(rows_g[k])
+        ra_g.append(np.full(len(k), g, np.int32))
+        ra_off.append(om[k])
+        ra_head.append(np.where(three[k], head_len[k], Lr[k]).astype(np.int32))
+        ra_midl.append(np.where(three[k], id_len, 0).astype(np.int32))
+        ra_mido.append(np.where(three[k], id_op, 0).astype(np.uint8))
+        ra_end.append(np.where(three[k], end_len[k], 0).astype(np.int32))
+        ra_start.append(new_start[k])
+        ra_newend.append(new_end[k])
+
+    # ---- write back: realigned rows ------------------------------------
+    if ra_rows:
+        rows_a = np.concatenate(ra_rows)
+        g_a = np.concatenate(ra_g)
+        off_a = np.concatenate(ra_off)
+        head_a = np.concatenate(ra_head)
+        midl_a = np.concatenate(ra_midl)
+        mido_a = np.concatenate(ra_mido)
+        end_a = np.concatenate(ra_end)
+        start_a = np.concatenate(ra_start)
+        newend_a = np.concatenate(ra_newend)
+        moved = native.md_move_batch(
+            b, rows_a, prep["t_ref_buf"], t_ref_off, g_a, off_a,
+            head_a, midl_a, mido_a, end_a, start_a,
+        )
+        if moved is None:
+            return None
+        mbuf, moff = moved
+        mstr = mbuf.tobytes().decode("ascii")
+
+        three_a = mido_a != 0
+        if three_a.any() and cmax < 3:
+            raise ValueError("realigned cigar exceeds batch cmax")
+        # OC/OP provenance from the pre-realignment columns
+        oc = native.cigar_strings(
+            np.asarray(b.cigar_ops)[rows_a],
+            np.asarray(b.cigar_lens)[rows_a],
+            np.asarray(b.cigar_n)[rows_a],
+        )
+        if oc is not None:
+            oc_buf, oc_off = oc
+            oc_all = oc_buf.tobytes().decode("ascii")
+            old_cigs = [
+                oc_all[oc_off[k]:oc_off[k + 1]] for k in range(len(rows_a))
+            ]
+        else:
+            old_cigs = [
+                schema.decode_cigar(
+                    np.asarray(b.cigar_ops)[r], np.asarray(b.cigar_lens)[r],
+                    int(np.asarray(b.cigar_n)[r]),
+                )
+                for r in rows_a
+            ]
+        attrs_col = StringColumn.of(side.attrs)
+        old_starts = np.asarray(b.start)[rows_a]
+        for k, row in enumerate(rows_a):
+            row = int(row)
+            tag = f"OC:Z:{old_cigs[k]}\tOP:i:{int(old_starts[k]) + 1}"
+            cur = attrs_col[row] or ""
+            new_attrs[row] = cur + "\t" + tag if cur else tag
+            new_md[row] = mstr[moff[k]:moff[k + 1]]
+        ops_new = np.zeros((len(rows_a), cmax), np.uint8)
+        ops_new[:] = schema.CIGAR_PAD
+        lens_new = np.zeros((len(rows_a), cmax), np.int32)
+        ncig_new = np.where(three_a, 3, 1).astype(np.int32)
+        ops_new[:, 0] = schema.CIGAR_M
+        lens_new[:, 0] = head_a
+        if three_a.any() and cmax >= 3:
+            ops_new[three_a, 1] = np.where(
+                mido_a[three_a] == ord("I"), schema.CIGAR_I, schema.CIGAR_D
+            )
+            lens_new[three_a, 1] = midl_a[three_a]
+            ops_new[three_a, 2] = schema.CIGAR_M
+            lens_new[three_a, 2] = end_a[three_a]
+        new_batch.cigar_ops[rows_a] = ops_new
+        new_batch.cigar_lens[rows_a] = lens_new
+        new_batch.cigar_n[rows_a] = ncig_new
+        new_batch.start[rows_a] = start_a
+        new_batch.end[rows_a] = newend_a
+        new_batch.mapq[rows_a] = np.asarray(b.mapq)[rows_a] + 10
+
+    # ---- write back: dirty (left-normalized) non-realigned rows --------
+    dirty_idx = np.flatnonzero(r_dirty & ~realigned_mask)
+    if len(dirty_idx):
+        cig_off = prep["r_cigar_off"]
+        cig_all = prep["r_cigar_buf"].tobytes().decode("ascii")
+        md_off2 = prep["r_md_off"]
+        md_all = prep["r_md_buf"].tobytes().decode("ascii")
+        for i in dirty_idx:
+            row = int(r_row[i])
+            cig = cig_all[cig_off[i]:cig_off[i + 1]]
+            elems = parse_cigar(cig)
+            ops, lens_, ncig = schema.encode_cigar(cig, max(cmax, len(elems)))
+            if ncig > cmax:
+                raise ValueError("realigned cigar exceeds batch cmax")
+            new_batch.cigar_ops[row] = ops[:cmax]
+            new_batch.cigar_lens[row] = lens_[:cmax]
+            new_batch.cigar_n[row] = ncig
+            new_batch.end[row] = int(new_batch.start[row]) + cigar_ref_len(
+                elems
+            )
+            if r_md_set[i]:
+                new_md[row] = md_all[md_off2[i]:md_off2[i + 1]]
+
+    new_side = dc_replace(
+        side,
+        md=with_overrides(StringColumn.of(side.md), new_md),
+        attrs=with_overrides(StringColumn.of(side.attrs), new_attrs),
+    )
+    return ds.with_batch(new_batch, new_side)

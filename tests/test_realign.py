@@ -231,3 +231,116 @@ def test_sweep_kernel_finds_tail_offset_match():
     )
     assert int(best_o[0]) == planted
     assert float(best_q[0]) == 0.0
+
+
+def test_native_realign_matches_python_oracle(tmp_path):
+    """The native-prep path (C++ realign.cpp + GEMM sweep) must be
+    bit-identical to the pure-Python oracle on WGS-shaped data with
+    planted indels: columns, MD strings, and OC/OP attrs all compared."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+    )
+    from make_wgs_sam import make_wgs
+
+    path = str(tmp_path / "in.sam")
+    make_wgs(path, 4096, 100, n_contigs=2, contig_len=30_000)
+    ds = load_alignments(path)
+    out_n = ra._realign_indels_native(
+        ds, "reads", None, ra.MAX_INDEL_SIZE, ra.MAX_CONSENSUS_NUMBER,
+        ra.LOD_THRESHOLD, ra.MAX_TARGET_SIZE, None, "overlap",
+    )
+    if out_n is None:
+        pytest.skip("native library unavailable")
+    out_p = ra._realign_indels_py(ds)
+    bn, bp = out_n.batch.to_numpy(), out_p.batch.to_numpy()
+    for f in ("start", "end", "mapq", "cigar_n", "flags"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(bn, f)), np.asarray(getattr(bp, f)), err_msg=f
+        )
+    np.testing.assert_array_equal(
+        np.asarray(bn.cigar_ops), np.asarray(bp.cigar_ops)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bn.cigar_lens), np.asarray(bp.cigar_lens)
+    )
+    assert [out_n.sidecar.md[i] for i in range(len(ds))] == [
+        out_p.sidecar.md[i] for i in range(len(ds))
+    ]
+    assert [out_n.sidecar.attrs[i] for i in range(len(ds))] == [
+        out_p.sidecar.attrs[i] for i in range(len(ds))
+    ]
+
+
+def test_native_realign_knowns_without_table_matches_oracle(ref_resources):
+    """consensus_model='knowns' with no indel table falls back to
+    read-generated consensuses in BOTH paths (the Python else-branch)."""
+    ds = load_alignments(str(ref_resources / "artificial.sam"))
+    out_n = ra._realign_indels_native(
+        ds, "knowns", None, ra.MAX_INDEL_SIZE, ra.MAX_CONSENSUS_NUMBER,
+        ra.LOD_THRESHOLD, ra.MAX_TARGET_SIZE, None, "overlap",
+    )
+    if out_n is None:
+        pytest.skip("native library unavailable")
+    out_p = ra._realign_indels_py(ds, consensus_model="knowns")
+    bn, bp = out_n.batch.to_numpy(), out_p.batch.to_numpy()
+    np.testing.assert_array_equal(np.asarray(bn.start), np.asarray(bp.start))
+    np.testing.assert_array_equal(
+        np.asarray(bn.cigar_lens), np.asarray(bp.cigar_lens)
+    )
+    # the fallback actually realigns (not a no-op pass-through)
+    assert not np.array_equal(
+        np.asarray(bn.start), np.asarray(ds.batch.to_numpy().start)
+    )
+
+
+def test_sweep_gemm_kernel_wide_lanes():
+    """Batch lane width L may exceed the lr bucket (windowed or concat-
+    widened batches); the kernel slices instead of crashing, and results
+    match the scan kernel."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    L, lr, off = 160, 128, 512
+    n, read_len, cons_len = 4, 100, 300
+    bases = np.full((n, L), schema.BASE_PAD, np.uint8)
+    quals = np.zeros((n, L), np.uint8)
+    lens = np.full(n, read_len, np.int32)
+    cons = rng.integers(0, 4, cons_len).astype(np.uint8)
+    planted = 150
+    for i in range(n):
+        r = rng.integers(0, 4, read_len).astype(np.uint8)
+        if i == 0:
+            r = cons[planted:planted + read_len]
+        bases[i, :read_len] = r
+        quals[i, :read_len] = 30
+    ct = np.full((1, off + lr), schema.BASE_PAD, np.uint8)
+    ct[0, :cons_len] = cons
+    pr = np.zeros((1, 16), np.int32)
+    pr[0, :n] = np.arange(n)
+    pm = np.zeros((1, 16), bool)
+    pm[0, :n] = True
+    bq, bo = ra.sweep_gemm_kernel(
+        jnp.asarray(bases), jnp.asarray(quals), jnp.asarray(lens),
+        jnp.asarray(pr), jnp.asarray(pm),
+        jnp.asarray(ct), jnp.asarray(np.array([cons_len], np.int32)),
+        off, 16, lr,
+    )
+    assert int(bo[0, 0]) == planted and float(bq[0, 0]) == 0.0
+    # cross-check row 1 against the scan kernel
+    lr2, lc2 = ra.sweep_bucket_shape(read_len, cons_len)
+    rc = np.full((1, lr2), schema.BASE_PAD, np.uint8)
+    rc[0, :read_len] = bases[1, :read_len]
+    rq = np.zeros((1, lr2), np.uint8)
+    rq[0, :read_len] = 30
+    ct2 = np.full((1, lc2), schema.BASE_PAD, np.uint8)
+    ct2[0, :cons_len] = cons
+    sq, so = ra.sweep_kernel(
+        jnp.asarray(rc), jnp.asarray(rq),
+        jnp.asarray(np.array([read_len], np.int32)),
+        jnp.asarray(ct2), jnp.asarray(np.array([cons_len], np.int32)),
+        lr2, lc2,
+    )
+    assert float(sq[0]) == float(bq[0, 1]) and int(so[0]) == int(bo[0, 1])
