@@ -148,6 +148,7 @@ def _lib() -> Optional[ct.CDLL]:
                 _u8p, _i32p, _i32p, ct.c_int64,
                 _i32p, _i64p, _i64p, ct.c_int64,
                 _u8p, _u8p, _u8p,
+                _u8p, _i64p,
                 ct.c_int64, ct.c_int64, ct.c_int32, ct.c_int64,
                 _i64p, _i64p, ct.c_int,
             ]
@@ -728,13 +729,18 @@ def bqsr_apply(bases, quals, lengths, flags, rg_idx, has_qual, valid,
 def bqsr_observe(bases, quals, lengths, flags, rg_idx,
                  cigar_ops, cigar_lens, cigar_n,
                  residue_ok, is_mm, read_ok, n_rg: int, gl: int,
-                 contig_idx=None, start=None, snp_keys=None):
+                 contig_idx=None, start=None, snp_keys=None,
+                 md_buf=None, md_off=None):
     """Threaded host covariate histogram -> (total, mism) i64 arrays of
     shape [n_rg, 94, 2*gl+1, 17]; None if native unavailable.
 
     ``residue_ok`` may be None: the aligned/q>0/base<4 residue filter is
     then derived from the cigar columns inside the kernel, so no [N, L]
-    mask ever materializes.  Known-SNP masking likewise runs in-kernel:
+    mask ever materializes.  ``is_mm`` may also be None when
+    ``md_buf``/``md_off`` (the sidecar MD string column) are given: the
+    kernel parses each read's MD inline during the same walk instead of
+    consuming a host-tokenized [N, L] mismatch mask.  Known-SNP masking
+    likewise runs in-kernel:
     pass ``contig_idx``/``start`` plus ``snp_keys`` (sorted i64
     ``contig << 40 | pos`` site keys) and masked residues are skipped
     during the same cigar walk — no host-side [N, L] position matrix."""
@@ -768,6 +774,19 @@ def bqsr_observe(bases, quals, lengths, flags, rg_idx,
         st_ptr = ct.cast(None, _i64p)
         sk_ptr = ct.cast(None, _i64p)
         n_snps = 0
+    if is_mm is not None:
+        mm_arr = np.ascontiguousarray(is_mm, np.uint8).reshape(-1)
+        mm_ptr = _u8_ptr(mm_arr)
+        mdb_ptr = ct.cast(None, _u8p)
+        mdo_ptr = ct.cast(None, _i64p)
+    else:
+        if md_buf is None or md_off is None:
+            return None
+        mdb_arr = np.ascontiguousarray(md_buf, np.uint8)
+        mdo_arr = np.ascontiguousarray(md_off, np.int64)
+        mm_ptr = ct.cast(None, _u8p)
+        mdb_ptr = _u8_ptr(mdb_arr)
+        mdo_ptr = mdo_arr.ctypes.data_as(_i64p)
     lib.bqsr_observe(
         _u8_ptr(bases.reshape(-1)), _u8_ptr(quals.reshape(-1)),
         np.ascontiguousarray(lengths, np.int32).ctypes.data_as(_i32p),
@@ -779,8 +798,9 @@ def bqsr_observe(bases, quals, lengths, flags, rg_idx,
         ct.c_int64(cmax),
         ci_ptr, st_ptr, sk_ptr, ct.c_int64(n_snps),
         rok_ptr,
-        _u8_ptr(np.ascontiguousarray(is_mm, np.uint8).reshape(-1)),
+        mm_ptr,
         _u8_ptr(np.ascontiguousarray(read_ok, np.uint8)),
+        mdb_ptr, mdo_ptr,
         ct.c_int64(n), ct.c_int64(lmax), ct.c_int32(n_rg), ct.c_int64(gl),
         total.ctypes.data_as(_i64p), mism.ctypes.data_as(_i64p),
         ct.c_int(_nthreads()),

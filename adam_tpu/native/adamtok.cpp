@@ -734,6 +734,9 @@ int adamtok_version() { return 5; }
 // snp_keys (may be null): sorted (contig << 40 | ref_pos) known-SNP site
 // keys; residues at those reference positions are skipped (the dbSNP
 // masking of BaseQualityRecalibration) without any [N, L] host mask.
+int64_t md_mismatch_offsets(const uint8_t* s, int64_t n, int64_t* out,
+                            int64_t cap);  // realign.cpp
+
 void bqsr_observe(
     const uint8_t* bases, const uint8_t* quals, const int32_t* lengths,
     const int32_t* flags, const int32_t* rg_idx,
@@ -742,6 +745,7 @@ void bqsr_observe(
     const int32_t* contig_idx, const int64_t* start,
     const int64_t* snp_keys, int64_t n_snps,
     const uint8_t* residue_ok, const uint8_t* is_mm, const uint8_t* read_ok,
+    const uint8_t* md_buf, const int64_t* md_off,
     int64_t N, int64_t lmax, int32_t n_rg, int64_t gl,
     int64_t* total, int64_t* mism, int nthreads) {
   static const uint8_t kComp[6] = {3, 2, 1, 0, 4, 5};
@@ -764,16 +768,23 @@ void bqsr_observe(
     auto& lm = loc_m[t];
     lt.assign(size_t(size), 0);
     lm.assign(size_t(size), 0);
-    // per-thread scratch: aligned-span flags + reference positions
+    // per-thread scratch: aligned-span flags + reference positions +
+    // inline-parsed MD mismatch offsets (is_mm == nullptr mode)
     std::vector<uint8_t> aligned(static_cast<size_t>(lmax), 0);
     std::vector<int64_t> refp(static_cast<size_t>(lmax), -1);
+    std::vector<int64_t> mm_ro(static_cast<size_t>(4 * lmax + 8), 0);
     const bool mask_snps = snp_keys && n_snps > 0;
     for (int64_t i = lo; i < hi; ++i) {
       if (!read_ok[i]) continue;
       const uint8_t* bs = bases + i * lmax;
       const uint8_t* q = quals + i * lmax;
       const uint8_t* rok = residue_ok ? residue_ok + i * lmax : nullptr;
-      const uint8_t* mm = is_mm + i * lmax;
+      const uint8_t* mm = is_mm ? is_mm + i * lmax : nullptr;
+      int64_t n_mm = 0, mp = 0;
+      if (!mm && md_buf && md_off)
+        n_mm = md_mismatch_offsets(md_buf + md_off[i],
+                                   md_off[i + 1] - md_off[i], mm_ro.data(),
+                                   int64_t(mm_ro.size()));
       int64_t L = lengths[i];
       int32_t fl = flags[i];
       bool rev = fl & 0x10;
@@ -781,7 +792,7 @@ void bqsr_observe(
       int64_t initial = rev ? (second ? -L : L) : (second ? -1 : 1);
       int64_t inc = rev ? (second ? 1 : -1) : (second ? -1 : 1);
       int32_t rg = rg_idx[i] >= 0 && rg_idx[i] < n_rg ? rg_idx[i] : n_rg - 1;
-      if (!rok) {
+      if (!rok || !mm) {
         // mark query positions consumed by reference-aligned ops (M/=/X),
         // recording each one's reference position for SNP masking
         static const uint8_t kQ[16] = {1, 1, 0, 0, 1, 0, 0, 1, 1,
@@ -843,7 +854,17 @@ void bqsr_observe(
         int64_t key =
             ((int64_t(rg) * kNQual + qi) * n_cyc + cyc) * kNDinuc + din;
         ++lt[size_t(key)];
-        if (mm[j]) ++lm[size_t(key)];
+        bool j_mm;
+        if (mm) {
+          j_mm = mm[j];
+        } else {
+          // merge inline-parsed MD mismatch offsets against the walk's
+          // ascending reference positions (both relative to start[i])
+          int64_t ro = refp[size_t(j)] - (start ? start[i] : 0);
+          while (mp < n_mm && mm_ro[size_t(mp)] < ro) ++mp;
+          j_mm = mp < n_mm && mm_ro[size_t(mp)] == ro;
+        }
+        if (j_mm) ++lm[size_t(key)];
       }
     }
   };

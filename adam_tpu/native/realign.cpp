@@ -515,6 +515,25 @@ int64_t sum_mismatch_quality(const std::string& seq, const std::string& ref,
 
 extern "C" {
 
+// MD-tag mismatch reference offsets (relative to the alignment start),
+// ascending.  Lenient: malformed MD yields however many offsets parsed
+// before the error (the vectorized tokenizer's tolerance).  Returns the
+// count written (capped at cap).  Shared with adamtok.cpp's BQSR
+// observe walk so the host never materializes [N, L] mismatch masks.
+int64_t md_mismatch_offsets(const uint8_t* s, int64_t n, int64_t* out,
+                            int64_t cap) {
+  // reusable parse scratch: this runs once per read inside the BQSR
+  // observe hot loop, so the Md vectors must not reallocate per call
+  thread_local Md md;
+  md_parse(s, n, 0, md);  // partial results kept on malformed input
+  int64_t k = 0;
+  for (const auto& p : md.mm) {
+    if (k >= cap) break;
+    out[k++] = p.first;
+  }
+  return k;
+}
+
 // Phase-1 prep over candidate target groups.  See realign.py phase 1.
 // Columns are the candidate batch's; groups are (grows flat rows, goff
 // offsets).  gen_consensus=0 for the "knowns" model.

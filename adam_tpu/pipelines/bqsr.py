@@ -230,7 +230,21 @@ def _observe_device(
     parallel/dist.distributed_observe)."""
     b = ds.batch.to_numpy()
     lmax = b.lmax
-    is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar, need_ref_codes=False)
+    from adam_tpu import native
+    from adam_tpu.formats.strings import StringColumn
+
+    n = b.n_rows
+    md_col = StringColumn.of(ds.sidecar.md)
+    use_native = native.available() and len(md_col) >= n
+    if use_native:
+        # the native walk parses each read's MD inline — no host-side
+        # [N, L] mismatch mask, no vectorized MD tokenize pass
+        is_mm = None
+        has_md = md_col.valid[:n] & np.asarray(b.valid)
+    else:
+        is_mm, _, has_md = batch_md_arrays(
+            ds.batch, ds.sidecar, need_ref_codes=False
+        )
 
     flags = np.asarray(b.flags)
     read_ok = (
@@ -255,8 +269,6 @@ def _observe_device(
     # cross-chip psum (parallel/dist.distributed_observe keeps it); with
     # one chip the threaded host histogram is exact and avoids shipping
     # [N, L] mask arrays to a possibly-throttled device.
-    from adam_tpu import native
-
     snp_active = known_snps is not None and len(known_snps)
     residue_ok = None
     snp_keys = None
@@ -292,10 +304,16 @@ def _observe_device(
         residue_ok & read_ok[:, None] if residue_ok is not None else None,
         is_mm, read_ok, n_rg, gl,
         contig_idx=b.contig_idx, start=b.start, snp_keys=snp_keys,
+        md_buf=md_col.buf if use_native else None,
+        md_off=md_col.offsets[: n + 1] if use_native else None,
     )
     if nat is not None:
         total, mism = nat  # host arrays: downstream table math stays host
     else:
+        if is_mm is None:
+            is_mm, _, _hm = batch_md_arrays(
+                ds.batch, ds.sidecar, need_ref_codes=False
+            )
         if residue_ok is None:
             residue_ok = _python_residue_mask()
         total, mism = observe_kernel(

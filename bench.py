@@ -84,6 +84,12 @@ def _warmup_compiles(known) -> None:
                     if n >= 270_000:
                         break
         os.replace(small + ".tmp", small)
+    # GEMM sweep tiers compile by (off, rt) only — warm them explicitly
+    # so the 1M run cannot hit a shape the 270k slice's consensus-length
+    # distribution happened to miss
+    from adam_tpu.pipelines import realign as _realign
+
+    _realign.warm_sweep_shapes()
     with tempfile.TemporaryDirectory() as td:
         transform_streamed(
             small, os.path.join(td, "w.adam"), known_snps=known

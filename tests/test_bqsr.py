@@ -153,3 +153,40 @@ def test_known_sites_native_masking_matches_python(ref_resources):
     # and masking actually removed observations vs the unmasked table
     unmasked = build_observation_table(ds)
     assert native_tab.total.sum() < unmasked.total.sum()
+
+
+def test_inline_md_observe_matches_tokenized_mask(ref_resources):
+    """The native walk's inline MD parse must produce the same histograms
+    as feeding it the host-tokenized [N, L] mismatch mask."""
+    from adam_tpu import native
+    from adam_tpu.formats.batch import grid_cols
+    from adam_tpu.ops.mdtag import batch_md_arrays
+    from adam_tpu.pipelines import bqsr as bq
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    ds = load_alignments(str(ref_resources / "bqsr1.sam"))
+    t1, m1, _, gl = bq._observe_device(ds, None)
+    b = ds.batch.to_numpy()
+    is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar,
+                                       need_ref_codes=False)
+    flags = np.asarray(b.flags)
+    read_ok = (
+        np.asarray(b.valid)
+        & ((flags & schema.FLAG_UNMAPPED) == 0)
+        & ((flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0)
+        & ((flags & schema.FLAG_DUPLICATE) == 0)
+        & ((flags & schema.FLAG_FAILED_QC) == 0)
+        & np.asarray(b.has_qual)
+        & (np.asarray(b.mapq) > 0)
+        & (np.asarray(b.mapq) != 255)
+        & has_md
+    )
+    t2, m2 = native.bqsr_observe(
+        b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
+        b.cigar_ops, b.cigar_lens, b.cigar_n, None, is_mm, read_ok,
+        len(ds.read_groups) + 1, grid_cols(b.lmax),
+        contig_idx=b.contig_idx, start=b.start,
+    )
+    np.testing.assert_array_equal(np.asarray(t1), t2)
+    np.testing.assert_array_equal(np.asarray(m1), m2)

@@ -296,51 +296,49 @@ def test_native_realign_knowns_without_table_matches_oracle(ref_resources):
     )
 
 
-def test_sweep_gemm_kernel_wide_lanes():
-    """Batch lane width L may exceed the lr bucket (windowed or concat-
-    widened batches); the kernel slices instead of crashing, and results
-    match the scan kernel."""
+def test_sweep_gemm_kernel_matches_scan_kernel():
+    """The GEMM sweep tier must reproduce the scan/conv kernel exactly
+    (planted perfect match found; random reads bit-identical)."""
     import jax.numpy as jnp
 
     rng = np.random.default_rng(3)
-    L, lr, off = 160, 128, 512
+    lr, off, rt = 128, 512, 16
     n, read_len, cons_len = 4, 100, 300
-    bases = np.full((n, L), schema.BASE_PAD, np.uint8)
-    quals = np.zeros((n, L), np.uint8)
-    lens = np.full(n, read_len, np.int32)
     cons = rng.integers(0, 4, cons_len).astype(np.uint8)
     planted = 150
+    rc = np.full((rt, lr), schema.BASE_PAD, np.uint8)
+    rq = np.zeros((rt, lr), np.uint8)
+    rl = np.zeros(rt, np.int32)
+    pm = np.zeros(rt, bool)
     for i in range(n):
         r = rng.integers(0, 4, read_len).astype(np.uint8)
         if i == 0:
             r = cons[planted:planted + read_len]
-        bases[i, :read_len] = r
-        quals[i, :read_len] = 30
+        rc[i, :read_len] = r
+        rq[i, :read_len] = 30
+        rl[i] = read_len
+        pm[i] = True
     ct = np.full((1, off + lr), schema.BASE_PAD, np.uint8)
     ct[0, :cons_len] = cons
-    pr = np.zeros((1, 16), np.int32)
-    pr[0, :n] = np.arange(n)
-    pm = np.zeros((1, 16), bool)
-    pm[0, :n] = True
     bq, bo = ra.sweep_gemm_kernel(
-        jnp.asarray(bases), jnp.asarray(quals), jnp.asarray(lens),
-        jnp.asarray(pr), jnp.asarray(pm),
+        jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl), jnp.asarray(pm),
         jnp.asarray(ct), jnp.asarray(np.array([cons_len], np.int32)),
-        off, 16, lr,
+        off, rt, lr,
     )
     assert int(bo[0, 0]) == planted and float(bq[0, 0]) == 0.0
-    # cross-check row 1 against the scan kernel
+    # cross-check every real row against the scan kernel
     lr2, lc2 = ra.sweep_bucket_shape(read_len, cons_len)
-    rc = np.full((1, lr2), schema.BASE_PAD, np.uint8)
-    rc[0, :read_len] = bases[1, :read_len]
-    rq = np.zeros((1, lr2), np.uint8)
-    rq[0, :read_len] = 30
-    ct2 = np.full((1, lc2), schema.BASE_PAD, np.uint8)
-    ct2[0, :cons_len] = cons
+    rc2 = np.full((n, lr2), schema.BASE_PAD, np.uint8)
+    rc2[:, :read_len] = rc[:n, :read_len]
+    rq2 = np.zeros((n, lr2), np.uint8)
+    rq2[:, :read_len] = 30
+    ct2 = np.full((n, lc2), schema.BASE_PAD, np.uint8)
+    ct2[:, :cons_len] = cons
     sq, so = ra.sweep_kernel(
-        jnp.asarray(rc), jnp.asarray(rq),
-        jnp.asarray(np.array([read_len], np.int32)),
-        jnp.asarray(ct2), jnp.asarray(np.array([cons_len], np.int32)),
+        jnp.asarray(rc2), jnp.asarray(rq2),
+        jnp.asarray(np.full(n, read_len, np.int32)),
+        jnp.asarray(ct2), jnp.asarray(np.full(n, cons_len, np.int32)),
         lr2, lc2,
     )
-    assert float(sq[0]) == float(bq[0, 1]) and int(so[0]) == int(bo[0, 1])
+    np.testing.assert_array_equal(np.asarray(sq), np.asarray(bq)[0, :n])
+    np.testing.assert_array_equal(np.asarray(so), np.asarray(bo)[0, :n])
