@@ -26,6 +26,7 @@ def shuffle_alignments_to_shards(
     n_shards: int,
     out_dir: str,
     compression: str = "snappy",
+    fmt: str = "parquet",
 ) -> list[str]:
     """Stream (batch, sidecar, header) triples into per-genome-bin shards.
 
@@ -33,19 +34,28 @@ def shuffle_alignments_to_shards(
     ``shard-unmapped.adam`` when unplaced reads exist). Constant memory:
     only one streamed batch is resident at a time; each shard grows by
     Parquet row groups.
+
+    ``fmt="raw"`` spills the framework's own columnar layout instead of
+    the Parquet interchange schema (``shard-*.arrows`` Arrow IPC; see
+    parallel/spill.py) — memcpy-speed writes/reads for intermediate
+    stores that only this framework re-reads.
     """
     import jax
     import pyarrow.parquet as pq
 
     from adam_tpu.io.parquet import to_arrow_alignments
+    from adam_tpu.parallel import spill
 
     os.makedirs(out_dir, exist_ok=True)
-    writers: dict[int, pq.ParquetWriter] = {}
+    writers: dict[int, object] = {}
     paths: dict[int, str] = {}
+    raw = fmt == "raw"
 
     def shard_path(s: int) -> str:
+        ext = "arrows" if raw else "adam"
         name = (
-            f"shard-{s:05d}.adam" if s < n_shards else "shard-unmapped.adam"
+            f"shard-{s:05d}.{ext}" if s < n_shards
+            else f"shard-unmapped.{ext}"
         )
         return os.path.join(out_dir, name)
 
@@ -70,8 +80,14 @@ def shuffle_alignments_to_shards(
                 rows = np.flatnonzero(valid & (part == s))
                 sub = jax.tree.map(lambda x: x[rows], b)
                 sub_side = side.take(rows)
-                table = to_arrow_alignments(sub, sub_side, header)
                 s = int(s)
+                if raw:
+                    if s not in writers:
+                        paths[s] = shard_path(s)
+                        writers[s] = spill.RawShardWriter(paths[s])
+                    writers[s].append(sub, sub_side, header)
+                    continue
+                table = to_arrow_alignments(sub, sub_side, header)
                 if s not in writers:
                     paths[s] = shard_path(s)
                     writers[s] = pq.ParquetWriter(
@@ -102,8 +118,15 @@ def shuffle_bam_to_shards(
 
 
 def iter_shards(paths: Iterable[str]) -> Iterator:
-    """Load shards one at a time -> (ReadBatch, ReadSidecar, SamHeader)."""
+    """Load shards one at a time -> (ReadBatch, ReadSidecar, SamHeader).
+
+    Dispatches on the shard format: ``.arrows`` raw columnar spill
+    (parallel/spill.py) or the Parquet interchange layout."""
     from adam_tpu.io.parquet import load_alignments
+    from adam_tpu.parallel import spill
 
     for p in paths:
-        yield load_alignments(p)
+        if str(p).endswith(".arrows"):
+            yield spill.read_raw_shard(p)
+        else:
+            yield load_alignments(p)

@@ -59,6 +59,8 @@ def transform_sharded(
     lod_threshold: float | None = None,
     max_target_size: int | None = None,
     dump_observations: str | None = None,
+    shard_fmt: str = "raw",
+    cache_bytes: int = 4 << 30,
 ) -> dict:
     from adam_tpu.io import context
     from adam_tpu.io.sam import iter_bam_batches, iter_sam_batches
@@ -93,7 +95,7 @@ def transform_sharded(
             else iter_sam_batches(p, batch_reads=batch_reads)
         )
         shard_paths = host_shuffle.shuffle_alignments_to_shards(
-            reader, n_shards, tmp, compression=compression
+            reader, n_shards, tmp, compression=compression, fmt=shard_fmt
         )
         stats["shuffle_s"] = time.perf_counter() - t
         if not shard_paths:
@@ -101,9 +103,44 @@ def transform_sharded(
             stats["total_s"] = time.perf_counter() - t_start
             return stats
 
-        def load(si: int) -> AlignmentDataset:
+        # bounded LRU shard cache: each pass re-reads its shards, so
+        # shards that fit the budget skip the decode on passes B/C (the
+        # Spark block-manager analog: cache when it fits, spill-backed
+        # always).  Out-of-core discipline is preserved — eviction keeps
+        # resident bytes under ``cache_bytes`` no matter the dataset.
+        from collections import OrderedDict
+
+        _cache: OrderedDict[int, tuple[AlignmentDataset, int]] = OrderedDict()
+        _cache_total = [0]
+
+        def _nbytes(ds: AlignmentDataset) -> int:
+            import jax
+
+            n = 0
+            for leaf in jax.tree.leaves(ds.batch):
+                n += getattr(leaf, "nbytes", 0)
+            for col in (ds.sidecar.names, ds.sidecar.attrs, ds.sidecar.md,
+                        ds.sidecar.orig_quals):
+                n += getattr(getattr(col, "buf", None), "nbytes", 0)
+            return n
+
+        def load(si: int, insert: bool = True) -> AlignmentDataset:
+            hit = _cache.get(si)
+            if hit is not None:
+                _cache.move_to_end(si)
+                return hit[0]
             b, s, h = host_shuffle.iter_shards([shard_paths[si]]).__next__()
-            return AlignmentDataset(b, s, h)
+            ds = AlignmentDataset(b, s, h)
+            nb = _nbytes(ds)
+            # the final pass never revisits a shard: inserting there
+            # would only evict shards later in this same pass
+            if insert and nb <= cache_bytes:
+                while _cache and _cache_total[0] + nb > cache_bytes:
+                    _, (_, old_nb) = _cache.popitem(last=False)
+                    _cache_total[0] -= old_nb
+                _cache[si] = (ds, nb)
+                _cache_total[0] += nb
+            return ds
 
         def with_dup_flags(ds: AlignmentDataset, si: int) -> AlignmentDataset:
             if dup_slices[si] is None:
@@ -174,41 +211,69 @@ def transform_sharded(
             table = bqsr_mod.solve_recalibration_table(total, mism)
         stats["observe_s"] = time.perf_counter() - t
 
-        # ---- 5. pass C: apply + split + write -------------------------
+        # ---- 5. pass C: apply + split || part writes ------------------
+        # a writer pool encodes finished shards while the next shard's
+        # apply runs (the streamed path's layout; Parquet encode is
+        # arrow C++ and releases the GIL around compression/IO)
+        from concurrent.futures import ThreadPoolExecutor
+
         t = time.perf_counter()
         candidates = []
-        for si in range(len(shard_paths)):
-            ds = with_dup_flags(load(si), si)
-            if table is not None:
-                ds = bqsr_mod.apply_recalibration(ds, table, gl)
-            if targets:
-                b = ds.batch.to_numpy()
-                tidx = realign_mod.map_batch_to_targets(
-                    b, targets, header.seq_dict.names
-                )
-                cand = tidx >= 0
-                if cand.any():
-                    candidates.append(ds.take_rows(np.flatnonzero(cand)))
-                    ds = ds.take_rows(np.flatnonzero(~cand))
-            if ds.batch.n_rows:
-                _write_part(out_path, si, ds, compression)
-        stats["apply_split_s"] = time.perf_counter() - t
+        futures = []
+        n_writers = 3
+        with ThreadPoolExecutor(max_workers=n_writers) as pool:
+            def _submit_write(idx, ds):
+                # backpressure: each pending future pins a whole shard,
+                # so cap in-flight writes to preserve the O(largest
+                # shard) memory invariant
+                while sum(1 for f in futures if not f.done()) >= n_writers:
+                    next(f for f in futures if not f.done()).result()
+                futures.append(pool.submit(
+                    _write_part, out_path, idx, ds, compression
+                ))
 
-        # ---- 6. tail: realign candidates across shard edges -----------
-        t = time.perf_counter()
-        if candidates:
-            cand = AlignmentDataset.concat(candidates)
-            cand = realign_mod.realign_indels(
-                cand,
-                consensus_model=consensus_model,
-                known_indels=known_indels,
-                max_indel_size=mis,
-                max_consensus_number=mcn,
-                lod_threshold=lod,
-                max_target_size=mts,
-            )
-            _write_part(out_path, len(shard_paths), cand, compression)
-        stats["realign_s"] = time.perf_counter() - t
+            for si in range(len(shard_paths)):
+                ds = with_dup_flags(load(si, insert=False), si)
+                ev = _cache.pop(si, None)  # final pass: free as we go
+                if ev is not None:
+                    _cache_total[0] -= ev[1]
+                if table is not None:
+                    ds = bqsr_mod.apply_recalibration(ds, table, gl)
+                if targets:
+                    b = ds.batch.to_numpy()
+                    tidx = realign_mod.map_batch_to_targets(
+                        b, targets, header.seq_dict.names
+                    )
+                    cand = tidx >= 0
+                    if cand.any():
+                        candidates.append(ds.take_rows(np.flatnonzero(cand)))
+                        ds = ds.take_rows(np.flatnonzero(~cand))
+                if ds.batch.n_rows:
+                    _submit_write(si, ds)
+            stats["apply_split_s"] = time.perf_counter() - t
+
+            # ---- 6. tail: realign candidates across shard edges -------
+            t = time.perf_counter()
+            if candidates:
+                cand = AlignmentDataset.concat(candidates)
+                cand = realign_mod.realign_indels(
+                    cand,
+                    consensus_model=consensus_model,
+                    known_indels=known_indels,
+                    max_indel_size=mis,
+                    max_consensus_number=mcn,
+                    lod_threshold=lod,
+                    max_target_size=mts,
+                )
+                _submit_write(len(shard_paths), cand)
+            stats["realign_s"] = time.perf_counter() - t
+
+            t = time.perf_counter()
+            for f in futures:
+                err = f.exception()
+                if err is not None:
+                    raise err
+        stats["write_wait_s"] = time.perf_counter() - t
         stats["total_s"] = time.perf_counter() - t_start
         return stats
     finally:

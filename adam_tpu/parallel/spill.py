@@ -1,0 +1,216 @@
+"""Raw columnar shard spill (Arrow IPC).
+
+The out-of-core shard store for the composed sharded transform
+(parallel/sharded.py).  Unlike the Parquet output format — which is the
+*interchange* layout (AlignmentRecord field names, ASCII sequences,
+CIGAR strings; io/parquet.py) — this spill keeps the framework's own
+struct-of-arrays batch columns verbatim: base/qual code matrices ride as
+per-row binary values, cigar columns as packed bytes, sidecar strings as
+Arrow strings.  Writing is memcpy-speed (no ASCII encode), reading is
+memcpy + pad (no tokenize), and the store is still Arrow IPC: appendable
+record batches, memory-mappable, readable cross-process (the property
+the 2-process harness leans on).
+
+The reference's analog is Spark's shuffle-file format — an internal
+serialized block layout, not the public Parquet schema
+(SURVEY §2.6; core/.../ShuffleBlockResolver in Spark itself).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from adam_tpu.formats.batch import ReadBatch, ReadSidecar
+
+
+def _binary_rows(mat: np.ndarray) -> "pa.Array":
+    """[N, W] u8 matrix -> large_binary array of N W-byte values (one
+    memcpy; 64-bit offsets so long-read batches cannot wrap the offset
+    arithmetic)."""
+    import pyarrow as pa
+
+    mat = np.ascontiguousarray(mat, np.uint8)
+    n, w = mat.shape
+    offsets = np.arange(n + 1, dtype=np.int64) * w
+    return pa.LargeBinaryArray.from_buffers(
+        pa.large_binary(), n,
+        [None, pa.py_buffer(offsets), pa.py_buffer(mat)],
+    )
+
+
+def _i32_matrix_rows(mat: np.ndarray) -> "pa.Array":
+    """[N, C] i32 matrix -> binary array of N 4C-byte values."""
+    mat = np.ascontiguousarray(mat, np.int32)
+    return _binary_rows(mat.view(np.uint8).reshape(mat.shape[0], -1))
+
+
+def _string_array(col) -> "pa.Array":
+    from adam_tpu.formats.strings import StringColumn
+
+    return StringColumn.of(col).to_arrow()
+
+
+def batch_to_raw_table(batch: ReadBatch, side: ReadSidecar, header):
+    """Valid rows of a columnar batch -> raw-layout arrow table."""
+    import jax
+    import pyarrow as pa
+
+    from adam_tpu.io.parquet import _header_meta
+
+    b = jax.tree.map(np.asarray, batch)
+    valid = np.asarray(b.valid)
+    if not valid.all():
+        rows = np.flatnonzero(valid)
+        b = jax.tree.map(lambda x: np.asarray(x)[rows], b)
+        side = side.take(rows)
+    cols = {
+        "bases": _binary_rows(b.bases),
+        "quals": _binary_rows(b.quals),
+        "lengths": pa.array(np.asarray(b.lengths, np.int32), pa.int32()),
+        "flags": pa.array(np.asarray(b.flags, np.int32), pa.int32()),
+        "contig_idx": pa.array(np.asarray(b.contig_idx, np.int32), pa.int32()),
+        "start": pa.array(np.asarray(b.start, np.int64), pa.int64()),
+        "end": pa.array(np.asarray(b.end, np.int64), pa.int64()),
+        "mapq": pa.array(np.asarray(b.mapq, np.int32), pa.int32()),
+        "cigar_ops": _binary_rows(b.cigar_ops),
+        "cigar_lens": _i32_matrix_rows(b.cigar_lens),
+        "cigar_n": pa.array(np.asarray(b.cigar_n, np.int32), pa.int32()),
+        "mate_contig_idx": pa.array(
+            np.asarray(b.mate_contig_idx, np.int32), pa.int32()
+        ),
+        "mate_start": pa.array(np.asarray(b.mate_start, np.int64), pa.int64()),
+        "tlen": pa.array(np.asarray(b.tlen, np.int32), pa.int32()),
+        "read_group_idx": pa.array(
+            np.asarray(b.read_group_idx, np.int32), pa.int32()
+        ),
+        "has_qual": pa.array(np.asarray(b.has_qual, bool), pa.bool_()),
+        "names": _string_array(side.names),
+        "attrs": _string_array(side.attrs),
+        "md": _string_array(side.md),
+        "orig_quals": _string_array(side.orig_quals),
+        "trimmed_from_start": pa.array(
+            np.asarray(side.trimmed_from_start, np.int32), pa.int32()
+        ),
+        "trimmed_from_end": pa.array(
+            np.asarray(side.trimmed_from_end, np.int32), pa.int32()
+        ),
+    }
+    return pa.table(cols).replace_schema_metadata(_header_meta(header))
+
+
+class RawShardWriter:
+    """Appendable raw-spill writer for one shard file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._writer = None
+
+    def append(self, batch: ReadBatch, side: ReadSidecar, header) -> None:
+        import pyarrow as pa
+
+        table = batch_to_raw_table(batch, side, header)
+        if self._writer is None:
+            self._writer = pa.ipc.new_file(self.path, table.schema)
+        for rb in table.to_batches():
+            self._writer.write_batch(rb)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+def _rows_matrix(chunks, dtype, pad_value, item: int = 1):
+    """Binary chunked array -> [N, Wmax/item] matrix of ``dtype``.
+
+    Each chunk's rows share one width (they came from one [N, W]
+    matrix), so a chunk reconstructs as a single buffer reshape; chunks
+    of differing width pad to the max."""
+    widths = []
+    parts = []
+    for ch in chunks:
+        n = len(ch)
+        if n == 0:
+            continue
+        buf = np.frombuffer(ch.buffers()[2], np.uint8,
+                            ch.buffers()[2].size)
+        off = np.frombuffer(ch.buffers()[1], np.int64, n + 1)
+        w = int(off[1] - off[0]) if n else 0
+        mat = buf[off[0]: off[0] + n * w].reshape(n, w)
+        parts.append(mat)
+        widths.append(w)
+    if not parts:
+        return np.zeros((0, item), dtype).reshape(0, -1)
+    wmax = max(widths)
+    out = []
+    for mat in parts:
+        if mat.shape[1] < wmax:
+            pad = np.full((mat.shape[0], wmax - mat.shape[1]),
+                          pad_value, np.uint8)
+            if dtype is np.int32:
+                # i32 rows pad with whole little-endian elements
+                pad = np.zeros((mat.shape[0], wmax - mat.shape[1]), np.uint8)
+            mat = np.concatenate([mat, pad], axis=1)
+        out.append(mat)
+    full = np.concatenate(out, axis=0) if len(out) > 1 else out[0]
+    if dtype is np.int32:
+        return full.view(np.int32).reshape(full.shape[0], -1)
+    return full.astype(dtype, copy=False)
+
+
+def read_raw_shard(path: str):
+    """Raw spill file -> (ReadBatch, ReadSidecar, SamHeader)."""
+    import pyarrow as pa
+
+    from adam_tpu.formats import schema
+    from adam_tpu.formats.strings import StringColumn
+    from adam_tpu.io.parquet import _header_from_meta
+
+    with pa.memory_map(path) as source:
+        table = pa.ipc.open_file(source).read_all()
+    header = _header_from_meta(table.schema.metadata)
+    n = table.num_rows
+
+    def col(name):
+        return table.column(name)
+
+    def ints(name, dtype):
+        return np.asarray(col(name).combine_chunks(), dtype=dtype)
+
+    bases = _rows_matrix(col("bases").chunks, np.uint8, schema.BASE_PAD)
+    quals = _rows_matrix(col("quals").chunks, np.uint8, schema.QUAL_PAD)
+    cigar_ops = _rows_matrix(col("cigar_ops").chunks, np.uint8,
+                             schema.CIGAR_PAD)
+    cigar_lens = _rows_matrix(col("cigar_lens").chunks, np.int32, 0, item=4)
+
+    def strings(name):
+        return StringColumn.from_arrow(col(name))
+
+    batch = ReadBatch(
+        bases=bases,
+        quals=quals,
+        lengths=ints("lengths", np.int32),
+        flags=ints("flags", np.int32),
+        contig_idx=ints("contig_idx", np.int32),
+        start=ints("start", np.int64),
+        end=ints("end", np.int64),
+        mapq=ints("mapq", np.int32),
+        cigar_ops=cigar_ops,
+        cigar_lens=cigar_lens,
+        cigar_n=ints("cigar_n", np.int32),
+        mate_contig_idx=ints("mate_contig_idx", np.int32),
+        mate_start=ints("mate_start", np.int64),
+        tlen=ints("tlen", np.int32),
+        read_group_idx=ints("read_group_idx", np.int32),
+        has_qual=ints("has_qual", bool),
+        valid=np.ones(n, bool),
+    )
+    side = ReadSidecar(
+        names=strings("names"),
+        attrs=strings("attrs"),
+        md=strings("md"),
+        orig_quals=strings("orig_quals"),
+        trimmed_from_start=ints("trimmed_from_start", np.int32),
+        trimmed_from_end=ints("trimmed_from_end", np.int32),
+    )
+    return batch, side, header
