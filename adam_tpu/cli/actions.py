@@ -53,7 +53,12 @@ class CalculateDepth(Command):
             broadcast_region_join,
         )
 
-        ds = AlignmentDataset.load(args.adam)
+        kw = {}
+        if str(args.adam).endswith((".adam", ".parquet")):
+            # depth only joins on coordinates: push the projection down
+            # so payload columns (sequence/qual/attrs) are never read
+            kw["projection"] = ["contig", "start", "end", "flags"]
+        ds = AlignmentDataset.load(args.adam, **kw)
         b = ds.batch.to_numpy()
         mapped = np.flatnonzero(np.asarray(b.is_mapped) & np.asarray(b.valid))
         reads = IntervalArrays.of(

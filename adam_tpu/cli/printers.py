@@ -29,6 +29,9 @@ class PrintAdam(Command):
                        help="output to a (local) file")
         p.add_argument("-pretty", action="store_true",
                        help="display raw, pretty-formatted JSON")
+        p.add_argument("-projection", default=None,
+                       help="comma-separated column names to read "
+                            "(pushed down to the Parquet scan)")
 
     @classmethod
     def run(cls, args):
@@ -36,10 +39,14 @@ class PrintAdam(Command):
 
         import pyarrow.parquet as pq
 
+        cols = (
+            [c.strip() for c in args.projection.split(",") if c.strip()]
+            if args.projection else None
+        )
         out = open(args.output, "w") if args.output else sys.stdout
         try:
             for path in args.files:
-                table = pq.read_table(path)
+                table = pq.read_table(path, columns=cols)
                 for row in table.to_pylist():
                     if args.pretty:
                         out.write(json.dumps(row, indent=2, default=str) + "\n")

@@ -1,0 +1,76 @@
+"""Projection field enumerations per record type.
+
+Mirrors the reference's ``projections/FieldEnumeration.scala:49-61`` and
+the per-type enums (``AlignmentRecordField.scala:29-31``,
+``GenotypeField.scala``, ``VariantField.scala``, ``FeatureField.scala``,
+``NucleotideContigFragmentField.scala``): a named, validated set of
+storage-schema fields per record type, used to push column projection
+into the Parquet reads (``io/parquet.py`` ``projection=`` arguments).
+
+Here the enums are plain frozensets of the Parquet column names the
+columnar stores actually write; ``validate_projection`` raises on
+unknown names so a typo fails loudly at the API boundary rather than
+silently reading everything.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+# io/parquet.py to_arrow_alignments column set (AlignmentRecord fields)
+ALIGNMENT_FIELDS = frozenset({
+    "readName", "sequence", "qual", "flags", "contig", "start", "end",
+    "mapq", "cigar", "mateContig", "mateAlignmentStart",
+    "inferredInsertSize", "recordGroupName", "attributes",
+    "mismatchingPositions", "origQual", "basesTrimmedFromStart",
+    "basesTrimmedFromEnd",
+})
+
+# save_genotypes variants.parquet columns (VariantField + annotations)
+VARIANT_FIELDS = frozenset({
+    "contig", "start", "end", "referenceAllele", "alternateAllele",
+    "name", "filters", "annotations", "qual", "filtersApplied",
+    "filtersPassed", "variantIdx",
+})
+
+# save_genotypes genotypes.parquet columns (GenotypeField)
+GENOTYPE_FIELDS = frozenset({
+    "variantIdx", "sampleId", "allele0", "allele1", "genotypeQuality",
+    "readDepth", "referenceReadDepth", "alternateReadDepth", "isPhased",
+    "genotypeLikelihoods", "nonReferenceLikelihoods",
+    "splitFromMultiAllelic", "genotypeFilters",
+})
+
+# save_features columns (FeatureField)
+FEATURE_FIELDS = frozenset({
+    "contig", "start", "end", "strand", "score", "featureId",
+    "featureType", "source", "parentIds", "attributes",
+})
+
+# save_fragments columns (NucleotideContigFragmentField)
+FRAGMENT_FIELDS = frozenset({
+    "contig", "description", "fragmentSequence", "fragmentStartPosition",
+    "fragmentNumber", "numberOfFragmentsInContig",
+})
+
+
+def validate_projection(
+    projection: Optional[Sequence[str]],
+    allowed: Iterable[str],
+    essential: Iterable[str],
+    what: str,
+) -> Optional[list[str]]:
+    """-> sorted column list (projection + essentials), or None for all.
+
+    Unknown field names raise ValueError, as the reference's enum-typed
+    ``Projection(...)`` constructor makes impossible by construction."""
+    if projection is None:
+        return None
+    allowed = set(allowed)
+    bad = sorted(set(projection) - allowed)
+    if bad:
+        raise ValueError(
+            f"unknown {what} projection field(s) {bad}; "
+            f"valid: {sorted(allowed)}"
+        )
+    return sorted(set(projection) | set(essential))

@@ -458,15 +458,20 @@ def _seq_dict_from_meta(meta) -> "SequenceDictionary":
 
 
 def save_genotypes(path: str, variants, genotypes, seq_dict,
-                   compression: str = "snappy") -> None:
+                   compression: str = "snappy",
+                   typed_annotations=None) -> None:
+    """``typed_annotations``: ``{adamKey: [value-or-None per variant]}``
+    from formats/annotations.split_typed — stored as real typed
+    ``ann_<adamKey>`` Parquet columns (the VariantAnnotationConverter
+    analog), so annotation predicates push down like any other column.
+    """
     import os
 
     from adam_tpu.formats import variants as vf
 
     os.makedirs(path, exist_ok=True)
     vside = variants.sidecar
-    vt = pa.table(
-        {
+    cols = {
             "contig": pa.array(
                 [seq_dict.names[c] for c in variants.contig_idx], pa.string()
             ),
@@ -487,8 +492,31 @@ def save_genotypes(path: str, variants, genotypes, seq_dict,
             "annotations": pa.array(
                 [json.dumps(d) for d in vside.info], pa.string()
             ),
+            # row index: lets a pushed-down variant predicate select the
+            # matching genotype rows without reading the full table
+            "variantIdx": pa.array(
+                np.arange(len(variants.start), dtype=np.int32), pa.int32()
+            ),
         }
-    ).replace_schema_metadata(_seq_dict_meta(seq_dict))
+    if typed_annotations is None:
+        # default: split recognized INFO keys into typed columns (the
+        # loadVcf-side VariantAnnotationConverter application); pass {}
+        # to disable
+        from adam_tpu.formats.annotations import split_typed
+
+        typed_annotations, leftover = split_typed(vside.info)
+        if typed_annotations:
+            cols["annotations"] = pa.array(
+                [json.dumps(d) for d in leftover], pa.string()
+            )
+    if typed_annotations:
+        from adam_tpu.formats.annotations import arrow_type
+
+        for adam_key in sorted(typed_annotations):
+            cols[f"ann_{adam_key}"] = pa.array(
+                typed_annotations[adam_key], arrow_type(adam_key)
+            )
+    vt = pa.table(cols).replace_schema_metadata(_seq_dict_meta(seq_dict))
     pq.write_table(vt, os.path.join(path, "variants.parquet"),
                    compression=compression)
 
@@ -550,17 +578,62 @@ def _likelihood_matrix(col, m: int, what: str) -> np.ndarray:
     return out
 
 
-def load_genotypes(path: str, contig_names=None):
+def _pylist_or(t, name: str, n: int, default):
+    """Column as pylist, or defaults when projected away."""
+    if name in t.column_names:
+        return t[name].to_pylist()
+    return [default] * n
+
+
+def load_genotypes(path: str, contig_names=None, projection=None,
+                   filters=None):
     """-> (VariantBatch, GenotypeBatch, SequenceDictionary).
 
     ``contig_names`` optionally fixes the contig index space (e.g. from a
     BAM header), as in :func:`adam_tpu.io.vcf.read_vcf`.
+
+    ``projection`` is a subset of VARIANT_FIELDS | GENOTYPE_FIELDS
+    (formats/fields.py, mirroring GenotypeField/VariantField enums,
+    projections/GenotypeField.scala): only those Parquet columns are
+    read; everything else comes back as defaults.  ``filters`` is a
+    pyarrow predicate over the VARIANT columns, pushed down to the
+    variants read; the matching genotype rows are selected by a pushed
+    ``variantIdx in ...`` predicate and re-indexed.
     """
     import os
 
     from adam_tpu.formats import variants as vf
+    from adam_tpu.formats.fields import (
+        GENOTYPE_FIELDS,
+        VARIANT_FIELDS,
+        validate_projection,
+    )
 
-    vt = pq.read_table(os.path.join(path, "variants.parquet"))
+    v_cols = g_cols = None
+    if projection is not None:
+        proj = set(projection)
+        bad = sorted(proj - (VARIANT_FIELDS | GENOTYPE_FIELDS))
+        if bad:
+            raise ValueError(
+                f"unknown genotype/variant projection field(s) {bad}"
+            )
+        v_cols = validate_projection(
+            sorted(proj & VARIANT_FIELDS), VARIANT_FIELDS,
+            ("contig", "start", "end", "referenceAllele",
+             "alternateAllele", "variantIdx"),
+            "variant",
+        )
+        g_cols = validate_projection(
+            sorted(proj & GENOTYPE_FIELDS), GENOTYPE_FIELDS,
+            ("variantIdx", "sampleId", "allele0", "allele1"),
+            "genotype",
+        )
+    v_path = os.path.join(path, "variants.parquet")
+    if v_cols is not None:
+        # legacy stores predate the variantIdx row-index column
+        present = set(pq.read_schema(v_path).names)
+        v_cols = [c for c in v_cols if c in present]
+    vt = pq.read_table(v_path, columns=v_cols, filters=filters)
     if contig_names is not None:
         seq_dict = SequenceDictionary(
             tuple(SequenceRecord(n, 0) for n in contig_names)
@@ -583,15 +656,29 @@ def load_genotypes(path: str, contig_names=None):
             )
         )
 
+    nv = vt.num_rows
+    info = [
+        json.loads(s) if s else {}
+        for s in _pylist_or(vt, "annotations", nv, None)
+    ]
+    ann_cols = [c for c in vt.column_names if c.startswith("ann_")]
+    if ann_cols:
+        # typed annotation columns (VariantAnnotationConverter analog)
+        # merge back under their VCF keys
+        from adam_tpu.formats.annotations import merge_typed
+
+        info = merge_typed(
+            {c[4:]: vt[c].to_pylist() for c in ann_cols}, info
+        )
     side = vf.VariantSidecar(
         ref_allele=vt["referenceAllele"].to_pylist(),
         alt_allele=vt["alternateAllele"].to_pylist(),
-        names=vt["name"].to_pylist(),
-        filters=vt["filters"].to_pylist(),
-        info=[json.loads(s) for s in vt["annotations"].to_pylist()],
+        names=_pylist_or(vt, "name", nv, None),
+        filters=_pylist_or(vt, "filters", nv, None),
+        info=info,
     )
     quals = [
-        np.nan if q is None else q for q in vt["qual"].to_pylist()
+        np.nan if q is None else q for q in _pylist_or(vt, "qual", nv, None)
     ]
     variants = vf.VariantBatch(
         contig_idx=np.array([name_idx[c] for c in contigs], np.int32),
@@ -602,12 +689,47 @@ def load_genotypes(path: str, contig_names=None):
             [len(a) if a else 0 for a in side.alt_allele], np.int32
         ),
         qual=np.array(quals, np.float32),
-        filters_applied=np.array(vt["filtersApplied"].to_pylist(), bool),
-        passing=np.array(vt["filtersPassed"].to_pylist(), bool),
+        filters_applied=np.array(
+            _pylist_or(vt, "filtersApplied", nv, False), bool
+        ),
+        passing=np.array(_pylist_or(vt, "filtersPassed", nv, False), bool),
         sidecar=side,
     )
 
-    gt = pq.read_table(os.path.join(path, "genotypes.parquet"))
+    g_path = os.path.join(path, "genotypes.parquet")
+    g_filters = None
+    remap = None
+    if filters is not None:
+        # surviving original variant rows: pushed down to the genotype
+        # read, then genotype variant_idx re-indexes into the filtered
+        # variant batch
+        if "variantIdx" in vt.column_names:
+            keep = np.asarray(vt["variantIdx"].combine_chunks(), np.int64)
+        else:
+            # legacy store without the row-index column: re-read the
+            # table unfiltered with a synthesized row index and evaluate
+            # the same predicate in memory (identity-key matching would
+            # mis-select under duplicate positions, e.g. split
+            # multiallelics)
+            import pyarrow.compute as pc
+
+            full = pq.read_table(v_path)
+            full = full.append_column(
+                "__row", pa.array(np.arange(full.num_rows, dtype=np.int64))
+            )
+            expr = (
+                filters if isinstance(filters, pc.Expression)
+                else pq.filters_to_expression(filters)
+            )
+            keep = np.asarray(
+                full.filter(expr)["__row"].combine_chunks(), np.int64
+            )
+        keep = np.sort(keep)
+        import pyarrow.compute as pc
+
+        g_filters = pc.field("variantIdx").isin(pa.array(keep))
+        remap = keep
+    gt = pq.read_table(g_path, columns=g_cols, filters=g_filters)
     sample_names = gt["sampleId"].to_pylist()
     samples: list = []
     sample_idx = {}
@@ -618,8 +740,17 @@ def load_genotypes(path: str, contig_names=None):
             samples.append(s)
         si.append(sample_idx[s])
     m = gt.num_rows
+    vidx = np.array(gt["variantIdx"].to_pylist(), np.int64)
+    if remap is not None and m:
+        vidx = np.searchsorted(remap, vidx)
+
+    def _pl(name):
+        if name in gt.column_names:
+            return _likelihood_matrix(gt[name], m, name)
+        return np.zeros((m, 3), np.int32)
+
     genotypes = vf.GenotypeBatch(
-        variant_idx=np.array(gt["variantIdx"].to_pylist(), np.int32),
+        variant_idx=vidx.astype(np.int32),
         sample_idx=np.array(si, np.int32),
         alleles=np.stack(
             [
@@ -629,21 +760,24 @@ def load_genotypes(path: str, contig_names=None):
             axis=1,
         ) if m else np.zeros((0, 2), np.int8),
         gq=np.clip(
-            np.array(gt["genotypeQuality"].to_pylist(), np.int32), 0, 32767
+            np.array(_pylist_or(gt, "genotypeQuality", m, 0), np.int32),
+            0, 32767,
         ).astype(np.int16),
-        dp=np.array(gt["readDepth"].to_pylist(), np.int32),
-        ref_depth=np.array(gt["referenceReadDepth"].to_pylist(), np.int32),
-        alt_depth=np.array(gt["alternateReadDepth"].to_pylist(), np.int32),
-        phased=np.array(gt["isPhased"].to_pylist(), bool),
-        pl=_likelihood_matrix(gt["genotypeLikelihoods"], m,
-                              "genotypeLikelihoods"),
-        nonref_pl=_likelihood_matrix(gt["nonReferenceLikelihoods"], m,
-                                     "nonReferenceLikelihoods"),
+        dp=np.array(_pylist_or(gt, "readDepth", m, -1), np.int32),
+        ref_depth=np.array(
+            _pylist_or(gt, "referenceReadDepth", m, -1), np.int32
+        ),
+        alt_depth=np.array(
+            _pylist_or(gt, "alternateReadDepth", m, -1), np.int32
+        ),
+        phased=np.array(_pylist_or(gt, "isPhased", m, False), bool),
+        pl=_pl("genotypeLikelihoods"),
+        nonref_pl=_pl("nonReferenceLikelihoods"),
         split_from_multiallelic=np.array(
-            gt["splitFromMultiAllelic"].to_pylist(), bool
+            _pylist_or(gt, "splitFromMultiAllelic", m, False), bool
         ),
         samples=samples,
-        genotype_filters=gt["genotypeFilters"].to_pylist(),
+        genotype_filters=_pylist_or(gt, "genotypeFilters", m, None),
     )
     return variants, genotypes, seq_dict
 
@@ -678,10 +812,17 @@ def save_features(path: str, feats, compression: str = "snappy") -> None:
     pq.write_table(t, path, compression=compression)
 
 
-def load_features(path: str):
+def load_features(path: str, projection=None, filters=None):
+    """``projection``: subset of FEATURE_FIELDS (FeatureField.scala);
+    ``filters``: pyarrow predicate pushed into the Parquet read."""
     from adam_tpu.formats.features import FeatureBatch, FeatureSidecar
+    from adam_tpu.formats.fields import FEATURE_FIELDS, validate_projection
 
-    t = pq.read_table(path)
+    cols = validate_projection(
+        projection, FEATURE_FIELDS, ("contig", "start", "end"), "feature"
+    )
+    t = pq.read_table(path, columns=cols, filters=filters)
+    n = t.num_rows
     contigs = t["contig"].to_pylist()
     names: list = []
     idx = {}
@@ -691,20 +832,25 @@ def load_features(path: str):
             idx[c] = len(names)
             names.append(c)
         ci.append(idx[c])
-    scores = [np.nan if s is None else s for s in t["score"].to_pylist()]
+    scores = [
+        np.nan if s is None else s for s in _pylist_or(t, "score", n, None)
+    ]
     return FeatureBatch(
         contig_idx=np.array(ci, np.int32),
         start=np.array(t["start"].to_pylist(), np.int64),
         end=np.array(t["end"].to_pylist(), np.int64),
-        strand=np.array(t["strand"].to_pylist(), np.int8),
+        strand=np.array(_pylist_or(t, "strand", n, 0), np.int8),
         score=np.array(scores, np.float32),
         contig_names=names,
         sidecar=FeatureSidecar(
-            feature_id=t["featureId"].to_pylist(),
-            feature_type=t["featureType"].to_pylist(),
-            source=t["source"].to_pylist(),
-            parent_ids=t["parentIds"].to_pylist(),
-            attributes=[json.loads(s) for s in t["attributes"].to_pylist()],
+            feature_id=_pylist_or(t, "featureId", n, None),
+            feature_type=_pylist_or(t, "featureType", n, None),
+            source=_pylist_or(t, "source", n, None),
+            parent_ids=_pylist_or(t, "parentIds", n, None),
+            attributes=[
+                json.loads(s) if s else {}
+                for s in _pylist_or(t, "attributes", n, None)
+            ],
         ),
     )
 
@@ -755,11 +901,22 @@ def save_fragments(path: str, fragments, seq_dict,
     pq.write_table(t, path, compression=compression)
 
 
-def load_fragments(path: str):
-    """-> (FragmentBatch, SequenceDictionary, descriptions dict)."""
+def load_fragments(path: str, projection=None, filters=None):
+    """-> (FragmentBatch, SequenceDictionary, descriptions dict).
+
+    ``projection``: subset of FRAGMENT_FIELDS
+    (NucleotideContigFragmentField.scala); ``filters``: pyarrow
+    predicate pushed into the Parquet read."""
+    from adam_tpu.formats.fields import FRAGMENT_FIELDS, validate_projection
     from adam_tpu.formats.fragments import FragmentBatch
 
-    t = pq.read_table(path)
+    cols = validate_projection(
+        projection, FRAGMENT_FIELDS,
+        ("contig", "fragmentSequence", "fragmentStartPosition",
+         "fragmentNumber", "numberOfFragmentsInContig"),
+        "fragment",
+    )
+    t = pq.read_table(path, columns=cols, filters=filters)
     seq_dict = _seq_dict_from_meta(t.schema.metadata)
     name_idx = {n: i for i, n in enumerate(seq_dict.names)}
     contigs = t["contig"].to_pylist()
@@ -787,7 +944,7 @@ def load_fragments(path: str):
         valid=np.ones(n, bool),
     )
     descriptions = {}
-    descs = t["description"].to_pylist()
+    descs = _pylist_or(t, "description", n, None)
     for i in range(n):
         out.bases[i, : len(seqs[i])] = schema.encode_bases(seqs[i])
         out.lengths[i] = len(seqs[i])

@@ -23,9 +23,13 @@ GenomicPartitioners.scala:63-85):
 6. **Tail**: candidates from all shards realign together (boundary
    targets see all their reads) and land in the final part.
 
-Each pass re-reads its shard store rather than holding shards in RAM, so
-peak memory is O(largest shard), not O(dataset) — the property that lets
-one host per shard drive this same structure over DCN.
+Each pass reads its shards through a bounded LRU cache
+(``cache_bytes``, default 4 GiB): shards that fit skip the re-decode on
+later passes, eviction keeps resident bytes under the budget, and pass C
+additionally pins up to ``n_writers`` shards in the write pool — so peak
+memory is O(cache_bytes + a few shards), never O(dataset).  Set
+``cache_bytes=0`` for the strict one-shard-resident discipline that lets
+one small host per shard drive this same structure over DCN.
 """
 
 from __future__ import annotations
@@ -224,8 +228,8 @@ def transform_sharded(
         with ThreadPoolExecutor(max_workers=n_writers) as pool:
             def _submit_write(idx, ds):
                 # backpressure: each pending future pins a whole shard,
-                # so cap in-flight writes to preserve the O(largest
-                # shard) memory invariant
+                # so cap in-flight writes to bound pass C's residency at
+                # n_writers shards beyond the one being split
                 while sum(1 for f in futures if not f.done()) >= n_writers:
                     next(f for f in futures if not f.done()).result()
                 futures.append(pool.submit(

@@ -152,7 +152,7 @@ def _rows_matrix(chunks, dtype, pad_value, item: int = 1):
                 pad = np.zeros((mat.shape[0], wmax - mat.shape[1]), np.uint8)
             mat = np.concatenate([mat, pad], axis=1)
         out.append(mat)
-    full = np.concatenate(out, axis=0) if len(out) > 1 else out[0]
+    full = np.concatenate(out, axis=0) if len(out) > 1 else out[0].copy()
     if dtype is np.int32:
         return full.view(np.int32).reshape(full.shape[0], -1)
     return full.astype(dtype, copy=False)
@@ -175,7 +175,10 @@ def read_raw_shard(path: str):
         return table.column(name)
 
     def ints(name, dtype):
-        return np.asarray(col(name).combine_chunks(), dtype=dtype)
+        # fresh writable array: downstream transforms mutate columns in
+        # place (e.g. trim), and Arrow/mmap-backed views are read-only
+        arr = np.asarray(col(name).combine_chunks())
+        return arr.astype(dtype, copy=True)
 
     bases = _rows_matrix(col("bases").chunks, np.uint8, schema.BASE_PAD)
     quals = _rows_matrix(col("quals").chunks, np.uint8, schema.QUAL_PAD)

@@ -118,3 +118,28 @@ def test_sharded_cross_bin_duplicates_and_targets(tmp_path):
     assert marks["dup4"] == [False, False]
     n_marked = sum(all(v) for k, v in marks.items() if k.startswith("dup"))
     assert n_marked == 5
+
+
+def test_raw_shard_round_trip_is_writable(tmp_path):
+    """Raw-spill reads must hand back fresh writable arrays (downstream
+    transforms mutate columns in place, e.g. trim)."""
+    from adam_tpu.io import context
+    from adam_tpu.parallel import spill
+
+    ref = os.path.join(
+        "/root/reference/adam-core/src/test/resources", "small.sam"
+    )
+    ds = context.load_alignments(ref)
+    p = str(tmp_path / "s.arrows")
+    w = spill.RawShardWriter(p)
+    w.append(ds.batch, ds.sidecar, ds.header)
+    w.close()
+    b, side, header = spill.read_raw_shard(p)
+    for name in ("bases", "quals", "flags", "start", "cigar_lens"):
+        arr = getattr(b, name)
+        assert arr.flags.writeable, name
+        arr[:1] = arr[:1]  # actually write
+    np.testing.assert_array_equal(
+        np.asarray(b.start),
+        np.asarray(ds.batch.start)[np.asarray(ds.batch.valid)],
+    )
