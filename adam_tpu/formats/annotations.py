@@ -89,14 +89,23 @@ def split_typed(info_dicts) -> tuple[dict[str, list], list[dict]]:
         rest = {}
         for k, v in (d or {}).items():
             hit = ANNOTATION_KEYS.get(k)
-            if hit is None:
+            # VCF missing marker / unparseable values stay in the
+            # generic map verbatim (the reference skips
+            # MISSING_VALUE_v4 the same way, VariantAnnotation-
+            # Converter.scala:130-134) so round trips stay lossless
+            if hit is None or v == ".":
                 rest[k] = v
                 continue
             adam, typ = hit
+            try:
+                converted = _convert(v, typ)
+            except (ValueError, TypeError):
+                rest[k] = v
+                continue
             col = observed.get(adam)
             if col is None:
                 col = observed[adam] = [None] * n
-            col[i] = _convert(v, typ)
+            col[i] = converted
         leftover.append(rest)
     return observed, leftover
 

@@ -632,6 +632,12 @@ def load_genotypes(path: str, contig_names=None, projection=None,
     if v_cols is not None:
         # legacy stores predate the variantIdx row-index column
         present = set(pq.read_schema(v_path).names)
+        if "annotations" in v_cols:
+            # projecting the annotations field means ALL annotations,
+            # including the keys the save split into typed ann_* columns
+            v_cols = v_cols + sorted(
+                c for c in present if c.startswith("ann_")
+            )
         v_cols = [c for c in v_cols if c in present]
     vt = pq.read_table(v_path, columns=v_cols, filters=filters)
     if contig_names is not None:
@@ -706,20 +712,23 @@ def load_genotypes(path: str, contig_names=None, projection=None,
         if "variantIdx" in vt.column_names:
             keep = np.asarray(vt["variantIdx"].combine_chunks(), np.int64)
         else:
-            # legacy store without the row-index column: re-read the
-            # table unfiltered with a synthesized row index and evaluate
-            # the same predicate in memory (identity-key matching would
-            # mis-select under duplicate positions, e.g. split
-            # multiallelics)
+            # legacy store without the row-index column: re-read only
+            # the predicate-referenced columns with a synthesized row
+            # index and evaluate the predicate in memory (identity-key
+            # matching would mis-select under duplicate positions, e.g.
+            # split multiallelics)
             import pyarrow.compute as pc
 
-            full = pq.read_table(v_path)
-            full = full.append_column(
-                "__row", pa.array(np.arange(full.num_rows, dtype=np.int64))
-            )
             expr = (
                 filters if isinstance(filters, pc.Expression)
                 else pq.filters_to_expression(filters)
+            )
+            all_names = pq.read_schema(v_path).names
+            expr_repr = str(expr)
+            ref_cols = [c for c in all_names if c in expr_repr] or None
+            full = pq.read_table(v_path, columns=ref_cols)
+            full = full.append_column(
+                "__row", pa.array(np.arange(full.num_rows, dtype=np.int64))
             )
             keep = np.asarray(
                 full.filter(expr)["__row"].combine_chunks(), np.int64

@@ -7,7 +7,17 @@ DCN transport — the single-host simulation of SURVEY §2.6's multi-host
 requirement (the reference's analog: Spark executors shuffling over TCP).
 
 Usage: python multihost_harness.py <coordinator> <num_procs> <proc_id>
+           [transform <shard_dir> <out_dir>]
 Prints "HARNESS OK <checksum>" on success from every process.
+
+The ``transform`` mode runs the COMPOSED flagship transform
+(markdup + BQSR + realign) across the two processes over a shared raw
+shard store: each process owns alternating genome-bin shards,
+duplicate-marking summaries and realignment candidates exchange through
+spill files (the disk-shuffle role Spark's block manager plays), and
+the BQSR observation histograms merge with a REAL cross-process device
+``psum`` over the 2-device gRPC mesh.  test_parallel.py asserts the
+concatenated output equals the monolithic single-process transform.
 """
 
 import os
@@ -87,5 +97,186 @@ def main() -> None:
     print(f"HARNESS OK {int(expected[0]) % 100000}", flush=True)
 
 
+def transform_main(coordinator: str, n_procs: int, pid: int,
+                   shard_dir: str, out_dir: str) -> None:
+    """Composed 2-process transform over a shared raw shard store."""
+    import pickle
+
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+
+    from adam_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed(coordinator, n_procs, pid)
+
+    import glob as globmod
+    from functools import partial
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from adam_tpu.api.datasets import AlignmentDataset
+    from adam_tpu.parallel import spill
+    from adam_tpu.parallel.mesh import SHARD_AXIS, genome_mesh
+    from adam_tpu.pipelines import bqsr as bqsr_mod
+    from adam_tpu.pipelines import markdup as md_mod
+    from adam_tpu.pipelines import realign as realign_mod
+    from adam_tpu.pipelines.streamed import _write_part
+
+    mesh = genome_mesh(jax.devices())
+    shard_paths = sorted(globmod.glob(os.path.join(shard_dir, "*.arrows")))
+    mine = [si for si in range(len(shard_paths)) if si % n_procs == pid]
+
+    def load(si):
+        b, s, h = spill.read_raw_shard(shard_paths[si])
+        return AlignmentDataset(b, s, h)
+
+    def barrier(tag):
+        multihost_utils.sync_global_devices(tag)
+
+    # ---- pass A: per-process summaries + indel events ------------------
+    summaries = {}
+    events_local = []
+    header = None
+    counts = {}
+    for si in mine:
+        ds = load(si)
+        header = ds.header
+        counts[si] = ds.batch.n_rows
+        summaries[si] = md_mod.row_summary(ds)
+        events_local.extend(
+            realign_mod.extract_indel_events(ds.batch.to_numpy())
+        )
+
+    # exchange summaries + events (and the header, so a process that
+    # owns zero shards can still participate in the collectives)
+    # through spill files (disk shuffle)
+    with open(os.path.join(shard_dir, f"sum-{pid}.pkl"), "wb") as fh:
+        pickle.dump((summaries, events_local, counts, header), fh)
+    barrier("summaries")
+    all_summaries = {}
+    all_events = []
+    all_counts = {}
+    for p in range(n_procs):
+        with open(os.path.join(shard_dir, f"sum-{p}.pkl"), "rb") as fh:
+            s, e, c, h = pickle.load(fh)
+        all_summaries.update(s)
+        all_events.extend(e)
+        all_counts.update(c)
+        if header is None:
+            header = h
+    assert header is not None, "no process owned any shard"
+
+    # ---- barrier: global duplicate resolve + target merge (identical
+    # decisions in both processes — shard order fixes the splice order)
+    order = sorted(all_summaries)
+    dup = md_mod.resolve_duplicates(
+        md_mod.concat_summaries([all_summaries[si] for si in order])
+    )
+    dup_slices = {}
+    off = 0
+    for si in order:
+        dup_slices[si] = dup[off: off + all_counts[si]]
+        off += all_counts[si]
+    targets = realign_mod.merge_events(all_events, header.seq_dict.names)
+
+    def with_dup(ds, si):
+        b = ds.batch.to_numpy()
+        return ds.with_batch(b.replace(flags=md_mod.apply_duplicate_flags(
+            np.asarray(b.flags), dup_slices[si]
+        )))
+
+    # ---- pass B: local observation, cross-process device psum ----------
+    parts = []
+    for si in mine:
+        ds = with_dup(load(si), si)
+        total, mism, _rg, g = bqsr_mod._observe_device(ds, None)
+        parts.append((np.asarray(total), np.asarray(mism), g))
+    if parts:
+        lt, lm, lgl = bqsr_mod.merge_observations(parts)
+    else:
+        lt = lm = None
+        lgl = 0
+    # common table width across processes, then a REAL psum over DCN
+    gls = multihost_utils.process_allgather(jnp.int32(lgl))
+    gl = int(np.max(np.asarray(gls)))
+    n_rg = len(header.read_groups) + 1
+    shape = (n_rg, bqsr_mod.N_QUAL, 2 * gl + 1, bqsr_mod.N_DINUC)
+    pt = np.zeros(shape, np.int64)
+    pm = np.zeros(shape, np.int64)
+    if lt is not None:
+        o = gl - lgl
+        pt[:, :, o: o + 2 * lgl + 1, :] = lt
+        pm[:, :, o: o + 2 * lgl + 1, :] = lm
+
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+             out_specs=P(), check_vma=False)
+    def psum_hist(x):
+        return jax.lax.psum(x, SHARD_AXIS)
+
+    def psum_table(local):
+        # exact for counts < 2^53 (f64; i64 vector ops are emulated)
+        arr = jax.make_array_from_process_local_data(
+            sharding, local.reshape(1, -1).astype(np.float64)
+        )
+        out = np.asarray(psum_hist(arr))  # replicated: fully addressable
+        return out.reshape(shape).astype(np.int64)
+
+    total = psum_table(pt)
+    mism = psum_table(pm)
+    table = bqsr_mod.solve_recalibration_table(total, mism)
+
+    # ---- pass C: apply + split; exchange candidates; realign -----------
+    cand_local = []
+    for si in mine:
+        ds = with_dup(load(si), si)
+        ds = bqsr_mod.apply_recalibration(ds, table, gl)
+        if targets:
+            b = ds.batch.to_numpy()
+            tidx = realign_mod.map_batch_to_targets(
+                b, targets, header.seq_dict.names
+            )
+            keep = tidx >= 0
+            if keep.any():
+                cand_local.append(ds.take_rows(np.flatnonzero(keep)))
+                ds = ds.take_rows(np.flatnonzero(~keep))
+        if ds.batch.n_rows:
+            _write_part(out_dir, si, ds, "snappy")
+    cpath = os.path.join(shard_dir, f"cand-{pid}.arrows")
+    if cand_local:
+        cand = AlignmentDataset.concat(cand_local)
+        w = spill.RawShardWriter(cpath)
+        w.append(cand.batch, cand.sidecar, cand.header)
+        w.close()
+    barrier("candidates")
+    cands = []
+    for p in range(n_procs):
+        cp = os.path.join(shard_dir, f"cand-{p}.arrows")
+        if os.path.exists(cp):
+            b, s, h = spill.read_raw_shard(cp)
+            cands.append(AlignmentDataset(b, s, h))
+    if cands and pid == 0:
+        # boundary-correct: targets spanning shard/process edges see all
+        # their reads; one process owns the realigned part
+        cand = AlignmentDataset.concat(cands)
+        cand = realign_mod.realign_indels(cand)
+        _write_part(out_dir, len(shard_paths), cand, "snappy")
+    barrier("done")
+    print(f"HARNESS OK {int(total.sum()) % 100000}", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 4 and sys.argv[4] == "transform":
+        transform_main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                       sys.argv[5], sys.argv[6])
+    else:
+        main()

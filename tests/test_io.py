@@ -839,3 +839,28 @@ def test_legacy_store_filter_with_duplicate_positions(tmp_path):
     assert len(g.variant_idx) == int(
         (full_v.start[full_g.variant_idx] == 200).sum()
     )
+
+
+def test_annotation_missing_marker_and_projection_completeness(tmp_path):
+    """'.' (VCF missing marker) and unparseable values for known keys
+    stay in the generic map and round-trip; projecting 'annotations'
+    must pull the typed ann_* columns too."""
+    from adam_tpu.api.datasets import GenotypeDataset
+    from adam_tpu.formats.annotations import split_typed
+    from adam_tpu.io import parquet as pio
+
+    typed, rest = split_typed([{"MQ": ".", "DP": "bogus", "QD": "3.5"}])
+    assert rest[0] == {"MQ": ".", "DP": "bogus"}
+    assert typed["variantQualityByDepth"][0] == 3.5
+
+    vcf = tmp_path / "m.vcf"
+    vcf.write_text("\n".join([
+        "##fileformat=VCFv4.1",
+        "##contig=<ID=chr1,length=1000>",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "chr1\t11\t.\tA\tG\t50\tPASS\tMQ=.;DP=42;XX=1",
+    ]) + "\n")
+    out = str(tmp_path / "g.adam")
+    GenotypeDataset.load(str(vcf)).save(out)  # must not raise on 'MQ=.'
+    v, _g, _sd = pio.load_genotypes(out, projection=["annotations"])
+    assert v.sidecar.info[0] == {"MQ": ".", "DP": "42", "XX": "1"}

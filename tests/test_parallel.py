@@ -262,3 +262,159 @@ def test_host_shuffle_bam_to_shards(tmp_path):
             assert starts.min() > prev_max - 60_000_000 // 4
             prev_max = max(prev_max, int(starts.max()))
     assert total == 6000
+
+
+def test_two_process_composed_transform(tmp_path):
+    """The COMPOSED flagship transform across two real OS processes over
+    a shared raw shard store — summaries/candidates exchange via spill
+    files, observation tables merge with a cross-process device psum —
+    must equal the monolithic single-process transform bit-for-bit on
+    the output keys (SURVEY §2.6: the reference's whole execution model
+    is this exchange, via Spark)."""
+    import socket
+    import subprocess
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+    from make_wgs_sam import make_wgs
+
+    from adam_tpu.io import context
+    from adam_tpu.io.sam import iter_sam_batches
+    from adam_tpu.parallel import host_shuffle
+
+    sam = str(tmp_path / "in.sam")
+    make_wgs(sam, 3000, 100, n_contigs=2, contig_len=30_000)
+
+    shard_dir = str(tmp_path / "shards")
+    host_shuffle.shuffle_alignments_to_shards(
+        iter_sam_batches(sam, batch_reads=1024), 4, shard_dir, fmt="raw"
+    )
+
+    # monolithic expectation
+    mono = (
+        context.load_alignments(sam)
+        .mark_duplicates()
+        .recalibrate_base_qualities()
+        .realign_indels()
+    )
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    coord = f"localhost:{port}"
+    harness = str(pathlib.Path(__file__).parent / "multihost_harness.py")
+    out_dir = str(tmp_path / "out.adam")
+    os.makedirs(out_dir, exist_ok=True)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, harness, coord, "2", str(pid), "transform",
+             shard_dir, out_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=dict(os.environ),
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert "HARNESS OK" in out, f"proc {pid} output:\n{out[-3000:]}"
+
+    got = context.load_alignments(out_dir)
+
+    def keyed(d):
+        b = d.batch.to_numpy()
+        rows = []
+        for i in range(b.n_rows):
+            if not b.valid[i]:
+                continue
+            nc = int(b.cigar_n[i])
+            rows.append((
+                d.sidecar.names[i],
+                int(b.flags[i]),
+                int(b.start[i]),
+                tuple(b.cigar_lens[i, :nc].tolist()),
+                tuple(b.cigar_ops[i, :nc].tolist()),
+                b.bases[i, : int(b.lengths[i])].tobytes(),
+                int(b.quals[i, : int(b.lengths[i])].sum()),
+                d.sidecar.md[i],
+            ))
+        return sorted(rows)
+
+    assert len(got) == len(mono)
+    assert keyed(got) == keyed(mono)
+
+
+def test_capacity_bound_overflow_and_skew_split(mesh):
+    """Stress the capacity-bounded all_to_all at realistic shapes with
+    pathological skew: >=256 rows/device of IDENTICAL k-mers routes
+    every key to one shard, overflowing the slack capacity — the
+    dropped counter must fire and the exact-capacity retry must still
+    produce exact counts.  Ditto the row-carrying distributed sort, and
+    the empty-target -1 - start/3000 skew split must actually spread."""
+    import jax.numpy as jnp
+
+    from adam_tpu.formats.batch import ReadBatch, pack_reads
+    from adam_tpu.parallel.dist import (
+        _distributed_kmers_jit,
+        _route_all_to_all,
+        distributed_count_kmers,
+        pad_batch_for_mesh,
+    )
+
+    n_dev = mesh.devices.size
+    n, L, k = 256 * n_dev, 32, 21
+    # every read is poly-A: every k-mer is the SAME key, so every source
+    # shard routes its entire send to one destination — the per-(source,
+    # dest) capacity bound must overflow
+    seq = "A" * L
+    recs = [
+        dict(name=f"r{i}", flags=0, contig_idx=0, start=i, mapq=60,
+             cigar=f"{L}M", seq=seq, qual="I" * L, md=str(L))
+        for i in range(n)
+    ]
+    batch, _side = pack_reads(recs)
+
+    padded = pad_batch_for_mesh(batch, n_dev).to_device()
+    m = (padded.n_rows // n_dev) * (padded.lmax - k + 1)
+    cap = min(m, 4 * m // n_dev + 64)
+    _s, _c, _h, dropped = _distributed_kmers_jit(
+        padded.bases, padded.lengths, padded.valid, k, mesh, cap
+    )
+    assert int(dropped) > 0, (
+        "skewed keys must overflow the slack capacity (the bound "
+        "never binding means the stress is not a stress)"
+    )
+    # the public API retries at exact capacity: counts must be exact
+    counts = distributed_count_kmers(batch, k, mesh=mesh)
+    total = sum(counts.values())
+    assert total == n * (L - k + 1)
+    assert max(counts.values()) >= n  # the skewed keys all counted
+
+    # row-carrying distributed sort under the same skew (all-equal keys)
+    from adam_tpu.parallel.dist import distributed_sort_keys
+
+    keys = jnp.zeros(n, jnp.int64)  # maximal skew: one destination
+    out = np.asarray(distributed_sort_keys(keys, mesh)).ravel()
+    real = out[out != np.iinfo(np.int64).max]
+    assert len(real) == n and (real == 0).all()
+
+    # empty-target skew split: unmatched reads spread over -1 - start/3000
+    from adam_tpu.pipelines import realign as ra
+
+    starts = np.arange(n, dtype=np.int64) * 500
+    tidx = ra.map_reads_to_targets_overlap(
+        np.zeros(n, np.int64), starts, starts + L,
+        np.ones(n, bool),
+        np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.int64),
+    )
+    assert (tidx < 0).all()
+    n_bins = len(np.unique(tidx))
+    assert n_bins == len(np.unique(-1 - starts // 3000))
+    assert n_bins >= n * 500 // 3000  # genuinely spread, not one bin
