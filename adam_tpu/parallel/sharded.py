@@ -169,8 +169,8 @@ def transform_sharded(
             if mark_duplicates:
                 summaries.append(md_mod.row_summary(ds))
             if realign:
-                events.extend(
-                    realign_mod.extract_indel_events(
+                events.append(
+                    realign_mod.extract_indel_event_arrays(
                         ds.batch.to_numpy(), max_indel_size=mis
                     )
                 )
@@ -190,7 +190,11 @@ def transform_sharded(
                 off += n
             del summaries
         targets = (
-            realign_mod.merge_events(events, header.seq_dict.names, mts)
+            realign_mod.merge_events(
+                np.concatenate(events, axis=0) if events
+                else np.zeros((0, 5), np.int64),
+                header.seq_dict.names, mts,
+            )
             if realign
             else []
         )

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Compare two alignment outputs: mapq/baseq histograms, duplicate-flag
+mismatches, position concordance.
+
+Analog of the reference's ``adam-scripts/R/plots.R``, which charts
+mapq/base-quality distributions, duplicate-marking mismatches and
+position agreement between two pipeline runs (e.g. ADAM vs GATK).  Here
+the same four comparisons read any two outputs this framework can load
+(SAM/BAM/ADAM Parquet) and print binned tables; pass ``--png PREFIX``
+to also render bar charts when matplotlib is available.
+
+Usage: compare-plots.py <A> <B> [--png PREFIX]
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+def histo(values, splits):
+    """Counts binned as plots.R's splitby: <=s0, (s0,s1], ..., >last.
+
+    np.histogram bins are left-closed, so nudge the finite edges up by
+    0.5 (values are integers) to get the right-closed buckets the
+    labels describe — mapq 0 must land in '< 1', not '1 - 10'."""
+    edges = [-np.inf] + [s + 0.5 for s in splits] + [np.inf]
+    counts, _ = np.histogram(values, bins=np.array(edges, float))
+    names = [f"< {splits[0] + 1}"]
+    for prev, cur in zip(splits, splits[1:]):
+        names.append(str(cur) if prev + 1 == cur else f"{prev + 1} - {cur}")
+    names.append(f"> {splits[-1]}")
+    return names, counts
+
+
+def main(argv):
+    if len(argv) < 3:
+        sys.stderr.write("Usage: compare-plots.py <A> <B> [--png PREFIX]\n")
+        return 1
+    png = None
+    if "--png" in argv:
+        png = argv[argv.index("--png") + 1]
+
+    from adam_tpu.io import context
+
+    out = {}
+    sides = {}
+    for label, path in (("A", argv[1]), ("B", argv[2])):
+        ds = context.load_alignments(path)
+        b = ds.batch.to_numpy()
+        valid = np.asarray(b.valid)
+        sides[label] = (ds, b, valid)
+        mapq = np.asarray(b.mapq)[valid]
+        inlen = (
+            np.arange(b.lmax)[None, :]
+            < np.asarray(b.lengths)[valid][:, None]
+        )
+        quals = np.asarray(b.quals)[valid][inlen]
+        out[label] = (mapq, quals)
+
+    mq_splits = [0, 10, 20, 30, 40, 50, 60]
+    bq_splits = [2, 10, 20, 30, 40]
+    tables = {}
+    for metric, idx, splits in (
+        ("mapq", 0, mq_splits), ("baseq", 1, bq_splits)
+    ):
+        print(f"== {metric} ==")
+        print("bin\tA\tB")
+        na, ca = histo(out["A"][idx], splits)
+        _nb, cb = histo(out["B"][idx], splits)
+        for name, a, bcount in zip(na, ca, cb):
+            print(f"{name}\t{a}\t{bcount}")
+        tables[metric] = (na, ca, cb)
+
+    # duplicate-flag mismatch + position concordance, keyed by read name
+    def keyed(label):
+        ds, b, valid = sides[label]
+        names = ds.sidecar.names
+        flags = np.asarray(b.flags)
+        start = np.asarray(b.start)
+        return {
+            (names[i], int(flags[i]) & 0xC0): (
+                bool(flags[i] & 0x400), int(start[i])
+            )
+            for i in np.flatnonzero(valid)
+        }
+
+    ka, kb = keyed("A"), keyed("B")
+    common = set(ka) & set(kb)
+    dup_mismatch = sum(1 for k in common if ka[k][0] != kb[k][0])
+    pos_mismatch = sum(1 for k in common if ka[k][1] != kb[k][1])
+    print("== concordance ==")
+    print(f"common reads\t{len(common)}")
+    print(f"only in A\t{len(ka) - len(common)}")
+    print(f"only in B\t{len(kb) - len(common)}")
+    print(f"duplicate-flag mismatches\t{dup_mismatch}")
+    print(f"position mismatches\t{pos_mismatch}")
+
+    if png:
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            for metric, (names, ca, cb) in tables.items():
+                fig, ax = plt.subplots(figsize=(7, 4))
+                x = np.arange(len(names))
+                ax.bar(x - 0.2, ca, 0.4, label="A")
+                ax.bar(x + 0.2, cb, 0.4, label="B")
+                ax.set_xticks(x, names, rotation=45)
+                ax.set_title(metric)
+                ax.legend()
+                fig.tight_layout()
+                fig.savefig(f"{png}-{metric}.png", dpi=120)
+            print(f"wrote {png}-{{mapq,baseq}}.png")
+        except ImportError:
+            sys.stderr.write("matplotlib unavailable; tables only\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

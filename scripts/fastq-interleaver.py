@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Interleave two paired FASTQ files record-by-record.
+
+Analog of the reference's ``scripts/fastq-interleaver.py``: reads one
+4-line record from each file in turn, emitting the mate whose name sorts
+first consistently (the reference determines the order once from the
+first record pair and keeps it), and fails loudly on a truncated record
+or mismatched file lengths.
+
+The columnar framework reads the result with ``-force_load_ifastq`` /
+``io/fastq.py``'s interleaved codec; this standalone script exists for
+parity with the reference's tooling and for preparing inputs outside
+the framework.
+"""
+
+import sys
+
+
+def get_one(f):
+    first = f.readline()
+    if not first:
+        return None
+    rec = [first]
+    for _ in range(3):
+        line = f.readline()
+        if not line:
+            raise SystemExit("File ended in the middle of a fastq record")
+        rec.append(line)
+    return rec
+
+
+def main(argv):
+    if len(argv) != 3:
+        sys.stderr.write("Usage: fastq-interleaver <fastq_1> <fastq_2>\n")
+        return 1
+    with open(argv[1]) as f1, open(argv[2]) as f2:
+        file1_first = False
+        order_determined = False
+        while True:
+            r1 = get_one(f1)
+            r2 = get_one(f2)
+            if r1 is None and r2 is None:
+                return 0
+            if r1 is None or r2 is None:
+                raise SystemExit("Input files have different record counts")
+            if not order_determined:
+                file1_first = r1[0] <= r2[0]
+                order_determined = True
+            first, second = (r1, r2) if file1_first else (r2, r1)
+            sys.stdout.write("".join(first))
+            sys.stdout.write("".join(second))
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv) or 0)

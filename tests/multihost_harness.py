@@ -130,7 +130,10 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
     from adam_tpu.pipelines.streamed import _write_part
 
     mesh = genome_mesh(jax.devices())
-    shard_paths = sorted(globmod.glob(os.path.join(shard_dir, "*.arrows")))
+    # only real shards: the candidate spills below also live here
+    shard_paths = sorted(
+        globmod.glob(os.path.join(shard_dir, "shard-*.arrows"))
+    )
     mine = [si for si in range(len(shard_paths)) if si % n_procs == pid]
 
     def load(si):

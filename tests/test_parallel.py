@@ -363,7 +363,6 @@ def test_capacity_bound_overflow_and_skew_split(mesh):
     from adam_tpu.formats.batch import ReadBatch, pack_reads
     from adam_tpu.parallel.dist import (
         _distributed_kmers_jit,
-        _route_all_to_all,
         distributed_count_kmers,
         pad_batch_for_mesh,
     )
