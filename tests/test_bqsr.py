@@ -155,6 +155,23 @@ def test_known_sites_native_masking_matches_python(ref_resources):
     assert native_tab.total.sum() < unmasked.total.sum()
 
 
+def _observe_read_ok(b, has_md):
+    """The _observe_device canonical-read mask (bqsr.py), test-side
+    single copy for the differential tests."""
+    flags = np.asarray(b.flags)
+    return (
+        np.asarray(b.valid)
+        & ((flags & schema.FLAG_UNMAPPED) == 0)
+        & ((flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0)
+        & ((flags & schema.FLAG_DUPLICATE) == 0)
+        & ((flags & schema.FLAG_FAILED_QC) == 0)
+        & np.asarray(b.has_qual)
+        & (np.asarray(b.mapq) > 0)
+        & (np.asarray(b.mapq) != 255)
+        & has_md
+    )
+
+
 def test_inline_md_observe_matches_tokenized_mask(ref_resources):
     """The native walk's inline MD parse must produce the same histograms
     as feeding it the host-tokenized [N, L] mismatch mask."""
@@ -170,23 +187,56 @@ def test_inline_md_observe_matches_tokenized_mask(ref_resources):
     b = ds.batch.to_numpy()
     is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar,
                                        need_ref_codes=False)
-    flags = np.asarray(b.flags)
-    read_ok = (
-        np.asarray(b.valid)
-        & ((flags & schema.FLAG_UNMAPPED) == 0)
-        & ((flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0)
-        & ((flags & schema.FLAG_DUPLICATE) == 0)
-        & ((flags & schema.FLAG_FAILED_QC) == 0)
-        & np.asarray(b.has_qual)
-        & (np.asarray(b.mapq) > 0)
-        & (np.asarray(b.mapq) != 255)
-        & has_md
-    )
+    read_ok = _observe_read_ok(b, has_md)
     t2, m2 = native.bqsr_observe(
         b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
         b.cigar_ops, b.cigar_lens, b.cigar_n, None, is_mm, read_ok,
         len(ds.read_groups) + 1, grid_cols(b.lmax),
         contig_idx=b.contig_idx, start=b.start,
     )
+    np.testing.assert_array_equal(np.asarray(t1), t2)
+    np.testing.assert_array_equal(np.asarray(m1), m2)
+
+
+def test_inline_md_observe_matches_tokenized_mask_wgs(tmp_path):
+    """Same differential on WGS-shaped data (indels, soft clips, dense
+    SNP/indel planting) with known-site masking active."""
+    import os
+    import sys
+
+    from adam_tpu import native
+    from adam_tpu.api.datasets import GenotypeDataset
+    from adam_tpu.formats.batch import grid_cols
+    from adam_tpu.ops.mdtag import batch_md_arrays
+    from adam_tpu.pipelines import bqsr as bq
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+    )
+    from make_wgs_sam import make_wgs
+
+    sam = str(tmp_path / "w.sam")
+    vcf = str(tmp_path / "w.vcf")
+    make_wgs(sam, 4096, 100, n_contigs=2, contig_len=40_000,
+             indel_every=800, snp_every=400, known_sites_out=vcf)
+    ds = load_alignments(sam)
+    known = GenotypeDataset.load(
+        vcf, contig_names=ds.seq_dict.names
+    ).snp_table()
+    t1, m1, _, gl = bq._observe_device(ds, known)
+    b = ds.batch.to_numpy()
+    is_mm, _, has_md = batch_md_arrays(ds.batch, ds.sidecar,
+                                       need_ref_codes=False)
+    read_ok = _observe_read_ok(b, has_md)
+    t2, m2 = native.bqsr_observe(
+        b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
+        b.cigar_ops, b.cigar_lens, b.cigar_n, None, is_mm, read_ok,
+        len(ds.read_groups) + 1, grid_cols(b.lmax),
+        contig_idx=b.contig_idx, start=b.start,
+        snp_keys=known.site_keys(ds.seq_dict.names),
+    )
+    assert int(t2.sum()) > 0 and int(m2.sum()) > 0
     np.testing.assert_array_equal(np.asarray(t1), t2)
     np.testing.assert_array_equal(np.asarray(m1), m2)

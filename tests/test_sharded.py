@@ -143,3 +143,86 @@ def test_raw_shard_round_trip_is_writable(tmp_path):
         np.asarray(b.start),
         np.asarray(ds.batch.start)[np.asarray(ds.batch.valid)],
     )
+
+
+def test_raw_shard_round_trip_fuzz(tmp_path):
+    """Randomized round-trip of the raw spill: mixed read lengths,
+    mixed cigar widths across appends, absent MD/attrs, '*'-qual rows —
+    every column must survive bit-for-bit."""
+    from adam_tpu.formats.batch import pack_reads
+    from adam_tpu.io.sam import SamHeader
+    from adam_tpu.models.dictionaries import (
+        SequenceDictionary,
+        SequenceRecord,
+    )
+    from adam_tpu.parallel import spill
+
+    rng = np.random.default_rng(11)
+    header = SamHeader(
+        seq_dict=SequenceDictionary((SequenceRecord("c1", 10_000),))
+    )
+    p = str(tmp_path / "f.arrows")
+    w = spill.RawShardWriter(p)
+    all_recs = []
+    for part, L in ((37, 80), (23, 120)):  # widths differ across appends
+        recs = []
+        for i in range(part):
+            ln = int(rng.integers(30, L + 1))
+            seq = "".join("ACGTN"[c] for c in rng.integers(0, 5, ln))
+            recs.append(dict(
+                name=f"r{L}_{i}",
+                flags=int(rng.choice([0, 16, 1024, 99])),
+                contig_idx=0,
+                start=int(rng.integers(0, 5000)),
+                mapq=int(rng.integers(0, 61)),
+                cigar=(
+                    f"{ln}M" if i % 3 else (
+                        f"5S{ln - 5}M" if L == 80
+                        else f"3S4M2I5M2D{ln - 14}M"
+                    )
+                ),
+                seq=seq,
+                qual="*" if i % 7 == 0 else "".join(
+                    chr(33 + q) for q in rng.integers(2, 41, ln)
+                ),
+                md=None if i % 5 == 0 else str(ln),
+                attrs=None if i % 4 == 0 else f"NM:i:{i}",
+            ))
+        batch, side = pack_reads(recs)
+        w.append(batch, side, header)
+        all_recs.extend(recs)
+    w.close()
+    b, side, h2 = spill.read_raw_shard(p)
+    assert b.n_rows == len(all_recs)
+    assert h2.seq_dict.names == ["c1"]
+    from adam_tpu.formats import schema
+    from adam_tpu.ops.mdtag import parse_cigar
+
+    for i, r in enumerate(all_recs):
+        assert side.names[i] == r["name"]
+        assert side.md[i] == r["md"]
+        # absent attrs may round-trip as either None or ""
+        assert (side.attrs[i] or None) == (r["attrs"] or None)
+        assert int(b.flags[i]) == r["flags"]
+        assert int(b.start[i]) == r["start"]
+        assert int(b.contig_idx[i]) == 0
+        assert int(b.mapq[i]) == r["mapq"]
+        ln = len(r["seq"])
+        assert int(b.lengths[i]) == ln
+        assert schema.decode_bases(b.bases[i], ln) == r["seq"]
+        assert bool(b.has_qual[i]) == (r["qual"] != "*")
+        # quals content (mixed widths across appends pad with QUAL_PAD)
+        if r["qual"] != "*":
+            got_q = (b.quals[i, :ln] + schema.SANGER_OFFSET).tobytes()
+            assert got_q == r["qual"].encode()
+        # cigar columns survive the i32 pad branch
+        exp = parse_cigar(r["cigar"])
+        nc = int(b.cigar_n[i])
+        assert [
+            (int(b.cigar_lens[i, k]),
+             schema.CIGAR_CHARS[b.cigar_ops[i, k]])
+            for k in range(nc)
+        ] == exp
+        assert int(b.end[i]) == r["start"] + sum(
+            n for n, op in exp if op in "MDN=X"
+        )
