@@ -792,6 +792,19 @@ void bqsr_observe(
       int64_t initial = rev ? (second ? -L : L) : (second ? -1 : 1);
       int64_t inc = rev ? (second ? 1 : -1) : (second ? -1 : 1);
       int32_t rg = rg_idx[i] >= 0 && rg_idx[i] < n_rg ? rg_idx[i] : n_rg - 1;
+      // per-read SNP window: one binary search to the first site key at
+      // or past this read's start, then a merge pointer over the
+      // ascending refp walk — O(1) amortized per residue instead of a
+      // log2(n_snps) search at every aligned base
+      const int64_t* snp_it = nullptr;
+      const int64_t* snp_end = nullptr;
+      if (mask_snps && !rok) {
+        int64_t key0 =
+            (int64_t(contig_idx ? contig_idx[i] : 0) << 40) |
+            (start ? start[i] : 0);
+        snp_end = snp_keys + n_snps;
+        snp_it = std::lower_bound(snp_keys, snp_end, key0);
+      }
       if (!rok || !mm) {
         // mark query positions consumed by reference-aligned ops (M/=/X),
         // recording each one's reference position for SNP masking
@@ -830,9 +843,8 @@ void bqsr_observe(
             int64_t key =
                 (int64_t(contig_idx ? contig_idx[i] : 0) << 40) |
                 refp[size_t(j)];
-            const int64_t* e = snp_keys + n_snps;
-            const int64_t* it = std::lower_bound(snp_keys, e, key);
-            if (it != e && *it == key) continue;
+            while (snp_it != snp_end && *snp_it < key) ++snp_it;
+            if (snp_it != snp_end && *snp_it == key) continue;
           }
         }
         int64_t cyc = initial + inc * j + gl;

@@ -233,7 +233,7 @@ def test_inline_md_observe_matches_tokenized_mask_wgs(tmp_path):
     t2, m2 = native.bqsr_observe(
         b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
         b.cigar_ops, b.cigar_lens, b.cigar_n, None, is_mm, read_ok,
-        len(ds.read_groups) + 1, grid_cols(b.lmax),
+        len(ds.read_groups) + 1, gl,
         contig_idx=b.contig_idx, start=b.start,
         snp_keys=known.site_keys(ds.seq_dict.names),
     )
