@@ -247,16 +247,16 @@ def transform_sharded(
                     _cache_total[0] -= ev[1]
                 if table is not None:
                     ds = bqsr_mod.apply_recalibration(ds, table, gl)
+                n_valid = ds.batch.n_rows
                 if targets:
-                    b = ds.batch.to_numpy()
-                    tidx = realign_mod.map_batch_to_targets(
-                        b, targets, header.seq_dict.names
+                    cand, ds, n_valid = (
+                        realign_mod.split_realign_candidates(
+                            ds, targets, header.seq_dict.names
+                        )
                     )
-                    cand = tidx >= 0
-                    if cand.any():
-                        candidates.append(ds.take_rows(np.flatnonzero(cand)))
-                        ds = ds.take_rows(np.flatnonzero(~cand))
-                if ds.batch.n_rows:
+                    if cand is not None:
+                        candidates.append(cand)
+                if n_valid:
                     _submit_write(si, ds)
             stats["apply_split_s"] = time.perf_counter() - t
 

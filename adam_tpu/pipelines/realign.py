@@ -1757,3 +1757,25 @@ def warm_sweep_shapes(offs=(512, 1024, 2048, 4096), rts=(16, 128),
             jax.block_until_ready(bq)
             n += 1
     return n
+
+
+def split_realign_candidates(ds, targets, names):
+    """Split a window/shard into (candidates, writable remainder).
+
+    Candidate rows (mapped to a realignment target) gather into a new
+    dataset; the ~87%% keep-side majority is returned MASKED (valid
+    cleared) rather than copied — the Parquet encoder's own row gather
+    filters it once at write time.  Shared by the streamed and sharded
+    pipelines so their split semantics cannot diverge.  Returns
+    (candidates-or-None, remainder, n_remaining_valid)."""
+    b = ds.batch.to_numpy()
+    tidx = map_batch_to_targets(b, targets, names)
+    cand = tidx >= 0
+    if cand.any():
+        candidates = ds.take_rows(np.flatnonzero(cand))
+        ds = ds.with_batch(
+            b.replace(valid=np.asarray(b.valid) & ~cand)
+        )
+    else:
+        candidates = None
+    return candidates, ds, int(np.asarray(ds.batch.valid).sum())

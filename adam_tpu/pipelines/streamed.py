@@ -231,19 +231,15 @@ def transform_streamed(
         for i, w in enumerate(windows):
             if table is not None:
                 w = bqsr_mod.apply_recalibration(w, table, gl)
+            n_valid = w.batch.n_rows
             if targets:
-                b = w.batch.to_numpy()
-                tidx = realign_mod.map_batch_to_targets(
-                    b, targets, header.seq_dict.names
+                cand, w, n_valid = realign_mod.split_realign_candidates(
+                    w, targets, header.seq_dict.names
                 )
-                cand = tidx >= 0
-                if cand.any():
-                    rows = np.flatnonzero(cand)
-                    candidates.append(w.take_rows(rows))
-                    keep = np.flatnonzero(~cand)
-                    w = w.take_rows(keep)
+                if cand is not None:
+                    candidates.append(cand)
             windows[i] = None  # free as we go
-            if w.batch.n_rows:
+            if n_valid:
                 futures.append(
                     pool.submit(_write_part, out_path, i, w, compression)
                 )
