@@ -147,6 +147,14 @@ class StringColumn:
         n = len(self)
         lens = self.lengths()
         w = max(1, int(lens.max()) if n else 1)
+        if n and self.offsets[-1]:
+            from adam_tpu import native
+
+            mat = native.span_gather_strided(
+                self.buf, self.offsets[:-1], lens, w
+            )
+            if mat is not None:
+                return mat.view(f"S{w}").ravel()
         mat = np.zeros((n, w), dtype=np.uint8)
         if n and self.offsets[-1]:
             flat = _span_gather_indices(self.offsets[:-1], lens)

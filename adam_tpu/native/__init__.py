@@ -197,6 +197,9 @@ def _lib() -> Optional[ct.CDLL]:
                 ct.c_int64, _u8p, ct.c_int64, ct.c_int,
             ]
             lib.span_gather.argtypes = [_u8p, _i64p, _i64p, ct.c_int64, _u8p]
+            lib.span_gather_strided.argtypes = [
+                _u8p, _i64p, _i64p, ct.c_int64, ct.c_int64, _u8p,
+            ]
             lib.realign_prep.restype = ct.c_void_p
             lib.realign_prep.argtypes = [
                 _u8p, _u8p, ct.c_int64, ct.c_int64,            # bases/quals/N/L
@@ -868,6 +871,19 @@ def cigar_strings(cigar_ops, cigar_lens, cigar_n):
     return out[:got], offsets
 
 
+
+
+def _spans_in_bounds(starts: np.ndarray, lens: np.ndarray, size: int) -> bool:
+    """Corrupt-offset guard shared by the span gather wrappers: negative
+    lens from non-monotonic offsets would otherwise overflow out buffers."""
+    if not len(starts):
+        return True
+    return (
+        int((starts + lens).max()) <= size
+        and int(starts.min()) >= 0
+        and int(lens.min()) >= 0
+    )
+
 def span_gather(src: np.ndarray, starts: np.ndarray, lens: np.ndarray,
                 total: int):
     """Packed gather of byte spans [starts[i], starts[i]+lens[i]) ->
@@ -879,15 +895,8 @@ def span_gather(src: np.ndarray, starts: np.ndarray, lens: np.ndarray,
     src = np.ascontiguousarray(src, np.uint8)
     starts = np.ascontiguousarray(starts, np.int64)
     lens = np.ascontiguousarray(lens, np.int64)
-    if len(starts) and (
-        int((starts + lens).max()) > src.size
-        or int(starts.min()) < 0
-        or int(lens.min()) < 0
-    ):
-        # corrupt offsets: preserve the numpy path's fail-safe error
-        # instead of memcpy'ing out of bounds (negative lens from
-        # non-monotonic offsets would otherwise overflow the out buffer)
-        return None
+    if not _spans_in_bounds(starts, lens, src.size):
+        return None  # corrupt offsets: numpy path's fail-safe instead
     out = np.empty(int(total), np.uint8)
     lib.span_gather(
         _u8_ptr(src), starts.ctypes.data_as(_i64p),
@@ -1052,3 +1061,27 @@ def md_move_batch(b, rows, ref_buf, ref_off, tloc, offs,
             return out[:got], out_off
         cap = -got
     return None
+
+
+def span_gather_strided(src: np.ndarray, starts: np.ndarray,
+                        lens: np.ndarray, w: int):
+    """Gather byte spans into a zero-padded [n, w] matrix (row-strided);
+    None if native unavailable.  StringColumn.to_fixed_bytes hot path."""
+    lib = _lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, np.uint8)
+    starts = np.ascontiguousarray(starts, np.int64)
+    lens = np.ascontiguousarray(lens, np.int64)
+    n = len(starts)
+    if not _spans_in_bounds(starts, lens, src.size) or (
+        n and int(lens.max()) > w
+    ):
+        return None  # corrupt offsets: preserve the numpy fail-safe
+    out = np.zeros((n, int(w)), np.uint8)
+    lib.span_gather_strided(
+        _u8_ptr(src), starts.ctypes.data_as(_i64p),
+        lens.ctypes.data_as(_i64p), ct.c_int64(n), ct.c_int64(int(w)),
+        _u8_ptr(out),
+    )
+    return out

@@ -1996,4 +1996,16 @@ void span_gather(const uint8_t* src, const int64_t* starts,
   }
 }
 
+// Strided variant: row i's span lands at out + i*w (rows pre-zeroed by
+// the caller) — the StringColumn.to_fixed_bytes layout for np.unique
+// grouping, one memcpy per row instead of three fancy-index passes.
+void span_gather_strided(const uint8_t* src, const int64_t* starts,
+                         const int64_t* lens, int64_t n, int64_t w,
+                         uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t l = lens[i];
+    if (l > 0) memcpy(out + i * w, src + starts[i], size_t(l));
+  }
+}
+
 }  // extern "C"

@@ -140,3 +140,33 @@ def test_load_alignments_fasta_and_contig_parquet(tmp_path, ref_resources):
     ds2 = context.load_alignments(str(store))
     b2 = ds2.batch.to_numpy()
     assert int(np.asarray(b2.lengths)[np.asarray(b2.valid)].sum()) == total
+
+
+def test_to_fixed_bytes_native_matches_numpy():
+    """The native strided gather must produce the same S-array as the
+    numpy scatter path (nulls, empties, ragged widths included)."""
+    import numpy as np
+
+    from adam_tpu import native
+    from adam_tpu.formats.strings import StringColumn
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    col = StringColumn.from_list(
+        ["abc", None, "", "a", "zzzzzzzz", "mid", None, "yy"]
+    )
+    # the native path must actually run for this parity check
+    assert native.span_gather_strided(
+        col.buf, col.offsets[:-1], col.lengths(), 8
+    ) is not None
+    fb = col.to_fixed_bytes()
+    orig = native.span_gather_strided
+    try:
+        native.span_gather_strided = lambda *a, **k: None
+        fb2 = col.to_fixed_bytes()
+    finally:
+        native.span_gather_strided = orig
+    np.testing.assert_array_equal(fb, fb2)
+    assert fb[0] == b"abc" and fb[4] == b"zzzzzzzz" and fb[2] == b""
