@@ -209,8 +209,12 @@ def save_alignments(
     path: str, batch: ReadBatch, side: ReadSidecar, header: SamHeader,
     compression: str = "snappy",
 ) -> None:
-    table = to_arrow_alignments(batch, side, header)
-    pq.write_table(table, path, compression=compression)
+    from adam_tpu.utils import instrumentation as ins
+
+    with ins.TIMERS.time(ins.PARQUET_ENCODE):
+        table = to_arrow_alignments(batch, side, header)
+    with ins.TIMERS.time(ins.PARQUET_WRITE):
+        pq.write_table(table, path, compression=compression)
 
 
 def load_alignments(

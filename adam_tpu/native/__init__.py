@@ -22,12 +22,34 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from adam_tpu.utils import instrumentation as _instr
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "adamtok.cpp")
 _SRC_REALIGN = os.path.join(_DIR, "realign.cpp")
 _LOCK = threading.Lock()
 _LIB: Optional[ct.CDLL] = None
 _LOAD_FAILED = False
+
+
+def _timed(timer_name: str):
+    """Record a native dispatch under the instrumentation registry (the
+    InstrumentedOutputFormat analog, rdd/ADAMRDDFunctions.scala:161-164):
+    no-op unless ``-print_metrics`` switched recording on."""
+
+    def deco(fn):
+        import functools
+
+        from adam_tpu.utils import instrumentation as _ins
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _ins.TIMERS.time(timer_name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 _i64p = ct.POINTER(ct.c_int64)
 _i32p = ct.POINTER(ct.c_int32)
@@ -281,6 +303,7 @@ def _str_dict(names: Sequence[str]) -> tuple[np.ndarray, np.ndarray]:
     return c.buf, c.offsets
 
 
+@_timed(_instr.TOKENIZE_INPUT)
 def tokenize_sam(data, body_off: int, contig_names: Sequence[str],
                  rg_names: Sequence[str]) -> Optional[dict]:
     """Tokenize SAM body lines into columnar arrays; None -> fall back."""
@@ -383,6 +406,7 @@ def _alloc_columns(n: int, L: int, C: int, nameb: int, tagb: int) -> dict:
     return out
 
 
+@_timed(_instr.BGZF_CODEC)
 def bgzf_decompress(data) -> Optional[bytes]:
     """Block-parallel BGZF decode; None if not BGZF / native unavailable."""
     lib = _lib()
@@ -404,6 +428,7 @@ def bgzf_decompress(data) -> Optional[bytes]:
         lib.bgzf_free(h)
 
 
+@_timed(_instr.BGZF_CODEC)
 def bgzf_decompress_partial(data) -> Optional[tuple[bytes, int]]:
     """Streaming-window BGZF decode: decompress the *complete* blocks in
     ``data`` -> (decompressed bytes, input bytes consumed); a truncated
@@ -428,6 +453,7 @@ def bgzf_decompress_partial(data) -> Optional[tuple[bytes, int]]:
         lib.bgzf_free(h)
 
 
+@_timed(_instr.BGZF_CODEC)
 def bgzf_compress(
     data, level: int = 6, block_size: int = 0xFF00
 ) -> Optional[bytes]:
@@ -452,6 +478,7 @@ def bgzf_compress(
     return out[: out_len.value].tobytes()
 
 
+@_timed(_instr.TOKENIZE_INPUT)
 def tokenize_bam(raw, records_off: int,
                  rg_names: Sequence[str],
                  partial: bool = False) -> Optional[dict]:
@@ -651,6 +678,7 @@ def _encode_prep(batch, side, rg_names: Sequence[str]):
     return n, args, base_cap, keep
 
 
+@_timed(_instr.SAM_ENCODE)
 def bam_encode(batch, side, rg_names: Sequence[str],
                n_refs: int) -> Optional[bytes]:
     """Encode a (ReadBatch, ReadSidecar) into the BAM record stream
@@ -676,6 +704,7 @@ def bam_encode(batch, side, rg_names: Sequence[str],
     return out[:got].tobytes()
 
 
+@_timed(_instr.SAM_ENCODE)
 def sam_encode(batch, side, rg_names: Sequence[str],
                contig_names: Sequence[str]) -> Optional[bytes]:
     """Format a (ReadBatch, ReadSidecar) as SAM text lines (no header);
@@ -702,6 +731,7 @@ def sam_encode(batch, side, rg_names: Sequence[str],
     return out[:got].tobytes()
 
 
+@_timed(_instr.APPLY_WALK)
 def bqsr_apply(bases, quals, lengths, flags, rg_idx, has_qual, valid,
                table_u8, gl: int):
     """Threaded host application of the BQSR recalibration table ->
@@ -729,6 +759,7 @@ def bqsr_apply(bases, quals, lengths, flags, rg_idx, has_qual, valid,
     return out
 
 
+@_timed(_instr.OBSERVE_WALK)
 def bqsr_observe(bases, quals, lengths, flags, rg_idx,
                  cigar_ops, cigar_lens, cigar_n,
                  residue_ok, is_mm, read_ok, n_rg: int, gl: int,
@@ -811,6 +842,7 @@ def bqsr_observe(bases, quals, lengths, flags, rg_idx,
     return total, mism
 
 
+@_timed(_instr.FASTQ_ENCODE)
 def fastq_encode(batch, side, select, add_suffix: bool) -> Optional[bytes]:
     """Format selected rows as FASTQ text; None -> python fallback."""
     lib = _lib()

@@ -274,4 +274,22 @@ def transform_streamed(
         raise write_errs[0]
     stats["write_wait_s"] = time.perf_counter() - t
     stats["total_s"] = time.perf_counter() - t_start
+
+    # Mirror the stage walls into the named-timer registry so
+    # ``-print_metrics`` decomposes the streamed flagship the way the
+    # reference's Metrics listener decomposes a Spark job (stage rows on
+    # top, the codec/write timers recorded inside tokenize/save below
+    # them sum to the same wall).
+    from adam_tpu.utils import instrumentation as ins
+
+    for key, label in (
+        ("ingest_pass_s", "Streamed Pass A (ingest + summaries)"),
+        ("resolve_s", "Streamed Barrier (dup resolve + targets)"),
+        ("observe_s", "Streamed Pass B (BQSR observe)"),
+        ("apply_split_s", "Streamed Pass C (apply + split)"),
+        ("realign_s", "Streamed Tail (realign)"),
+        ("write_wait_s", "Streamed Write Wait"),
+    ):
+        if key in stats:
+            ins.TIMERS.add(label, int(stats[key] * 1e9))
     return stats

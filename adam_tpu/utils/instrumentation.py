@@ -14,6 +14,7 @@ TPU additions: timers can wrap a ``jax.profiler`` trace
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -33,6 +34,10 @@ class Timer:
 class TimerRegistry:
     timers: dict = field(default_factory=dict)
     recording: bool = False
+    # Codec/write timers fire from the ingest thread and the writer pool
+    # concurrently (pipelines/streamed.py); a lock keeps the
+    # read-modify-write on Timer.total_ns from losing updates.
+    _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def timer(self, name: str) -> Timer:
         if name not in self.timers:
@@ -48,12 +53,26 @@ class TimerRegistry:
         try:
             yield
         finally:
+            dt = time.monotonic_ns() - t0
+            with self._lock:
+                t = self.timer(name)
+                t.total_ns += dt
+                t.count += 1
+
+    def add(self, name: str, ns: int) -> None:
+        """Accumulate an externally-measured duration under ``name``
+        (for stages whose wall is computed elsewhere, e.g. the streamed
+        pipeline's stats dict)."""
+        if not self.recording:
+            return
+        with self._lock:
             t = self.timer(name)
-            t.total_ns += time.monotonic_ns() - t0
+            t.total_ns += ns
             t.count += 1
 
     def reset(self) -> None:
-        self.timers.clear()
+        with self._lock:
+            self.timers.clear()
 
     def report(self) -> str:
         """Aggregated table, longest stages first (the Metrics printout)."""
@@ -82,6 +101,21 @@ TRIM_READS = "Trim Reads"
 FLAGSTAT = "Flag Stat"
 COUNT_KMERS = "Count Kmers"
 SAVE_OUTPUT = "Save Output"
+
+# Codec / IO-path timers — the per-output-format timing the reference
+# gets from InstrumentedOutputFormat (rdd/ADAMRDDFunctions.scala:161-164)
+# and the per-stage RDD instrumentation (rdd/ADAMContext.scala:158).
+# These fire inside the native tokenizer dispatch and the Parquet part
+# writers, so `-print_metrics` decomposes the ingest/encode/write share
+# of a command's wall time.
+TOKENIZE_INPUT = "Tokenize Input (native)"
+BGZF_CODEC = "BGZF Codec (native)"
+PARQUET_ENCODE = "Parquet Encode"
+PARQUET_WRITE = "Write ADAM Record (part file)"
+SAM_ENCODE = "Write SAM/BAM Record (encode)"
+FASTQ_ENCODE = "Write FASTQ Record (encode)"
+OBSERVE_WALK = "BQSR Observe Walk (native)"
+APPLY_WALK = "BQSR Apply Walk (native)"
 
 
 @contextlib.contextmanager
