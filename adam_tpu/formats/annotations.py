@@ -10,9 +10,9 @@ and therefore here too).
 
 Here the typed fields land as real typed Parquet columns
 (``ann_<adamKey>``) in the variants store written by ``anno2adam``:
-floats stay float32 columns, ints int32, flags bool — so predicate
-pushdown works on them — and ``adam2vcf`` restores the original VCF
-keys on the way out.  Unknown INFO keys keep riding the generic string
+floats stay float64 columns (value-exact VCF round trips), ints int64,
+flags bool — so predicate pushdown works on them — and ``adam2vcf``
+restores the original VCF keys on the way out.  Unknown INFO keys keep riding the generic string
 map, as in the reference (the attributes catch-all).
 """
 
@@ -120,14 +120,24 @@ def merge_typed(typed: Optional[dict], info_dicts: list[dict]) -> list[dict]:
         vcf_key = _ADAM_TO_VCF.get(adam, adam)
         _a, typ = ANNOTATION_KEYS.get(vcf_key, (adam, str))
         for i, v in enumerate(col):
-            if v is None or (isinstance(v, float) and np.isnan(v)):
+            if v is None or (
+                isinstance(v, (float, np.floating)) and np.isnan(v)
+            ):
                 continue
             if typ is bool:
                 if v:
                     out[i][vcf_key] = True
                 continue
             if typ is float:
-                out[i][vcf_key] = f"{float(v):g}"
+                # shortest value-exact digits, exponent form where
+                # appropriate ('%g' truncated to 6 significant digits:
+                # VQSLOD 1234.5678 -> "1234.57").  numpy scalars format
+                # at their own width so legacy float32 columns don't
+                # emit widening noise.
+                out[i][vcf_key] = (
+                    str(v) if isinstance(v, np.floating)
+                    else repr(float(v))
+                )
             else:
                 out[i][vcf_key] = str(v)
     return out
@@ -144,5 +154,7 @@ def arrow_type(adam_key: str):
     if typ is int:
         return pa.int64()
     if typ is float:
-        return pa.float32()
+        # float64 so the VCF string -> column -> VCF string round trip
+        # is value-exact (float32 storage dropped digits past ~7)
+        return pa.float64()
     return pa.string()

@@ -677,9 +677,16 @@ def load_genotypes(path: str, contig_names=None, projection=None,
         # merge back under their VCF keys
         from adam_tpu.formats.annotations import merge_typed
 
-        info = merge_typed(
-            {c[4:]: vt[c].to_pylist() for c in ann_cols}, info
-        )
+        cols = {}
+        for c in ann_cols:
+            vals = vt[c].to_pylist()
+            if vt.schema.field(c).type == pa.float32():
+                # legacy float32 store: keep the column's own precision
+                # so formatting doesn't emit float64-widening noise
+                # digits (2.31 -> "2.309999942779541")
+                vals = [None if v is None else np.float32(v) for v in vals]
+            cols[c[4:]] = vals
+        info = merge_typed(cols, info)
     side = vf.VariantSidecar(
         ref_allele=vt["referenceAllele"].to_pylist(),
         alt_allele=vt["alternateAllele"].to_pylist(),
@@ -727,10 +734,11 @@ def load_genotypes(path: str, contig_names=None, projection=None,
                 filters if isinstance(filters, pc.Expression)
                 else pq.filters_to_expression(filters)
             )
-            all_names = pq.read_schema(v_path).names
-            expr_repr = str(expr)
-            ref_cols = [c for c in all_names if c in expr_repr] or None
-            full = pq.read_table(v_path, columns=ref_cols)
+            # pyarrow has no public API for an Expression's referenced
+            # fields, and guessing them from str(expr) mis-selects when a
+            # column name collides with a string literal in the
+            # predicate — a legacy store is rare enough to read whole
+            full = pq.read_table(v_path)
             full = full.append_column(
                 "__row", pa.array(np.arange(full.num_rows, dtype=np.int64))
             )

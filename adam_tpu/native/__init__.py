@@ -13,6 +13,7 @@ ingest keeps pace with the TPU compute path.
 from __future__ import annotations
 
 import ctypes as ct
+import functools
 import hashlib
 import os
 import subprocess
@@ -38,13 +39,9 @@ def _timed(timer_name: str):
     no-op unless ``-print_metrics`` switched recording on."""
 
     def deco(fn):
-        import functools
-
-        from adam_tpu.utils import instrumentation as _ins
-
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with _ins.TIMERS.time(timer_name):
+            with _instr.TIMERS.time(timer_name):
                 return fn(*args, **kwargs)
 
         return wrapper

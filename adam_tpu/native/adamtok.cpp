@@ -781,10 +781,20 @@ void bqsr_observe(
       const uint8_t* rok = residue_ok ? residue_ok + i * lmax : nullptr;
       const uint8_t* mm = is_mm ? is_mm + i * lmax : nullptr;
       int64_t n_mm = 0, mp = 0;
-      if (!mm && md_buf && md_off)
+      if (!mm && md_buf && md_off) {
         n_mm = md_mismatch_offsets(md_buf + md_off[i],
                                    md_off[i + 1] - md_off[i], mm_ro.data(),
                                    int64_t(mm_ro.size()));
+        // count == cap means the scratch may have truncated a
+        // pathological MD tag; grow and re-parse rather than silently
+        // dropping tail mismatches from the histogram
+        while (n_mm == int64_t(mm_ro.size())) {
+          mm_ro.resize(mm_ro.size() * 2);
+          n_mm = md_mismatch_offsets(md_buf + md_off[i],
+                                     md_off[i + 1] - md_off[i],
+                                     mm_ro.data(), int64_t(mm_ro.size()));
+        }
+      }
       int64_t L = lengths[i];
       int32_t fl = flags[i];
       bool rev = fl & 0x10;

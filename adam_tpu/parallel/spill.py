@@ -132,6 +132,14 @@ def _rows_matrix(chunks, dtype, pad_value, item: int = 1):
         n = len(ch)
         if n == 0:
             continue
+        # the frombuffer reads below start at the buffers' position 0,
+        # which is only correct for unsliced chunks (all RawShardWriter
+        # output is); fail loudly rather than decode shifted garbage
+        if ch.offset != 0:
+            raise ValueError(
+                "_rows_matrix requires unsliced chunks (offset=0); got "
+                f"a chunk with offset {ch.offset}"
+            )
         buf = np.frombuffer(ch.buffers()[2], np.uint8,
                             ch.buffers()[2].size)
         off = np.frombuffer(ch.buffers()[1], np.int64, n + 1)

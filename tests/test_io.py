@@ -757,7 +757,8 @@ def test_typed_variant_annotations_round_trip(tmp_path):
         "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
         "chr1\t11\trs1\tA\tG\t50\tPASS\t"
         "PHYLOP=2.31;SIFT_PRED=D;SIFT_SCORE=0.02;AA=G;GENEINFO=BRCA1:672;"
-        "MQ=58.7;DP=42;QD=11.5;VQSLOD=3.2;culprit=MQ;NEGATIVE_TRAIN_SITE;"
+        "MQ=58.7;DP=42;QD=11.5;VQSLOD=1234.5678;culprit=MQ;"
+        "NEGATIVE_TRAIN_SITE;"
         "MYSTERY=7",
         "chr1\t21\trs2\tC\tT\t60\tPASS\tPHYLOP=-0.5;DP=10",
     ]) + "\n")
@@ -769,8 +770,9 @@ def test_typed_variant_annotations_round_trip(tmp_path):
     vt = pq.read_table(os.path.join(adam, "variants.parquet"))
     import pyarrow as pa
 
-    # typed columns with typed storage
-    assert vt.schema.field("ann_phylop").type == pa.float32()
+    # typed columns with typed storage (float64 so VQSLOD-style values
+    # round-trip value-exact through the column back to VCF text)
+    assert vt.schema.field("ann_phylop").type == pa.float64()
     assert vt.schema.field("ann_readDepth").type == pa.int64()
     assert vt.schema.field("ann_usedForNegativeTrainingSet").type == pa.bool_()
     assert vt.schema.field("ann_culprit").type == pa.string()
@@ -799,6 +801,8 @@ def test_typed_variant_annotations_round_trip(tmp_path):
     )
     assert row1["PHYLOP"] == "2.31" and row1["SIFT_PRED"] == "D"
     assert row1["DP"] == "42" and row1["GENEINFO"] == "BRCA1:672"
+    # >6 significant digits survive ('%g' over float32 gave "1234.57")
+    assert row1["VQSLOD"] == "1234.5678"
     assert row1["NEGATIVE_TRAIN_SITE"] is True
     assert row1["MYSTERY"] == "7"
 
