@@ -131,13 +131,18 @@ def merge_typed(typed: Optional[dict], info_dicts: list[dict]) -> list[dict]:
             if typ is float:
                 # shortest value-exact digits, exponent form where
                 # appropriate ('%g' truncated to 6 significant digits:
-                # VQSLOD 1234.5678 -> "1234.57").  numpy scalars format
-                # at their own width so legacy float32 columns don't
-                # emit widening noise.
-                out[i][vcf_key] = (
-                    str(v) if isinstance(v, np.floating)
-                    else repr(float(v))
-                )
+                # VQSLOD 1234.5678 -> "1234.57").  Integer-valued floats
+                # print without the trailing ".0" (MQ=60 stays "60", as
+                # '%g' printed it); numpy scalars format at their own
+                # width so legacy float32 columns don't emit widening
+                # noise.
+                fv = float(v)
+                if fv.is_integer() and abs(fv) < 1e16:
+                    out[i][vcf_key] = str(int(fv))
+                else:
+                    out[i][vcf_key] = (
+                        str(v) if isinstance(v, np.floating) else repr(fv)
+                    )
             else:
                 out[i][vcf_key] = str(v)
     return out

@@ -69,6 +69,14 @@ BASE_COMPLEMENT = np.array(
 QUAL_PAD = 255  # quality value used in padding lanes
 SANGER_OFFSET = 33  # phred+33, util/PhredUtils.scala semantics
 
+# Full-byte-range decode LUTs for the native fused decode+compact pass
+# (native.lut_compact_rows): code -> ASCII base, qual -> clamped Sanger
+# char ('~' = phred 93 cap, the SAM printable ceiling).
+BASE_DECODE_LUT256 = BASE_DECODE_LUT[np.minimum(np.arange(256), BASE_PAD)]
+QUAL_SANGER_LUT256 = (
+    np.minimum(np.arange(256), 93) + SANGER_OFFSET
+).astype(np.uint8)
+
 
 def encode_bases(seq: str | bytes) -> np.ndarray:
     """ASCII sequence -> u8 code array."""

@@ -207,7 +207,9 @@ class StringColumn:
         return StringColumn(out, offsets, valid)
 
     def to_arrow(self):
-        """Zero-copy-ish conversion to a pyarrow string array."""
+        """Zero-copy conversion to a pyarrow string array (py_buffer
+        wraps the numpy memory and holds a reference — no tobytes copy,
+        which cost a full buffer duplication per fat column)."""
         import pyarrow as pa
 
         n = len(self)
@@ -220,8 +222,8 @@ class StringColumn:
             n,
             [
                 validity,
-                pa.py_buffer(self.offsets.tobytes()),
-                pa.py_buffer(self.buf.tobytes()),
+                pa.py_buffer(np.ascontiguousarray(self.offsets)),
+                pa.py_buffer(np.ascontiguousarray(self.buf)),
             ],
         )
 

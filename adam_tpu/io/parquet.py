@@ -163,17 +163,33 @@ def to_arrow_alignments(
         vals = np.asarray(vals)
         return pa.array(vals, dtype, mask=vals < 0)
 
-    base_ascii = schema.BASE_DECODE_LUT[np.minimum(b.bases, schema.BASE_PAD)]
-    qual_ascii = (np.minimum(b.quals, 93) + schema.SANGER_OFFSET).astype(np.uint8)
+    def decoded_col(mat, lut256, np_decode, valid):
+        # fused native LUT + compaction; numpy LUT gather + from_matrix
+        # as the fallback (same bytes)
+        from adam_tpu import native
+
+        lens = np.where(valid, np.asarray(b.lengths), 0)
+        nat = native.lut_compact_rows(mat, lens, lut256)
+        if nat is not None:
+            return StringColumn(nat[0], nat[1], valid).to_arrow()
+        return _matrix_string_array(np_decode(mat), b.lengths, valid)
 
     table = pa.table(
         {
             "readName": StringColumn.of(side.names).to_arrow(),
-            "sequence": _matrix_string_array(
-                base_ascii, b.lengths, np.ones(n, bool)
+            "sequence": decoded_col(
+                b.bases, schema.BASE_DECODE_LUT256,
+                lambda m: schema.BASE_DECODE_LUT[
+                    np.minimum(m, schema.BASE_PAD)
+                ],
+                np.ones(n, bool),
             ),
-            "qual": _matrix_string_array(
-                qual_ascii, b.lengths, np.asarray(b.has_qual)
+            "qual": decoded_col(
+                b.quals, schema.QUAL_SANGER_LUT256,
+                lambda m: (
+                    np.minimum(m, 93) + schema.SANGER_OFFSET
+                ).astype(np.uint8),
+                np.asarray(b.has_qual),
             ),
             "flags": pa.array(np.asarray(b.flags, np.int32), pa.int32()),
             "contig": _index_name_array(b.contig_idx, header.seq_dict.names),
@@ -214,7 +230,14 @@ def save_alignments(
     with ins.TIMERS.time(ins.PARQUET_ENCODE):
         table = to_arrow_alignments(batch, side, header)
     with ins.TIMERS.time(ins.PARQUET_WRITE):
-        pq.write_table(table, path, compression=compression)
+        # dictionary-encode only the low-cardinality name columns:
+        # letting the writer attempt dictionaries on the mostly-unique
+        # readName/sequence/qual columns builds dicts it then abandons
+        # (~20% of write time on a WGS-shaped part)
+        pq.write_table(
+            table, path, compression=compression,
+            use_dictionary=["contig", "mateContig", "recordGroupName"],
+        )
 
 
 def load_alignments(

@@ -241,17 +241,23 @@ def iter_sam_batches(path: str, batch_reads: int = 262_144):
     header_lines, body_off = _split_header_lines(data)
     header = SamHeader.parse(header_lines)
     buf = np.frombuffer(data, np.uint8)
-    ends = np.flatnonzero(buf[body_off:] == 10) + body_off + 1
-    starts = np.concatenate([[body_off], ends])
-    if starts[-1] < len(data):  # unterminated final line
-        starts = np.concatenate([starts, [len(data)]])
-    n_lines = len(starts) - 1
-    if n_lines <= 0:
+    # window boundaries: every batch_reads-th line start (native memchr
+    # walk; the numpy fallback scans the whole buffer for newlines)
+    bounds = native.line_index_strided(buf, body_off, batch_reads)
+    if bounds is None:
+        ends = np.flatnonzero(buf[body_off:] == 10) + body_off + 1
+        starts = np.concatenate([[body_off], ends])
+        if starts[-1] < len(data):  # unterminated final line
+            starts = np.concatenate([starts, [len(data)]])
+        bounds = starts[:: batch_reads]
+        if bounds[-1] != starts[-1]:
+            bounds = np.concatenate([bounds, starts[-1:]])
+    if len(bounds) < 2:
         yield ReadBatch.empty(), ReadSidecar(), header
         return
-    for lo in range(0, n_lines, batch_reads):
-        hi = min(lo + batch_reads, n_lines)
-        chunk = data[starts[lo] : starts[hi]]
+    for i in range(len(bounds) - 1):
+        # a u8 view, not a bytes copy — tokenize_sam reads it in place
+        chunk = buf[bounds[i] : bounds[i + 1]]
         out = native.tokenize_sam(
             chunk, 0, header.seq_dict.names, header.read_groups.names
         )

@@ -53,6 +53,29 @@ _i32p = ct.POINTER(ct.c_int32)
 _u8p = ct.POINTER(ct.c_uint8)
 
 
+def _cpu_fingerprint() -> str:
+    """ISA feature fingerprint of this host (the 'flags' line of
+    /proc/cpuinfo, or the platform string elsewhere)."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    return " ".join(sorted(line.split(":", 1)[1].split()))
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine() + " " + platform.processor()
+
+
+_BASE_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC"]
+# -march=native first: the scan/fill/LUT hot loops vectorize well on the
+# AVX2/AVX-512 hosts this runs on.  The plain build is the fallback for
+# toolchains/CPUs where that flag fails; the cache tag includes the flag
+# set so a flag change cannot serve a stale .so.
+_FLAG_SETS = [_BASE_FLAGS + ["-march=native"], _BASE_FLAGS]
+
+
 def _build_so() -> Optional[str]:
     try:
         h = hashlib.sha256()
@@ -61,28 +84,38 @@ def _build_so() -> Optional[str]:
                 h.update(fh.read())
     except OSError:
         return None  # missing source: degrade to the Python fallbacks
-    tag = h.hexdigest()[:16]
+    src_hash = h.copy()
     build_dir = os.environ.get(
         "ADAM_TPU_NATIVE_CACHE", os.path.join(_DIR, "_build")
     )
-    so_path = os.path.join(build_dir, f"adamtok_{tag}.so")
-    if os.path.exists(so_path):
-        return so_path
-    try:
-        os.makedirs(build_dir, exist_ok=True)
-        with tempfile.TemporaryDirectory(dir=build_dir) as td:
-            tmp = os.path.join(td, "adamtok.so")
-            cmd = [
-                "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                "-o", tmp, _SRC, _SRC_REALIGN, "-lz", "-pthread",
-            ]
-            res = subprocess.run(cmd, capture_output=True, timeout=240)
-            if res.returncode != 0:
-                return None
-            os.replace(tmp, so_path)
-        return so_path
-    except Exception:
-        return None
+    for flags in _FLAG_SETS:
+        h = src_hash.copy()
+        h.update(" ".join(flags).encode())
+        if "-march=native" in flags:
+            # a native-ISA binary is host-specific: key the cache on the
+            # CPU's feature set so a shared cache dir can never serve an
+            # AVX-512 build to a host that would SIGILL on it
+            h.update(_cpu_fingerprint().encode())
+        tag = h.hexdigest()[:16]
+        so_path = os.path.join(build_dir, f"adamtok_{tag}.so")
+        if os.path.exists(so_path):
+            return so_path
+        try:
+            os.makedirs(build_dir, exist_ok=True)
+            with tempfile.TemporaryDirectory(dir=build_dir) as td:
+                tmp = os.path.join(td, "adamtok.so")
+                cmd = (
+                    ["g++"] + flags
+                    + ["-o", tmp, _SRC, _SRC_REALIGN, "-lz", "-pthread"]
+                )
+                res = subprocess.run(cmd, capture_output=True, timeout=240)
+                if res.returncode != 0:
+                    continue
+                os.replace(tmp, so_path)
+            return so_path
+        except Exception:
+            continue
+    return None
 
 
 def _lib() -> Optional[ct.CDLL]:
@@ -218,6 +251,14 @@ def _lib() -> Optional[ct.CDLL]:
             lib.span_gather.argtypes = [_u8p, _i64p, _i64p, ct.c_int64, _u8p]
             lib.span_gather_strided.argtypes = [
                 _u8p, _i64p, _i64p, ct.c_int64, ct.c_int64, _u8p,
+            ]
+            lib.lut_compact_rows.argtypes = [
+                _u8p, _i32p, _i64p, ct.c_int64, ct.c_int64, _u8p, _u8p,
+                ct.c_int,
+            ]
+            lib.line_index_strided.restype = ct.c_int64
+            lib.line_index_strided.argtypes = [
+                _u8p, ct.c_int64, ct.c_int64, ct.c_int64, _i64p, ct.c_int64,
             ]
             lib.realign_prep.restype = ct.c_void_p
             lib.realign_prep.argtypes = [
@@ -932,6 +973,56 @@ def span_gather(src: np.ndarray, starts: np.ndarray, lens: np.ndarray,
         lens.ctypes.data_as(_i64p), ct.c_int64(len(starts)), _u8_ptr(out),
     )
     return out
+
+
+def lut_compact_rows(mat: np.ndarray, lens: np.ndarray, lut: np.ndarray):
+    """Padded byte matrix [N, W] -> (LUT-mapped compact string buffer,
+    i64 arrow offsets); None if native unavailable.
+
+    One fused pass standing in for the numpy pair
+    ``LUT[mat]`` + ``StringColumn.from_matrix`` that dominated the
+    Parquet part encode (sequence/qual columns)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    mat = np.ascontiguousarray(mat, np.uint8)
+    n, w = mat.shape
+    lens32 = np.clip(np.asarray(lens), 0, w).astype(np.int32)
+    lut = np.ascontiguousarray(lut, np.uint8)
+    if lut.size < 256:
+        return None
+    off = np.zeros(n + 1, np.int64)
+    np.cumsum(lens32, out=off[1:])
+    out = _pretouch(np.empty(max(1, int(off[-1])), np.uint8))
+    lib.lut_compact_rows(
+        _u8_ptr(mat.reshape(-1)), lens32.ctypes.data_as(_i32p),
+        off.ctypes.data_as(_i64p), ct.c_int64(n), ct.c_int64(w),
+        _u8_ptr(lut), _u8_ptr(out), _nthreads(),
+    )
+    return out[: int(off[-1])], off
+
+
+def line_index_strided(data, begin: int, stride: int):
+    """Byte offsets of every ``stride``-th line start in ``data[begin:]``
+    plus the final end offset -> i64 array; None if native unavailable.
+
+    The windowed SAM reader's replacement for a whole-buffer numpy
+    newline scan."""
+    lib = _lib()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    n = len(buf)
+    stride = max(1, int(stride))
+    cap = (n - int(begin)) // stride + 3
+    out = np.empty(cap, np.int64)
+    got = lib.line_index_strided(
+        _u8_ptr(buf), ct.c_int64(n), ct.c_int64(begin),
+        ct.c_int64(stride), out.ctypes.data_as(_i64p), ct.c_int64(cap),
+    )
+    if got < 0:
+        return None
+    return out[:got]
 
 
 def realign_prep(b, md_col_buf, md_col_off, md_valid, grows, goff,

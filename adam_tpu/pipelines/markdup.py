@@ -139,6 +139,35 @@ def concat_summaries(parts: list[dict]) -> dict:
     return out
 
 
+def _unique_inverse_fixed_bytes(names: np.ndarray) -> np.ndarray:
+    """``np.unique(names, return_inverse=True)[1]`` for fixed-width byte
+    names, via big-endian integer views when the width allows.
+
+    memcmp order on null-padded fixed-width bytes == numeric order of
+    the big-endian word(s), so the inverse ids are IDENTICAL to the
+    S-dtype unique's — just ~4x faster (integer radix-ish sort instead
+    of string compares; this was the single hottest step of the global
+    duplicate resolve on a 1M-read input)."""
+    n = len(names)
+    w = names.dtype.itemsize
+    if n == 0 or w > 16:
+        return np.unique(names, return_inverse=True)[1]
+    nw = 8 if w <= 8 else 16
+    padded = np.zeros((n, nw), np.uint8)
+    padded[:, :w] = names.view(np.uint8).reshape(n, w)
+    words = padded.view(">u8").astype(np.uint64)
+    if nw == 8:
+        return np.unique(words[:, 0], return_inverse=True)[1]
+    hi, lo = words[:, 0], words[:, 1]
+    order = np.lexsort((lo, hi))
+    sh, sl = hi[order], lo[order]
+    new = np.ones(n, bool)
+    new[1:] = (sh[1:] != sh[:-1]) | (sl[1:] != sl[:-1])
+    inv = np.empty(n, np.int64)
+    inv[order] = np.cumsum(new) - 1
+    return inv
+
+
 def resolve_duplicates(s: dict) -> np.ndarray:
     """Global group-subgroup-argmax cascade over row summaries -> bool[N]
     duplicate mask.  One lexsort over the bucket table; row order across
@@ -152,7 +181,7 @@ def resolve_duplicates(s: dict) -> np.ndarray:
 
     # ----- bucket ids: dense (rg, name) -> id (SingleReadBucket) -------
     names = s["name_bytes"]
-    _, name_inv = np.unique(names, return_inverse=True)
+    name_inv = _unique_inverse_fixed_bytes(names)
     rg = s["rg_idx"]
     key = (rg + 1) * (name_inv.max() + 1 if len(name_inv) else 1) + name_inv
     key = np.where(valid, key, -1)
@@ -213,10 +242,16 @@ def resolve_duplicates(s: dict) -> np.ndarray:
     right_arr[use_rk] = row_key[rk_rows[use_rk]]
 
     # ----- group by (library, left), subgroup by right, mark -----------
+    # lexicographic order (lib, L0..L3, R0..R3) with adjacent small-range
+    # fields packed into shared words: kind < 4, strand < 2, and
+    # |pos| < 2^40, so (lib<<2)|kind, (Lpos<<3)|(Lstrand<<2)|Rkind and
+    # (Rpos<<1)|Rstrand preserve the 9-key order in 5 stable sorts
+    # (full-range int64 hash keys L1/R1 stay unpacked)
+    k1 = (bucket_lib << 2) | left_arr[:, 0]
+    k3 = (left_arr[:, 2] << 3) | (left_arr[:, 3] << 2) | right_arr[:, 0]
+    k5 = (right_arr[:, 2] << 1) | right_arr[:, 3]
     group_order = np.lexsort(
-        tuple(right_arr[:, k] for k in range(3, -1, -1))
-        + tuple(left_arr[:, k] for k in range(3, -1, -1))
-        + (bucket_lib,)
+        (k5, right_arr[:, 1], k3, left_arr[:, 1], k1)
     )
     go = group_order
     sl = np.concatenate([bucket_lib[go, None], left_arr[go]], axis=1)
