@@ -127,7 +127,36 @@ def load_header(path: str) -> SamHeader:
         for _, _, header in sam.iter_bam_batches(p, batch_reads=1):
             return header
         return SamHeader()
+    # Parquet stores carry the header in schema metadata: read it without
+    # materializing any rows (the out-of-core consumers depend on this)
+    try:
+        import pyarrow.parquet as _pq
+
+        from adam_tpu.io.parquet import _header_from_meta
+
+        parts = _parquet_parts(p)
+        meta = _pq.read_schema(parts[0] if parts else p).metadata
+        header = _header_from_meta(meta)
+        if len(header.seq_dict.names) or len(header.read_groups):
+            return header
+    except Exception:
+        pass
     return load_alignments(path).header
+
+
+def _parquet_parts(path: str) -> list[str]:
+    """Ordered part files of a ``.adam`` part directory ([] when the
+    path is not a directory) — the one place the part-naming convention
+    lives."""
+    import glob as _glob
+    import os as _os
+
+    if not _os.path.isdir(path):
+        return []
+    return sorted(
+        _glob.glob(_os.path.join(path, "part-*.parquet"))
+        or _glob.glob(_os.path.join(path, "part-*"))
+    )
 
 
 def _expand_multi(path: str) -> Optional[list[str]]:
@@ -197,6 +226,47 @@ def load_alignments_multi(paths: Sequence[str], **kw) -> AlignmentDataset:
         ReadSidecar.concat(sides),
         SamHeader(seq_dict=sd, read_groups=rgd),
     )
+
+
+def iter_alignment_batches(
+    path: str, batch_reads: int = 262_144, projection=None
+):
+    """Windowed alignment reader: yields (ReadBatch, ReadSidecar,
+    SamHeader) without ever holding the whole input — the streaming
+    face of :func:`load_alignments` for out-of-core consumers
+    (parallel/sharded_join, parallel/host_shuffle).
+
+    SAM/BAM inputs stream through the windowed tokenizers; ``.adam``
+    part directories yield one window per part file (``projection``
+    pushes column pruning into the part reads); a single Parquet file —
+    or a directory/glob of SAM/BAM files, which needs the merged-header
+    re-indexing of :func:`load_alignments_multi` — yields once."""
+    from adam_tpu.io import sam as sam_io
+
+    p = str(path)
+    base = p[:-3] if p.endswith(".gz") else p
+    if base.endswith(".sam"):
+        yield from sam_io.iter_sam_batches(p, batch_reads=batch_reads)
+        return
+    if base.endswith(".bam"):
+        yield from sam_io.iter_bam_batches(p, batch_reads=batch_reads)
+        return
+    from adam_tpu.io import parquet as _parquet
+
+    kw = {"projection": projection} if projection else {}
+    parts = _parquet_parts(p)
+    if parts:
+        for part in parts:
+            yield _parquet.load_alignments(part, **kw)
+        return
+    multi = _expand_multi(p)
+    if multi is not None:
+        # SAM/BAM directory or glob: contig ids must re-index into the
+        # merged dictionary, which the resident multi-loader owns
+        ds = load_alignments(p)
+        yield ds.batch, ds.sidecar, ds.header
+        return
+    yield _parquet.load_alignments(p, **kw)
 
 
 def load_alignments(

@@ -235,12 +235,28 @@ def iter_sam_batches(path: str, batch_reads: int = 262_144):
         batch, side, header = read_sam(path)
         yield batch, side, header
         return
-    opener = gzip.open if str(path).endswith(".gz") else open
-    with opener(path, "rb") as fh:
-        data = fh.read()
-    header_lines, body_off = _split_header_lines(data)
+    import os as _os
+
+    if str(path).endswith(".gz"):
+        with gzip.open(path, "rb") as fh:
+            data = fh.read()
+        buf = np.frombuffer(data, np.uint8)
+    elif _os.path.getsize(path) == 0:
+        yield ReadBatch.empty(), ReadSidecar(), SamHeader()
+        return
+    else:
+        # file-backed mapping: the input's pages stay clean/reclaimable,
+        # so a WGS-scale SAM doesn't pin its whole size in RSS while the
+        # windows stream through
+        buf = np.memmap(path, np.uint8, mode="r")
+        data = buf
+    hdr_probe = bytes(buf[: 1 << 20])
+    header_lines, body_off = _split_header_lines(hdr_probe)
+    if body_off >= len(hdr_probe) and len(buf) > len(hdr_probe):
+        # pathological >1MB header: fall back to a full scan
+        hdr_probe = bytes(buf)
+        header_lines, body_off = _split_header_lines(hdr_probe)
     header = SamHeader.parse(header_lines)
-    buf = np.frombuffer(data, np.uint8)
     # window boundaries: every batch_reads-th line start (native memchr
     # walk; the numpy fallback scans the whole buffer for newlines)
     bounds = native.line_index_strided(buf, body_off, batch_reads)

@@ -1,0 +1,248 @@
+"""Out-of-core distributed region join and depth over genome-bin shards.
+
+The reference's joins are distributed by construction —
+``ShuffleRegionJoin.partitionAndJoin``
+(rdd/ShuffleRegionJoin.scala:72-134: genome bins + per-bin chromsweep,
+dedupe at :262-267) runs with both sides spilled to Spark's shuffle and
+each bin joined independently.  :mod:`adam_tpu.pipelines.region_join`
+implements the same join shapes over fully-resident arrays; this module
+is the out-of-core spine underneath them: the streamed (big) side is
+routed through a per-genome-bin interval spill on disk — the same
+genome-bin shard layout :mod:`adam_tpu.parallel.host_shuffle` uses for
+whole read batches — and each bin is then loaded and chromswept alone,
+so peak memory is one ingest window plus one bin, never the dataset.
+
+Halo handling: an interval spanning a bin edge is replicated into every
+bin it overlaps (``start_bin..end_bin``), exactly the reference's
+replication (:112-121); the pair-level dedupe is the reference's
+"at least one side starts in this bin" rule, and for point depth the
+site's single owning bin counts all replicas that reach it.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from adam_tpu.models.dictionaries import SequenceDictionary
+from adam_tpu.ops import intervals as iv
+from adam_tpu.parallel.partitioner import GenomeBins
+from adam_tpu.pipelines.region_join import IntervalArrays
+
+
+class BinnedIntervalSpill:
+    """Append-only per-genome-bin spill of (contig, start, end, row_id)
+    interval rows as raw little-endian i64 quadruples.
+
+    One file per touched bin; appends replicate each interval into every
+    bin it overlaps (the shuffle join's halo).  Constant memory: only
+    the appended batch is ever resident.
+    """
+
+    _ROW = 4  # i64 fields per spilled interval
+
+    def __init__(self, bins: GenomeBins, workdir: Optional[str] = None):
+        self.bins = bins
+        self._own = workdir is None
+        self._dir = workdir or tempfile.mkdtemp(prefix="adam_tpu_binspill_")
+        os.makedirs(self._dir, exist_ok=True)
+        # appends run in "ab" mode, so stale bin files from a crashed
+        # prior run sharing this workdir would silently corrupt counts
+        for name in os.listdir(self._dir):
+            if name.startswith("bin-") and name.endswith(".i64"):
+                os.unlink(os.path.join(self._dir, name))
+        self._counts: dict[int, int] = {}
+
+    def _path(self, b: int) -> str:
+        return os.path.join(self._dir, f"bin-{b:06d}.i64")
+
+    def append(self, contig, start, end, row_id) -> None:
+        contig = np.asarray(contig, np.int64)
+        start = np.asarray(start, np.int64)
+        end = np.asarray(end, np.int64)
+        row_id = np.asarray(row_id, np.int64)
+        if len(contig) == 0:
+            return
+        lo = self.bins.start_bin(contig, start)
+        hi = self.bins.end_bin(contig, end) + 1
+        rep, rbin = iv.expand_ranges(lo, hi)
+        order = np.argsort(rbin, kind="stable")
+        rep, rbin = rep[order], rbin[order]
+        edges = np.flatnonzero(
+            np.concatenate([[True], rbin[1:] != rbin[:-1]])
+        )
+        bounds = np.concatenate([edges, [len(rbin)]])
+        for k in range(len(edges)):
+            b = int(rbin[edges[k]])
+            rows = rep[bounds[k]: bounds[k + 1]]
+            mat = np.empty((len(rows), self._ROW), np.int64)
+            mat[:, 0] = contig[rows]
+            mat[:, 1] = start[rows]
+            mat[:, 2] = end[rows]
+            mat[:, 3] = row_id[rows]
+            # open-per-write append: a WGS genome touches thousands of
+            # bins, so persistent handles would blow the fd ulimit
+            if b not in self._counts:
+                self._counts[b] = 0
+            with open(self._path(b), "ab") as fh:
+                fh.write(mat.tobytes())
+            self._counts[b] += len(rows)
+
+    def close(self) -> None:  # appends hold no persistent handles
+        pass
+
+    def touched_bins(self) -> list[int]:
+        return sorted(self._counts)
+
+    def read_bin(self, b: int) -> tuple[np.ndarray, np.ndarray]:
+        """-> (IntervalArrays, row_ids) of one bin's spilled rows."""
+        with open(self._path(b), "rb") as fh:
+            mat = np.frombuffer(fh.read(), np.int64).reshape(-1, self._ROW)
+        ia = IntervalArrays(
+            mat[:, 0].copy(), mat[:, 1].copy(), mat[:, 2].copy()
+        )
+        return ia, mat[:, 3].copy()
+
+    def cleanup(self) -> None:
+        self.close()
+        for b in list(self._counts):
+            try:
+                os.unlink(self._path(b))
+            except OSError:
+                pass
+        if self._own:
+            try:
+                os.rmdir(self._dir)
+            except OSError:
+                pass
+
+
+def _spill_batches(
+    batches: Iterable, bins: GenomeBins, workdir: Optional[str]
+) -> tuple[BinnedIntervalSpill, int]:
+    """Stream (ReadBatch, sidecar, header) triples into a binned interval
+    spill of their mapped reads -> (spill, total rows consumed)."""
+    import jax
+
+    spill = BinnedIntervalSpill(bins, workdir)
+    n_contigs = len(bins.seq_dict.names)
+    offset = 0
+    try:
+        for batch, _side, _header in batches:
+            b = jax.tree.map(np.asarray, batch)
+            keep = np.flatnonzero(
+                np.asarray(b.valid)
+                & np.asarray(b.is_mapped)
+                & (np.asarray(b.contig_idx) >= 0)
+                & (np.asarray(b.contig_idx) < n_contigs)
+            )
+            spill.append(
+                np.asarray(b.contig_idx)[keep],
+                np.asarray(b.start)[keep],
+                np.asarray(b.end)[keep],
+                keep + offset,
+            )
+            offset += b.n_rows
+    except BaseException:
+        # a mid-ingest failure must not strand gigabytes of bin files
+        spill.cleanup()
+        raise
+    return spill, offset
+
+
+def streamed_depth(
+    batches: Iterable,
+    sites: IntervalArrays,
+    seq_dict: SequenceDictionary,
+    bin_size: int = 1_000_000,
+    workdir: Optional[str] = None,
+) -> np.ndarray:
+    """Read depth at each site start, out of core -> i64[len(sites)].
+
+    Bit-parity with the monolithic
+    ``iv.point_depth(reads..., sites...)`` (the `depth` CLI core): a
+    read overlapping a site's position is, by construction of the halo
+    replication, present in the site's owning bin, and each site is
+    counted in exactly one bin (point sites own one bin).  Peak memory
+    is one ingest window + one bin of intervals.
+    """
+    bins = GenomeBins(bin_size, seq_dict)
+    spill, _n = _spill_batches(batches, bins, workdir)
+    depth = np.zeros(len(sites), np.int64)
+    n_contigs = len(seq_dict.names)
+    in_dict = (sites.contig >= 0) & (sites.contig < n_contigs)
+    site_bin = np.full(len(sites), -1, np.int64)
+    rows = np.flatnonzero(in_dict)
+    site_bin[rows] = bins.start_bin(sites.contig[rows], sites.start[rows])
+    try:
+        for b in spill.touched_bins():
+            sel = np.flatnonzero(site_bin == b)
+            if len(sel) == 0:
+                continue
+            reads, _ids = spill.read_bin(b)
+            depth[sel] = iv.point_depth(
+                reads.contig, reads.start, reads.end,
+                sites.contig[sel], sites.start[sel],
+            )
+    finally:
+        spill.cleanup()
+    return depth
+
+
+def streamed_overlap_join(
+    batches: Iterable,
+    right: IntervalArrays,
+    seq_dict: SequenceDictionary,
+    bin_size: int = 1_000_000,
+    workdir: Optional[str] = None,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Out-of-core shuffle region join: streamed left batches x resident
+    right intervals -> per-bin (left_row_id, right_index) overlap pairs.
+
+    Pair-set parity with ``shuffle_region_join``/``overlap_join`` over
+    the fully-resident left side: per-bin chromsweep
+    (``iv.overlap_join`` is the sorted sweep) plus the reference's
+    dedupe rule — a pair is emitted only in bins where at least one side
+    *starts* (ShuffleRegionJoin.scala:262-267) — so halo replicas never
+    double-emit.  Left row ids are global (cumulative over the stream),
+    so callers can re-fetch payload rows from their own store.
+    """
+    bins = GenomeBins(bin_size, seq_dict)
+    spill, _n = _spill_batches(batches, bins, workdir)
+    n_contigs = len(seq_dict.names)
+    r_keep = np.flatnonzero(
+        (right.contig >= 0) & (right.contig < n_contigs)
+    )
+    r_lo = bins.start_bin(right.contig[r_keep], right.start[r_keep])
+    r_hi = bins.end_bin(right.contig[r_keep], right.end[r_keep]) + 1
+    rr, rbin = iv.expand_ranges(r_lo, r_hi)
+    r_order = np.argsort(rbin, kind="stable")
+    rr, rbin_sorted = rr[r_order], rbin[r_order]
+    try:
+        for b in spill.touched_bins():
+            lo = np.searchsorted(rbin_sorted, b)
+            hi = np.searchsorted(rbin_sorted, b, "right")
+            if lo == hi:
+                continue
+            rsel = r_keep[rr[lo:hi]]
+            reads, ids = spill.read_bin(b)
+            pl, pr = iv.overlap_join(
+                reads.contig, reads.start, reads.end,
+                right.contig[rsel], right.start[rsel], right.end[rsel],
+            )
+            if len(pl) == 0:
+                continue
+            gl, gr = ids[pl], rsel[pr]
+            _, bstart, bend = bins.dedupe_region(int(b))
+            keep = (
+                (reads.start[pl] >= bstart) & (reads.start[pl] < bend)
+            ) | (
+                (right.start[gr] >= bstart) & (right.start[gr] < bend)
+            )
+            if keep.any():
+                yield gl[keep], gr[keep]
+    finally:
+        spill.cleanup()
