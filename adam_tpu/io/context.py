@@ -116,6 +116,20 @@ def load_header(path: str) -> SamHeader:
     materializing the reads — the role of SAMFileHeader probes in the
     reference's loaders (ADAMContext.scala:236-257)."""
     p = str(path)
+    multi = _expand_multi(p)
+    if multi is not None and (len(multi) > 1 or multi[0] != p):
+        # directory/glob of SAM/BAM: merge the per-file header peeks
+        # (still rows-free) the way load_alignments_multi merges
+        headers = [load_header(f) for f in multi]
+        sd = headers[0].seq_dict
+        rgd = headers[0].read_groups
+        for h in headers[1:]:
+            sd = sd.merge(h.seq_dict)
+            rgd = rgd.merge(h.read_groups)
+        from adam_tpu.io.sam import SamHeader as _SH
+
+        return _SH(seq_dict=sd, read_groups=rgd,
+                   hd_line=headers[0].hd_line)
     base = p[:-3] if p.endswith(".gz") else p
     if base.endswith(".sam"):
         from adam_tpu.io import sam
@@ -261,8 +275,26 @@ def iter_alignment_batches(
         return
     multi = _expand_multi(p)
     if multi is not None:
-        # SAM/BAM directory or glob: contig ids must re-index into the
-        # merged dictionary, which the resident multi-loader owns
+        # SAM/BAM directory or glob: when every file shares one
+        # sequence dictionary (the common same-pipeline case), stream
+        # each file's windows — contig ids already agree.  Divergent
+        # dictionaries need the resident multi-loader's re-indexing;
+        # warn, because that materializes the whole dataset.
+        headers = [load_header(f) for f in multi]
+        names0 = headers[0].seq_dict.names
+        if all(h.seq_dict.names == names0 for h in headers[1:]):
+            for f in multi:
+                yield from iter_alignment_batches(
+                    f, batch_reads=batch_reads, projection=projection
+                )
+            return
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "iter_alignment_batches(%s): %d sources with differing "
+            "sequence dictionaries — falling back to a resident "
+            "merged load (not out-of-core)", p, len(multi),
+        )
         ds = load_alignments(p)
         yield ds.batch, ds.sidecar, ds.header
         return

@@ -124,23 +124,25 @@ def _spill_batches(
     batches: Iterable, bins: GenomeBins, workdir: Optional[str]
 ) -> tuple[BinnedIntervalSpill, int]:
     """Stream (ReadBatch, sidecar, header) triples into a binned interval
-    spill of their mapped reads -> (spill, total rows consumed)."""
-    import jax
+    spill of their mapped reads -> (spill, total rows consumed).
 
+    Only the coordinate columns are touched — the [N, L] payload
+    matrices never convert or copy here (that is the point of the
+    spill)."""
     spill = BinnedIntervalSpill(bins, workdir)
     n_contigs = len(bins.seq_dict.names)
     offset = 0
     try:
-        for batch, _side, _header in batches:
-            b = jax.tree.map(np.asarray, batch)
+        for b, _side, _header in batches:
+            contig_idx = np.asarray(b.contig_idx)
             keep = np.flatnonzero(
                 np.asarray(b.valid)
                 & np.asarray(b.is_mapped)
-                & (np.asarray(b.contig_idx) >= 0)
-                & (np.asarray(b.contig_idx) < n_contigs)
+                & (contig_idx >= 0)
+                & (contig_idx < n_contigs)
             )
             spill.append(
-                np.asarray(b.contig_idx)[keep],
+                contig_idx[keep],
                 np.asarray(b.start)[keep],
                 np.asarray(b.end)[keep],
                 keep + offset,

@@ -274,7 +274,82 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
         cand = realign_mod.realign_indels(cand)
         _write_part(out_dir, len(shard_paths), cand, "snappy")
     barrier("done")
-    print(f"HARNESS OK {int(total.sum()) % 100000}", flush=True)
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # ru_maxrss is kilobytes on Linux, bytes on Darwin
+    rss_gb = ru / (1e9 if sys.platform == "darwin" else 1e6)
+    print(
+        f"HARNESS OK {int(total.sum()) % 100000} rss_gb={rss_gb:.2f}",
+        flush=True,
+    )
+
+
+def run_composition(
+    n_procs: int, shard_dir: str, out_dir: str, timeout: int = 900
+) -> list[tuple[str, float]]:
+    """Spawn ``n_procs`` OS processes running the composed transform over
+    an existing raw shard store -> per-process (output, peak_rss_gb)
+    pairs.  Shared by test_parallel.py and the driver's dryrun tail.
+
+    Pipes drain on one thread per child: the children synchronize at
+    barriers, so sequential communicate() would deadlock if any
+    non-first child filled its pipe before everyone reached "done"."""
+    import re
+    import socket
+    import subprocess
+    import threading
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    coord = f"localhost:{port}"
+    here = os.path.abspath(__file__)
+    os.makedirs(out_dir, exist_ok=True)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, here, coord, str(n_procs), str(pid),
+             "transform", shard_dir, out_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=dict(os.environ),
+        )
+        for pid in range(n_procs)
+    ]
+    outs: list = [None] * n_procs
+    errs: list = [None] * n_procs
+
+    def drain(i):
+        try:
+            outs[i], _ = procs[i].communicate(timeout=timeout)
+        except BaseException as e:  # timeout etc: recorded, proc killed
+            errs[i] = e
+    threads = [
+        threading.Thread(target=drain, args=(i,)) for i in range(n_procs)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        for p in procs:
+            p.kill()
+    results = []
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        if errs[pid] is not None or p.returncode != 0 or not out \
+                or "HARNESS OK" not in out:
+            raise RuntimeError(
+                f"composition proc {pid}/{n_procs} failed "
+                f"(rc={p.returncode}, err={errs[pid]!r}):"
+                f"\n{(out or '')[-3000:]}"
+            )
+        m = re.search(r"rss_gb=([0-9.]+)", out)
+        if not m:
+            raise RuntimeError(
+                f"composition proc {pid} reported no RSS:\n{out[-500:]}"
+            )
+        results.append((out, float(m.group(1))))
+    return results
 
 
 if __name__ == "__main__":

@@ -264,19 +264,25 @@ def test_host_shuffle_bam_to_shards(tmp_path):
     assert total == 6000
 
 
-def test_two_process_composed_transform(tmp_path):
-    """The COMPOSED flagship transform across two real OS processes over
-    a shared raw shard store — summaries/candidates exchange via spill
+@pytest.mark.parametrize("n_procs,n_shards", [(2, 4), (8, 16)])
+def test_composed_transform_n_processes(tmp_path, n_procs, n_shards):
+    """The COMPOSED flagship transform across real OS processes over a
+    shared raw shard store — summaries/candidates exchange via spill
     files, observation tables merge with a cross-process device psum —
     must equal the monolithic single-process transform bit-for-bit on
     the output keys (SURVEY §2.6: the reference's whole execution model
-    is this exchange, via Spark)."""
-    import socket
-    import subprocess
+    is this exchange, via Spark; the reference's local[N] suites test
+    real shuffle paths at arbitrary N the same way,
+    ADAMFunSuite.scala:22-29).  n=8 exercises the shard-store/psum
+    design at a process count where contention and per-process RSS
+    behave differently than at 2; each process's peak RSS must stay
+    under a fixed budget."""
     import sys
 
     sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
     from make_wgs_sam import make_wgs
+
+    from tests.multihost_harness import run_composition
 
     from adam_tpu.io import context
     from adam_tpu.io.sam import iter_sam_batches
@@ -287,7 +293,8 @@ def test_two_process_composed_transform(tmp_path):
 
     shard_dir = str(tmp_path / "shards")
     host_shuffle.shuffle_alignments_to_shards(
-        iter_sam_batches(sam, batch_reads=1024), 4, shard_dir, fmt="raw"
+        iter_sam_batches(sam, batch_reads=1024), n_shards, shard_dir,
+        fmt="raw",
     )
 
     # monolithic expectation
@@ -298,33 +305,15 @@ def test_two_process_composed_transform(tmp_path):
         .realign_indels()
     )
 
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-    coord = f"localhost:{port}"
-    harness = str(pathlib.Path(__file__).parent / "multihost_harness.py")
     out_dir = str(tmp_path / "out.adam")
-    os.makedirs(out_dir, exist_ok=True)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, harness, coord, "2", str(pid), "transform",
-             shard_dir, out_dir],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=dict(os.environ),
+    results = run_composition(n_procs, shard_dir, out_dir)
+    for pid, (_out, rss_gb) in enumerate(results):
+        # budget: jax runtime + one shard's columns; the whole point of
+        # the shard store is that per-process memory does not scale with
+        # the dataset or the process count
+        assert rss_gb < 1.5, (
+            f"proc {pid} peak RSS {rss_gb} GB over budget"
         )
-        for pid in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=600)
-            outs.append(out)
-    finally:
-        for p in procs:
-            p.kill()
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
-        assert "HARNESS OK" in out, f"proc {pid} output:\n{out[-3000:]}"
 
     got = context.load_alignments(out_dir)
 
@@ -349,6 +338,84 @@ def test_two_process_composed_transform(tmp_path):
 
     assert len(got) == len(mono)
     assert keyed(got) == keyed(mono)
+
+
+def test_composed_mesh_transform_capacity_retry(mesh, monkeypatch, tmp_path):
+    """Drive the capacity-bounded all_to_all through the COMPOSED mesh
+    transform (sort-rows -> markdup -> k-mers over one dataset) at a
+    skew/size that forces the exact-capacity second exchange round
+    inside the public APIs — not just the toy jit probes — and pin the
+    results against the monolithic path (VERDICT r4 weak #5)."""
+    import jax.numpy as jnp
+
+    from adam_tpu.api.datasets import AlignmentDataset
+    from adam_tpu.io import context
+    from adam_tpu.parallel import dist
+
+    n_dev = mesh.devices.size
+    n, L = 512 * n_dev, 32
+    # every read: same position, same poly-A sequence — one giant
+    # duplicate pileup whose sort keys AND k-mer keys all route to a
+    # single destination shard (maximal skew; the slack capacity is
+    # 4/n_dev of the per-shard rows, so this must overflow)
+    sam = tmp_path / "skew.sam"
+    with open(sam, "w") as fh:
+        fh.write("@HD\tVN:1.5\n@SQ\tSN:chr1\tLN:100000\n")
+        for i in range(n):
+            fh.write(
+                f"r{i}\t0\tchr1\t501\t60\t{L}M\t*\t0\t0\t"
+                f"{'A' * L}\t{'I' * L}\tMD:Z:{L}\n"
+            )
+    ds = context.load_alignments(str(sam))
+
+    calls = {"sort": 0, "kmers": 0}
+    orig_sort = dist._distributed_sort_rows_jit
+    orig_kmers = dist._distributed_kmers_jit
+
+    def sort_spy(*a, **k):
+        calls["sort"] += 1
+        return orig_sort(*a, **k)
+
+    def kmers_spy(*a, **k):
+        calls["kmers"] += 1
+        return orig_kmers(*a, **k)
+
+    monkeypatch.setattr(dist, "_distributed_sort_rows_jit", sort_spy)
+    monkeypatch.setattr(dist, "_distributed_kmers_jit", kmers_spy)
+
+    # composed: mesh sort (rows move), markdup over the sorted dataset,
+    # k-mer exchange over the same batch
+    b = ds.batch.to_numpy()
+    keys = jnp.asarray(
+        (np.asarray(b.contig_idx, np.int64) << 40)
+        | np.asarray(b.start, np.int64)
+    )
+    k_out, rows, valid = dist.distributed_sort_rows(
+        keys, {"row": jnp.arange(ds.batch.n_rows, dtype=jnp.int32)}, mesh
+    )
+    assert calls["sort"] == 2, (
+        "maximal key skew must overflow the slack round and trigger "
+        "the exact-capacity retry inside distributed_sort_rows"
+    )
+    order = np.asarray(rows["row"]).reshape(-1)[valid.ravel()]
+    assert len(order) == n
+    sorted_ds = ds.take_rows(order)
+
+    md = dist.distributed_markdup(sorted_ds, mesh)
+    mono = sorted_ds.mark_duplicates()
+    np.testing.assert_array_equal(
+        np.asarray(md.batch.flags), np.asarray(mono.batch.flags)
+    )
+    # the pileup marks all but one primary as duplicates
+    n_dup = ((np.asarray(md.batch.flags) & schema.FLAG_DUPLICATE) != 0).sum()
+    assert n_dup == n - 1
+
+    counts = dist.distributed_count_kmers(md.batch, 21, mesh=mesh)
+    assert calls["kmers"] == 2, (
+        "identical k-mer keys must overflow and retry inside "
+        "distributed_count_kmers"
+    )
+    assert counts == {"A" * 21: n * (L - 21 + 1)}
 
 
 def test_capacity_bound_overflow_and_skew_split(mesh):
