@@ -119,17 +119,8 @@ def load_header(path: str) -> SamHeader:
     multi = _expand_multi(p)
     if multi is not None and (len(multi) > 1 or multi[0] != p):
         # directory/glob of SAM/BAM: merge the per-file header peeks
-        # (still rows-free) the way load_alignments_multi merges
-        headers = [load_header(f) for f in multi]
-        sd = headers[0].seq_dict
-        rgd = headers[0].read_groups
-        for h in headers[1:]:
-            sd = sd.merge(h.seq_dict)
-            rgd = rgd.merge(h.read_groups)
-        from adam_tpu.io.sam import SamHeader as _SH
-
-        return _SH(seq_dict=sd, read_groups=rgd,
-                   hd_line=headers[0].hd_line)
+        # (still rows-free), same union rules as load_alignments_multi
+        return _merge_headers([load_header(f) for f in multi])
     base = p[:-3] if p.endswith(".gz") else p
     if base.endswith(".sam"):
         from adam_tpu.io import sam
@@ -156,6 +147,35 @@ def load_header(path: str) -> SamHeader:
     except Exception:
         pass
     return load_alignments(path).header
+
+
+def _merge_headers(headers):
+    """Union of per-source headers (loadBam's header merge,
+    rdd/ADAMContext.scala:236-257): sequence dictionaries and read-group
+    dictionaries merge (conflicting contig lengths raise); no hd_line —
+    a sort-order claim from one source does not hold for the union."""
+    from adam_tpu.io.sam import SamHeader
+
+    sd = headers[0].seq_dict
+    rgd = headers[0].read_groups
+    for h in headers[1:]:
+        sd = sd.merge(h.seq_dict)
+        rgd = rgd.merge(h.read_groups)
+    return SamHeader(seq_dict=sd, read_groups=rgd)
+
+
+def _headers_identical(headers) -> bool:
+    """Whether every source header carries the SAME dictionaries (names,
+    lengths, read groups incl. sample/library metadata) — the condition
+    under which per-file batches can stream without re-indexing."""
+    h0 = headers[0]
+    sq0 = h0.seq_dict.to_sam_header_lines()
+    rg0 = [g.to_sam_header_line() for g in h0.read_groups]
+    return all(
+        h.seq_dict.to_sam_header_lines() == sq0
+        and [g.to_sam_header_line() for g in h.read_groups] == rg0
+        for h in headers[1:]
+    )
 
 
 def _parquet_parts(path: str) -> list[str]:
@@ -206,11 +226,9 @@ def load_alignments_multi(paths: Sequence[str], **kw) -> AlignmentDataset:
     from adam_tpu.formats.batch import ReadBatch, ReadSidecar
 
     parts = [load_alignments(p, **kw) for p in paths]
-    sd = parts[0].header.seq_dict
-    rgd = parts[0].header.read_groups
-    for part in parts[1:]:
-        sd = sd.merge(part.header.seq_dict)
-        rgd = rgd.merge(part.header.read_groups)
+    merged = _merge_headers([part.header for part in parts])
+    sd = merged.seq_dict
+    rgd = merged.read_groups
 
     def remap(idx, m):
         idx = np.asarray(idx)
@@ -281,8 +299,7 @@ def iter_alignment_batches(
         # dictionaries need the resident multi-loader's re-indexing;
         # warn, because that materializes the whole dataset.
         headers = [load_header(f) for f in multi]
-        names0 = headers[0].seq_dict.names
-        if all(h.seq_dict.names == names0 for h in headers[1:]):
+        if _headers_identical(headers):
             for f in multi:
                 yield from iter_alignment_batches(
                     f, batch_reads=batch_reads, projection=projection
@@ -292,8 +309,8 @@ def iter_alignment_batches(
 
         logging.getLogger(__name__).warning(
             "iter_alignment_batches(%s): %d sources with differing "
-            "sequence dictionaries — falling back to a resident "
-            "merged load (not out-of-core)", p, len(multi),
+            "sequence/read-group dictionaries — falling back to a "
+            "resident merged load (not out-of-core)", p, len(multi),
         )
         ds = load_alignments(p)
         yield ds.batch, ds.sidecar, ds.header

@@ -625,10 +625,17 @@ def apply_recalibration(
     set_mask = (
         np.asarray(b.valid) & np.asarray(b.has_qual) & ~old_oq.valid
     )
-    qmat = (np.asarray(b.quals) + schema.SANGER_OFFSET).astype(np.uint8)
-    stashed = StringColumn.from_matrix(
-        qmat, np.where(set_mask, np.asarray(b.lengths), 0), set_mask.copy()
+    stash_lens = np.where(set_mask, np.asarray(b.lengths), 0)
+    nat = native.lut_compact_rows(
+        np.asarray(b.quals), stash_lens, schema.QUAL_SANGER_LUT256
     )
+    if nat is not None:
+        # fused LUT+compact pass — no [N, L] ASCII temporary (in-read
+        # quals are <= 93, so the clamp in the LUT never fires on them)
+        stashed = StringColumn(nat[0], nat[1], set_mask.copy())
+    else:
+        qmat = (np.asarray(b.quals) + schema.SANGER_OFFSET).astype(np.uint8)
+        stashed = StringColumn.from_matrix(qmat, stash_lens, set_mask.copy())
     if not old_oq.valid.any():
         merged = stashed  # no pre-existing OQ anywhere: stash wholesale
     else:

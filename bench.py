@@ -430,6 +430,10 @@ def main() -> None:
                 **(scale4m or {}),
                 "chip_windows": stages.get("windows"),
                 "chip_total_spread_s": stages.get("spread"),
+                # the CPU baseline runs minutes after the chip windows on
+                # a time-shared host core: its load context must be in
+                # the artifact or the ratio can't be read honestly
+                "cpu_windows": cpu_stats.get("windows"),
                 "chip_stages_s": {
                     k: round(v, 2)
                     for k, v in stages.items()

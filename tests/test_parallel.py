@@ -348,7 +348,6 @@ def test_composed_mesh_transform_capacity_retry(mesh, monkeypatch, tmp_path):
     results against the monolithic path (VERDICT r4 weak #5)."""
     import jax.numpy as jnp
 
-    from adam_tpu.api.datasets import AlignmentDataset
     from adam_tpu.io import context
     from adam_tpu.parallel import dist
 
