@@ -164,20 +164,6 @@ def _merge_headers(headers):
     return SamHeader(seq_dict=sd, read_groups=rgd)
 
 
-def _headers_identical(headers) -> bool:
-    """Whether every source header carries the SAME dictionaries (names,
-    lengths, read groups incl. sample/library metadata) — the condition
-    under which per-file batches can stream without re-indexing."""
-    h0 = headers[0]
-    sq0 = h0.seq_dict.to_sam_header_lines()
-    rg0 = [g.to_sam_header_line() for g in h0.read_groups]
-    return all(
-        h.seq_dict.to_sam_header_lines() == sq0
-        and [g.to_sam_header_line() for g in h.read_groups] == rg0
-        for h in headers[1:]
-    )
-
-
 def _parquet_parts(path: str) -> list[str]:
     """Ordered part files of a ``.adam`` part directory ([] when the
     path is not a directory) — the one place the part-naming convention
@@ -299,18 +285,40 @@ def iter_alignment_batches(
         # dictionaries need the resident multi-loader's re-indexing;
         # warn, because that materializes the whole dataset.
         headers = [load_header(f) for f in multi]
-        if _headers_identical(headers):
-            for f in multi:
-                yield from iter_alignment_batches(
-                    f, batch_reads=batch_reads, projection=projection
+        sq0 = headers[0].seq_dict.to_sam_header_lines()
+        if all(h.seq_dict.to_sam_header_lines() == sq0
+               for h in headers[1:]):
+            # identical sequence dictionaries: stream per file, with
+            # each file's read-group ids remapped into the merged RG
+            # dictionary on the fly (per-sample @RG files are the
+            # common multi-BAM shape; a full resident merge just for
+            # an int remap would defeat the out-of-core contract)
+            import numpy as np
+
+            merged = _merge_headers(headers)
+            rgd = merged.read_groups
+            for f, h in zip(multi, headers):
+                gmap = np.array(
+                    [rgd.index(nm) for nm in h.read_groups.names],
+                    np.int32,
                 )
+                for batch, side, _h in iter_alignment_batches(
+                    f, batch_reads=batch_reads, projection=projection
+                ):
+                    rg = np.asarray(batch.read_group_idx)
+                    if len(gmap):
+                        rg = np.where(
+                            rg >= 0, gmap[np.clip(rg, 0, len(gmap) - 1)],
+                            rg,
+                        ).astype(np.int32)
+                    yield batch.replace(read_group_idx=rg), side, merged
             return
         import logging
 
         logging.getLogger(__name__).warning(
             "iter_alignment_batches(%s): %d sources with differing "
-            "sequence/read-group dictionaries — falling back to a "
-            "resident merged load (not out-of-core)", p, len(multi),
+            "sequence dictionaries — falling back to a resident "
+            "merged load (not out-of-core)", p, len(multi),
         )
         ds = load_alignments(p)
         yield ds.batch, ds.sidecar, ds.header

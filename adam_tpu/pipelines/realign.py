@@ -1302,10 +1302,24 @@ def _realign_indels_native(
     consensus generation / MD rewrite) in C++ (native/realign.cpp) and
     the sweep task machinery vectorized.  Returns None when the native
     library is unavailable (caller falls back to the Python path)."""
+    import time as _time
+
     from adam_tpu import native
+    from adam_tpu.utils import instrumentation as _ins
 
     if not native.available():
         return None
+    _t0 = _time.perf_counter()
+
+    def _phase(label):
+        # phase walls for -print_metrics (SweepReadOverReferenceForQuality
+        # -style named timers, instrumentation/Timers.scala:25-81);
+        # no-ops unless recording
+        nonlocal _t0
+        now = _time.perf_counter()
+        _ins.TIMERS.add(label, int((now - _t0) * 1e9))
+        _t0 = now
+
     b = ds.batch.to_numpy()
     n = b.n_rows
     if n == 0:
@@ -1341,12 +1355,14 @@ def _realign_indels_native(
     gen_consensus = not (
         consensus_model == "knowns" and known_indels is not None
     )
+    _phase("Realign: target map/group")
     prep = native.realign_prep(
         b, md_buf, md_off, md_valid.astype(np.uint8), srows, goff,
         gen_consensus,
     )
     if prep is None:
         return None
+    _phase("Realign: native prep")
 
     t_status = prep["t_status"]
     t_ref_off = prep["t_ref_off"]
@@ -1477,7 +1493,14 @@ def _realign_indels_native(
         p_lo = np.asarray(p_lo, np.int64)
         p_rt = np.where(p_n <= 16, 16, 128).astype(np.int32)
         p_offb = _pow2_vec(p_off, 512).astype(np.int64)
+        # intermediate 384 tier: WGS-shaped targets need 250-330 offsets,
+        # and the sweep's im2col+GEMM cost scales linearly with the
+        # padded off — the pow2 jump to 512 wasted ~40% on that band
+        p_offb = np.where(
+            (p_offb == 512) & (np.asarray(p_off) <= 384), 384, p_offb
+        )
 
+        _phase("Realign: consensus + tiles")
         bases_np = np.asarray(b.bases)
         quals_np = np.asarray(b.quals)
         L = bases_np.shape[1]
@@ -1526,6 +1549,7 @@ def _realign_indels_native(
                     off, rt, lr,
                 )))
 
+        _phase("Realign: sweep dispatch (host assembly)")
         if pending:
             # one fused fetch: per-chunk fetches each pay a tunnel
             # round trip on the time-sliced chip
@@ -1547,6 +1571,7 @@ def _realign_indels_native(
                     res_q[rb:rb + nrt] = q2[j, :nrt]
                     res_o[rb:rb + nrt] = o2[j, :nrt]
 
+    _phase("Realign: sweep fetch")
     # ---- scoring + rewrite decisions (numpy, one pass per group) -------
     new_batch = jax.tree.map(np.array, b)
     new_md: dict[int, Optional[str]] = {}
@@ -1726,10 +1751,11 @@ def _realign_indels_native(
         md=with_overrides(StringColumn.of(side.md), new_md),
         attrs=with_overrides(StringColumn.of(side.attrs), new_attrs),
     )
+    _phase("Realign: decisions + rewrite")
     return ds.with_batch(new_batch, new_side)
 
 
-def warm_sweep_shapes(offs=(512, 1024, 2048, 4096), rts=(16, 128),
+def warm_sweep_shapes(offs=(384, 512, 1024, 2048, 4096), rts=(16, 128),
                       lr: int = 128):
     """Compile the GEMM sweep tiers ahead of a timed run.
 

@@ -127,6 +127,18 @@ def _matmul_probe(reps: int = 10) -> float:
         return float("nan")
 
 
+def _denan(o):
+    """NaN -> None through nested dicts/lists: the artifact lines must
+    stay strict JSON (json.dumps would emit a bare NaN token)."""
+    if isinstance(o, float) and o != o:
+        return None
+    if isinstance(o, dict):
+        return {k: _denan(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_denan(v) for v in o]
+    return o
+
+
 def _host_load() -> float:
     try:
         return round(os.getloadavg()[0], 2)
@@ -389,7 +401,7 @@ def main() -> None:
 
     print(
         json.dumps(
-            {
+            _denan({
                 "metric": "transform_e2e_reads_per_sec_per_chip",
                 "value": round(rps, 1),
                 "median": round(rps_median, 1),
@@ -401,7 +413,7 @@ def main() -> None:
                     "CPU baseline = same input/code on host cores)"
                 ),
                 "vs_baseline": round(vs, 2) if vs is not None else None,
-            }
+            })
         )
     )
     # per-config reads/sec derived from the fused run's stage split
@@ -421,7 +433,7 @@ def main() -> None:
     scale4m = _scale_4m(time.perf_counter() - t_bench0)
     print(
         json.dumps(
-            {
+            _denan({
                 "metric": "secondary",
                 "sw": sw_info,
                 "kmers_per_sec": round(kps, 1),
@@ -444,7 +456,7 @@ def main() -> None:
                     for k, v in cpu_stats.items()
                     if k.endswith("_s") and isinstance(v, float)
                 },
-            }
+            })
         )
     )
 
