@@ -21,7 +21,7 @@ Per-partition semantics match Spark's mapPartitions contract: stages
 see one partition at a time (the Spark driver owns any cross-partition
 shuffle, exactly as it does for the reference's own implementations).
 Within a partition, stages run in the reference Transform order:
-duplicate marking -> BQSR -> indel realignment.
+duplicate marking -> indel realignment -> BQSR.
 
 A py4j/JNI bridge would hand the same batches over a socket; the
 stdin/stdout stream is the transport-agnostic core (and what the round
@@ -48,10 +48,10 @@ class StageConfig:
 
 
 def apply_stages(ds, cfg: StageConfig):
+    # reference composition: markdup -> realign -> BQSR
+    # (Transform.scala:121-144)
     if cfg.mark_duplicates:
         ds = ds.mark_duplicates()
-    if cfg.recalibrate:
-        ds = ds.recalibrate_base_qualities(known_snps=cfg.known_snps)
     if cfg.realign:
         kw = {}
         if cfg.known_indels is not None:
@@ -60,6 +60,8 @@ def apply_stages(ds, cfg: StageConfig):
         elif cfg.consensus_model != "reads":
             kw = dict(consensus_model=cfg.consensus_model)
         ds = ds.realign_indels(**kw)
+    if cfg.recalibrate:
+        ds = ds.recalibrate_base_qualities(known_snps=cfg.known_snps)
     return ds
 
 

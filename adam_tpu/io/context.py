@@ -302,16 +302,20 @@ def iter_alignment_batches(
                     [rgd.index(nm) for nm in h.read_groups.names],
                     np.int32,
                 )
+                identity = np.array_equal(
+                    gmap, np.arange(len(gmap), dtype=np.int32)
+                )
                 for batch, side, _h in iter_alignment_batches(
                     f, batch_reads=batch_reads, projection=projection
                 ):
-                    rg = np.asarray(batch.read_group_idx)
-                    if len(gmap):
+                    if len(gmap) and not identity:
+                        rg = np.asarray(batch.read_group_idx)
                         rg = np.where(
                             rg >= 0, gmap[np.clip(rg, 0, len(gmap) - 1)],
                             rg,
                         ).astype(np.int32)
-                    yield batch.replace(read_group_idx=rg), side, merged
+                        batch = batch.replace(read_group_idx=rg)
+                    yield batch, side, merged
             return
         import logging
 

@@ -16,12 +16,15 @@ GenomicPartitioners.scala:63-85):
    taken over compact spliced summaries, so duplicate groups whose
    mates landed in different bins and realignment targets spanning a
    bin edge resolve exactly as in one batch.
-4. **Pass B**: per-shard BQSR observation under resolved duplicate
-   flags; histogram merge; table solve.
-5. **Pass C**: per-shard recalibration apply + realignment-candidate
-   split; non-candidates write to the output part for that shard.
-6. **Tail**: candidates from all shards realign together (boundary
-   targets see all their reads) and land in the final part.
+4. **Pass B**: per-shard realignment-candidate split (pre-BQSR quals —
+   the reference composes markdup -> realign -> BQSR,
+   Transform.scala:121-144) + BQSR observation of each shard's
+   remainder under resolved duplicate flags.
+5. **Tail**: candidates from all shards realign together (boundary
+   targets see all their reads); the realigned part is observed with
+   its post-realignment alignments; histograms merge; table solve.
+6. **Pass C**: per-shard recalibration apply; parts write to the
+   output directory, the realigned part last.
 
 Each pass reads its shards through a bounded LRU cache
 (``cache_bytes``, default 4 GiB): shards that fit skip the re-decode on
@@ -200,40 +203,81 @@ def transform_sharded(
         )
         stats["resolve_s"] = time.perf_counter() - t
 
-        # ---- 4. pass B: observe under dup flags -----------------------
+        # ---- 4. pass B: candidate split (pre-BQSR, the reference's
+        # markdup -> realign -> BQSR composition, Transform.scala:121-144)
+        # + observe each shard's remainder under dup flags --------------
+        t = time.perf_counter()
+        candidates = []
+        obs_parts = []
+        for si in range(len(shard_paths)):
+            ds = with_dup_flags(load(si), si)
+            n_valid = ds.batch.n_rows
+            if targets:
+                # remainders are NOT carried to the apply pass — that
+                # would pin every shard at once; pass C re-splits (a
+                # cheap target-index lookup) under the same LRU cache
+                cand, ds, n_valid = realign_mod.split_realign_candidates(
+                    ds, targets, header.seq_dict.names
+                )
+                if cand is not None:
+                    candidates.append(cand)
+            if recalibrate and n_valid:
+                total, mism, _rg, g = bqsr_mod._observe_device(
+                    ds, known_snps
+                )
+                obs_parts.append((np.asarray(total), np.asarray(mism), g))
+        stats["observe_s"] = time.perf_counter() - t
+
+        # ---- 5. tail: realign candidates across shard edges, observe
+        # the realigned part with its post-realignment alignments -------
+        t = time.perf_counter()
+        realigned = None
+        if candidates:
+            cand = AlignmentDataset.concat(candidates)
+            realigned = realign_mod.realign_indels(
+                cand,
+                consensus_model=consensus_model,
+                known_indels=known_indels,
+                max_indel_size=mis,
+                max_consensus_number=mcn,
+                lod_threshold=lod,
+                max_target_size=mts,
+            )
+            if recalibrate and realigned.batch.n_rows:
+                total, mism, _rg, g = bqsr_mod._observe_device(
+                    realigned, known_snps
+                )
+                obs_parts.append((np.asarray(total), np.asarray(mism), g))
+        stats["realign_s"] = time.perf_counter() - t
+
+        # ---- barrier: merge histograms, solve the table ---------------
         t = time.perf_counter()
         table = None
         gl = 0
-        if recalibrate:
-            parts = []
-            for si in range(len(shard_paths)):
-                ds = with_dup_flags(load(si), si)
-                total, mism, _rg, g = bqsr_mod._observe_device(ds, known_snps)
-                parts.append((np.asarray(total), np.asarray(mism), g))
-            total, mism, gl = bqsr_mod.merge_observations(parts)
+        if recalibrate and obs_parts:
+            total, mism, gl = bqsr_mod.merge_observations(obs_parts)
             if dump_observations:
                 bqsr_mod.dump_observation_csv(
                     total, mism, header.read_groups.names + ["null"], gl,
                     dump_observations,
                 )
             table = bqsr_mod.solve_recalibration_table(total, mism)
-        stats["observe_s"] = time.perf_counter() - t
+        stats["solve_s"] = time.perf_counter() - t
 
-        # ---- 5. pass C: apply + split || part writes ------------------
+        # ---- 6. pass C: apply || part writes --------------------------
         # a writer pool encodes finished shards while the next shard's
         # apply runs (the streamed path's layout; Parquet encode is
         # arrow C++ and releases the GIL around compression/IO)
         from concurrent.futures import ThreadPoolExecutor
 
         t = time.perf_counter()
-        candidates = []
         futures = []
         n_writers = 3
         with ThreadPoolExecutor(max_workers=n_writers) as pool:
             def _submit_write(idx, ds):
                 # backpressure: each pending future pins a whole shard,
                 # so cap in-flight writes to bound pass C's residency at
-                # n_writers shards beyond the one being split
+                # n_writers shards beyond the one being applied
                 while sum(1 for f in futures if not f.done()) >= n_writers:
                     next(f for f in futures if not f.done()).result()
                 futures.append(pool.submit(
@@ -245,36 +289,25 @@ def transform_sharded(
                 ev = _cache.pop(si, None)  # final pass: free as we go
                 if ev is not None:
                     _cache_total[0] -= ev[1]
+                if targets:
+                    # mask-only re-split: drop candidate rows without
+                    # gathering a throwaway candidate dataset
+                    b2 = ds.batch.to_numpy()
+                    tidx = realign_mod.map_batch_to_targets(
+                        b2, targets, header.seq_dict.names
+                    )
+                    ds = ds.take_rows(np.flatnonzero(tidx < 0))
                 if table is not None:
                     ds = bqsr_mod.apply_recalibration(ds, table, gl)
-                n_valid = ds.batch.n_rows
-                if targets:
-                    cand, ds, n_valid = (
-                        realign_mod.split_realign_candidates(
-                            ds, targets, header.seq_dict.names
-                        )
-                    )
-                    if cand is not None:
-                        candidates.append(cand)
-                if n_valid:
+                if ds.batch.n_rows:
                     _submit_write(si, ds)
+            if realigned is not None:
+                if table is not None:
+                    realigned = bqsr_mod.apply_recalibration(
+                        realigned, table, gl
+                    )
+                _submit_write(len(shard_paths), realigned)
             stats["apply_split_s"] = time.perf_counter() - t
-
-            # ---- 6. tail: realign candidates across shard edges -------
-            t = time.perf_counter()
-            if candidates:
-                cand = AlignmentDataset.concat(candidates)
-                cand = realign_mod.realign_indels(
-                    cand,
-                    consensus_model=consensus_model,
-                    known_indels=known_indels,
-                    max_indel_size=mis,
-                    max_consensus_number=mcn,
-                    lod_threshold=lod,
-                    max_target_size=mts,
-                )
-                _submit_write(len(shard_paths), cand)
-            stats["realign_s"] = time.perf_counter() - t
 
             t = time.perf_counter()
             for f in futures:

@@ -16,16 +16,20 @@ pipeline runs as three overlapped passes with two global barriers:
            decisions the single-batch path makes, so window edges are
            invisible (a duplicate group or realignment target spanning
            two windows resolves exactly as in one batch).
-  pass B   per-window BQSR observation (threaded host histogram) under
-           the resolved duplicate flags.
-  barrier  merge histograms, solve the recalibration table.
-  pass C   per-window recalibration apply + candidate split, while a
-           writer pool encodes finished windows to Parquet part files
-           (the Spark executor part-file layout: ``out.adam/part-*``).
+  pass B   per-window realignment-candidate split (pre-BQSR quals, as
+           the reference composes: markdup -> realign -> BQSR,
+           Transform.scala:121-144) + BQSR observation of each window's
+           remainder under the resolved duplicate flags.
   tail     rows mapped to realignment targets (gathered across all
            windows, so boundary-spanning targets see all their reads)
-           realign together — device sweep kernels — and land in the
-           final part file.
+           realign together — device sweep kernels — then the realigned
+           part is observed with its POST-realignment alignments (the
+           composition-order-visible piece of adamBQSR-after-realign).
+  barrier  merge histograms, solve the recalibration table.
+  pass C   per-window recalibration apply, while a writer pool encodes
+           finished windows to Parquet part files (the Spark executor
+           part-file layout: ``out.adam/part-*``); the realigned part
+           applies and lands in the final part file.
 
 Wall-clock goal: max(stage) instead of sum(stages) — host codecs and
 device kernels run at the same time, which is what a TPU-attached host
@@ -204,66 +208,91 @@ def transform_streamed(
     )
     stats["resolve_s"] = time.perf_counter() - t
 
-    # ---- pass B: per-window observation -------------------------------
+    # ---- pass B: candidate split (pre-BQSR, reference order) + observe
+    # each window's remainder --------------------------------------------
+    t = time.perf_counter()
+    candidates: list[AlignmentDataset] = []
+    window_valid: list[int] = []
+    obs_parts = []
+    for i, w in enumerate(windows):
+        n_valid = w.batch.n_rows
+        if targets:
+            cand, w, n_valid = realign_mod.split_realign_candidates(
+                w, targets, header.seq_dict.names
+            )
+            if cand is not None:
+                candidates.append(cand)
+            windows[i] = w
+        window_valid.append(n_valid)
+        if recalibrate and n_valid:
+            # non-candidate rows are untouched by realignment, so their
+            # observations are identical on either side of it
+            total, mism, _rg, g = bqsr_mod._observe_device(w, known_snps)
+            obs_parts.append((np.asarray(total), np.asarray(mism), g))
+    stats["observe_s"] = time.perf_counter() - t
+
+    # ---- tail: realign the gathered candidates, then observe them with
+    # their post-realignment alignments (markdup -> realign -> BQSR, the
+    # reference's Transform composition) ---------------------------------
+    t = time.perf_counter()
+    realigned: Optional[AlignmentDataset] = None
+    if candidates:
+        cand = AlignmentDataset.concat(candidates)
+        realigned = realign_mod.realign_indels(
+            cand,
+            consensus_model=consensus_model,
+            known_indels=known_indels,
+            max_indel_size=mis,
+            max_consensus_number=mcn,
+            lod_threshold=lod,
+            max_target_size=mts,
+        )
+        if recalibrate and realigned.batch.n_rows:
+            total, mism, _rg, g = bqsr_mod._observe_device(
+                realigned, known_snps
+            )
+            obs_parts.append((np.asarray(total), np.asarray(mism), g))
+    stats["realign_s"] = time.perf_counter() - t
+
+    # ---- barrier 2: merge histograms, solve the table ------------------
     t = time.perf_counter()
     table = None
     gl = 0
-    if recalibrate:
-        parts = []
-        for w in windows:
-            total, mism, _rg, g = bqsr_mod._observe_device(w, known_snps)
-            parts.append((np.asarray(total), np.asarray(mism), g))
-        total, mism, gl = bqsr_mod.merge_observations(parts)
+    if recalibrate and obs_parts:
+        total, mism, gl = bqsr_mod.merge_observations(obs_parts)
         if dump_observations:
             bqsr_mod.dump_observation_csv(
                 total, mism, header.read_groups.names + ["null"], gl,
                 dump_observations,
             )
         table = bqsr_mod.solve_recalibration_table(total, mism)
-    stats["observe_s"] = time.perf_counter() - t
+    stats["solve_s"] = time.perf_counter() - t
 
-    # ---- pass C: apply + candidate split || part writes ---------------
+    # ---- pass C: apply || part writes ----------------------------------
     t = time.perf_counter()
-    candidates: list[AlignmentDataset] = []
     write_errs: list[BaseException] = []
     futures = []
     with ThreadPoolExecutor(max_workers=max(1, n_writers)) as pool:
         for i, w in enumerate(windows):
             if table is not None:
                 w = bqsr_mod.apply_recalibration(w, table, gl)
-            n_valid = w.batch.n_rows
-            if targets:
-                cand, w, n_valid = realign_mod.split_realign_candidates(
-                    w, targets, header.seq_dict.names
-                )
-                if cand is not None:
-                    candidates.append(cand)
             windows[i] = None  # free as we go
-            if n_valid:
+            if window_valid[i]:
                 futures.append(
                     pool.submit(_write_part, out_path, i, w, compression)
                 )
-        stats["apply_split_s"] = time.perf_counter() - t
-
-        # ---- tail: realign the gathered candidates --------------------
-        t = time.perf_counter()
-        if candidates:
-            cand = AlignmentDataset.concat(candidates)
-            cand = realign_mod.realign_indels(
-                cand,
-                consensus_model=consensus_model,
-                known_indels=known_indels,
-                max_indel_size=mis,
-                max_consensus_number=mcn,
-                lod_threshold=lod,
-                max_target_size=mts,
-            )
+        if realigned is not None:
+            if table is not None:
+                realigned = bqsr_mod.apply_recalibration(
+                    realigned, table, gl
+                )
             futures.append(
                 pool.submit(
-                    _write_part, out_path, len(windows), cand, compression
+                    _write_part, out_path, len(windows), realigned,
+                    compression,
                 )
             )
-        stats["realign_s"] = time.perf_counter() - t
+        stats["apply_split_s"] = time.perf_counter() - t
 
         t = time.perf_counter()
         for f in futures:
@@ -285,9 +314,10 @@ def transform_streamed(
     for key, label in (
         ("ingest_pass_s", "Streamed Pass A (ingest + summaries)"),
         ("resolve_s", "Streamed Barrier (dup resolve + targets)"),
-        ("observe_s", "Streamed Pass B (BQSR observe)"),
-        ("apply_split_s", "Streamed Pass C (apply + split)"),
-        ("realign_s", "Streamed Tail (realign)"),
+        ("observe_s", "Streamed Pass B (split + BQSR observe)"),
+        ("realign_s", "Streamed Tail (realign + observe realigned)"),
+        ("solve_s", "Streamed Barrier (solve recalibration)"),
+        ("apply_split_s", "Streamed Pass C (apply)"),
         ("write_wait_s", "Streamed Write Wait"),
     ):
         if key in stats:

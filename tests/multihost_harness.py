@@ -11,8 +11,8 @@ Usage: python multihost_harness.py <coordinator> <num_procs> <proc_id>
 Prints "HARNESS OK <checksum>" on success from every process.
 
 The ``transform`` mode runs the COMPOSED flagship transform
-(markdup + BQSR + realign) across the two processes over a shared raw
-shard store: each process owns alternating genome-bin shards,
+(markdup -> realign -> BQSR, the reference's Transform composition)
+across the processes over a shared raw shard store: each process owns alternating genome-bin shards,
 duplicate-marking summaries and realignment candidates exchange through
 spill files (the disk-shuffle role Spark's block manager plays), and
 the BQSR observation histograms merge with a REAL cross-process device
@@ -195,12 +195,55 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
             np.asarray(b.flags), dup_slices[si]
         )))
 
-    # ---- pass B: local observation, cross-process device psum ----------
+    # ---- pass B: candidate split (pre-BQSR, the reference's markdup ->
+    # realign -> BQSR order) + local observation of shard remainders ----
     parts = []
+    cand_local = []
     for si in mine:
         ds = with_dup(load(si), si)
-        total, mism, _rg, g = bqsr_mod._observe_device(ds, None)
-        parts.append((np.asarray(total), np.asarray(mism), g))
+        n_valid = ds.batch.n_rows
+        if targets:
+            b = ds.batch.to_numpy()
+            tidx = realign_mod.map_batch_to_targets(
+                b, targets, header.seq_dict.names
+            )
+            keep = tidx >= 0
+            if keep.any():
+                cand_local.append(ds.take_rows(np.flatnonzero(keep)))
+                ds = ds.take_rows(np.flatnonzero(~keep))
+                n_valid = ds.batch.n_rows
+        if n_valid:
+            total, mism, _rg, g = bqsr_mod._observe_device(ds, None)
+            parts.append((np.asarray(total), np.asarray(mism), g))
+
+    # exchange candidates; pid 0 realigns them all (boundary-correct)
+    # and observes the realigned part so its POST-realignment
+    # observations enter the global table
+    cpath = os.path.join(shard_dir, f"cand-{pid}.arrows")
+    if cand_local:
+        cand = AlignmentDataset.concat(cand_local)
+        w = spill.RawShardWriter(cpath)
+        w.append(cand.batch, cand.sidecar, cand.header)
+        w.close()
+    barrier("candidates")
+    realigned = None
+    if pid == 0:
+        cands = []
+        for p2 in range(n_procs):
+            cp = os.path.join(shard_dir, f"cand-{p2}.arrows")
+            if os.path.exists(cp):
+                b2, s2, h2 = spill.read_raw_shard(cp)
+                cands.append(AlignmentDataset(b2, s2, h2))
+        if cands:
+            realigned = realign_mod.realign_indels(
+                AlignmentDataset.concat(cands)
+            )
+            if realigned.batch.n_rows:
+                total, mism, _rg, g = bqsr_mod._observe_device(
+                    realigned, None
+                )
+                parts.append((np.asarray(total), np.asarray(mism), g))
+
     if parts:
         lt, lm, lgl = bqsr_mod.merge_observations(parts)
     else:
@@ -238,41 +281,22 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
     mism = psum_table(pm)
     table = bqsr_mod.solve_recalibration_table(total, mism)
 
-    # ---- pass C: apply + split; exchange candidates; realign -----------
-    cand_local = []
+    # ---- pass C: apply the global table to shard remainders (re-split
+    # under the same rule) and, on pid 0, to the realigned part ----------
     for si in mine:
         ds = with_dup(load(si), si)
-        ds = bqsr_mod.apply_recalibration(ds, table, gl)
         if targets:
             b = ds.batch.to_numpy()
             tidx = realign_mod.map_batch_to_targets(
                 b, targets, header.seq_dict.names
             )
-            keep = tidx >= 0
-            if keep.any():
-                cand_local.append(ds.take_rows(np.flatnonzero(keep)))
-                ds = ds.take_rows(np.flatnonzero(~keep))
+            ds = ds.take_rows(np.flatnonzero(tidx < 0))
+        ds = bqsr_mod.apply_recalibration(ds, table, gl)
         if ds.batch.n_rows:
             _write_part(out_dir, si, ds, "snappy")
-    cpath = os.path.join(shard_dir, f"cand-{pid}.arrows")
-    if cand_local:
-        cand = AlignmentDataset.concat(cand_local)
-        w = spill.RawShardWriter(cpath)
-        w.append(cand.batch, cand.sidecar, cand.header)
-        w.close()
-    barrier("candidates")
-    cands = []
-    for p in range(n_procs):
-        cp = os.path.join(shard_dir, f"cand-{p}.arrows")
-        if os.path.exists(cp):
-            b, s, h = spill.read_raw_shard(cp)
-            cands.append(AlignmentDataset(b, s, h))
-    if cands and pid == 0:
-        # boundary-correct: targets spanning shard/process edges see all
-        # their reads; one process owns the realigned part
-        cand = AlignmentDataset.concat(cands)
-        cand = realign_mod.realign_indels(cand)
-        _write_part(out_dir, len(shard_paths), cand, "snappy")
+    if realigned is not None:
+        realigned = bqsr_mod.apply_recalibration(realigned, table, gl)
+        _write_part(out_dir, len(shard_paths), realigned, "snappy")
     barrier("done")
     import resource
 

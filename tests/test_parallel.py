@@ -301,8 +301,8 @@ def test_composed_transform_n_processes(tmp_path, n_procs, n_shards):
     mono = (
         context.load_alignments(sam)
         .mark_duplicates()
-        .recalibrate_base_qualities()
         .realign_indels()
+        .recalibrate_base_qualities()
     )
 
     out_dir = str(tmp_path / "out.adam")

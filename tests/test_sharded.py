@@ -40,8 +40,8 @@ def test_sharded_matches_monolithic_wgs(tmp_path):
     mono = (
         context.load_alignments(path)
         .mark_duplicates()
-        .recalibrate_base_qualities()
         .realign_indels()
+        .recalibrate_base_qualities()
     )
     out = str(tmp_path / "out.adam")
     stats = transform_sharded(path, out, n_shards=4, batch_reads=1024)
@@ -100,8 +100,8 @@ def test_sharded_cross_bin_duplicates_and_targets(tmp_path):
     mono = (
         context.load_alignments(path)
         .mark_duplicates()
-        .recalibrate_base_qualities()
         .realign_indels()
+        .recalibrate_base_qualities()
     )
     out = str(tmp_path / "out.adam")
     transform_sharded(path, out, n_shards=3, batch_reads=8)

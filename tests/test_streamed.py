@@ -69,8 +69,8 @@ def test_streamed_matches_monolithic(tmp_path):
     mono = (
         context.load_alignments(path)
         .mark_duplicates()
-        .recalibrate_base_qualities()
         .realign_indels()
+        .recalibrate_base_qualities()
     )
     out = str(tmp_path / "out.adam")
     stats = transform_streamed(path, out, window_reads=1024)
@@ -125,8 +125,8 @@ def test_streamed_boundary_duplicates_and_targets(tmp_path):
     mono = (
         context.load_alignments(path)
         .mark_duplicates()
-        .recalibrate_base_qualities()
         .realign_indels()
+        .recalibrate_base_qualities()
     )
     out = str(tmp_path / "out.adam")
     transform_streamed(path, out, window_reads=8)
