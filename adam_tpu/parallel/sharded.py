@@ -206,30 +206,63 @@ def transform_sharded(
         # ---- 4. pass B: candidate split (pre-BQSR, the reference's
         # markdup -> realign -> BQSR composition, Transform.scala:121-144)
         # + observe each shard's remainder under dup flags --------------
+        # remainder datasets are NOT carried across passes (that would
+        # pin every shard at once); a per-shard candidate bitmask is —
+        # ~n_rows bytes each — so the observe and apply passes mask the
+        # same membership without recomputing the target mapping
         t = time.perf_counter()
         candidates = []
-        obs_parts = []
+        splits = []
+        cand_masks: dict[int, np.ndarray] = {}
         for si in range(len(shard_paths)):
             ds = with_dup_flags(load(si), si)
             n_valid = ds.batch.n_rows
             if targets:
-                # remainders are NOT carried to the apply pass — that
-                # would pin every shard at once; pass C re-splits (a
-                # cheap target-index lookup) under the same LRU cache
-                cand, ds, n_valid = realign_mod.split_realign_candidates(
-                    ds, targets, header.seq_dict.names
+                b2 = ds.batch.to_numpy()
+                mask = realign_mod.candidate_mask(
+                    b2, targets, header.seq_dict.names
                 )
-                if cand is not None:
-                    candidates.append(cand)
-            if recalibrate and n_valid:
-                total, mism, _rg, g = bqsr_mod._observe_device(
-                    ds, known_snps
+                cand_masks[si] = mask
+                if mask.any():
+                    candidates.append(
+                        ds.take_rows(np.flatnonzero(mask))
+                    )
+                ds = realign_mod.mask_out_candidates(
+                    ds, targets, header.seq_dict.names, mask=mask
                 )
-                obs_parts.append((np.asarray(total), np.asarray(mism), g))
-        stats["observe_s"] = time.perf_counter() - t
+                n_valid = int(np.asarray(ds.batch.valid).sum())
+            splits.append((si, n_valid))
+        stats["split_s"] = time.perf_counter() - t
 
-        # ---- 5. tail: realign candidates across shard edges, observe
-        # the realigned part with its post-realignment alignments -------
+        obs_parts = []
+
+        def _observe_remainders():
+            # hidden under the realign sweeps' device drain (remainder
+            # rows are untouched by realignment, so observing them on
+            # either side of it is equivalent); shards re-read through
+            # the LRU cache and re-split by the same rule
+            t0 = time.perf_counter()
+            if recalibrate:
+                for si, n_valid in splits:
+                    if not n_valid:
+                        continue
+                    ds = with_dup_flags(load(si), si)
+                    if si in cand_masks:
+                        ds = realign_mod.mask_out_candidates(
+                            ds, targets, header.seq_dict.names,
+                            mask=cand_masks[si],
+                        )
+                    total, mism, _rg, g = bqsr_mod._observe_device(
+                        ds, known_snps
+                    )
+                    obs_parts.append(
+                        (np.asarray(total), np.asarray(mism), g)
+                    )
+            stats["observe_s"] = time.perf_counter() - t0
+
+        # ---- 5. tail: realign candidates across shard edges (observing
+        # shard remainders under the device wait), then observe the
+        # realigned part with its post-realignment alignments -----------
         t = time.perf_counter()
         realigned = None
         if candidates:
@@ -242,13 +275,18 @@ def transform_sharded(
                 max_consensus_number=mcn,
                 lod_threshold=lod,
                 max_target_size=mts,
+                overlap_work=_observe_remainders,
             )
             if recalibrate and realigned.batch.n_rows:
                 total, mism, _rg, g = bqsr_mod._observe_device(
                     realigned, known_snps
                 )
                 obs_parts.append((np.asarray(total), np.asarray(mism), g))
-        stats["realign_s"] = time.perf_counter() - t
+        else:
+            _observe_remainders()
+        stats["realign_s"] = (
+            time.perf_counter() - t - stats.get("observe_s", 0.0)
+        )
 
         # ---- barrier: merge histograms, solve the table ---------------
         t = time.perf_counter()
@@ -289,17 +327,16 @@ def transform_sharded(
                 ev = _cache.pop(si, None)  # final pass: free as we go
                 if ev is not None:
                     _cache_total[0] -= ev[1]
-                if targets:
-                    # mask-only re-split: drop candidate rows without
-                    # gathering a throwaway candidate dataset
-                    b2 = ds.batch.to_numpy()
-                    tidx = realign_mod.map_batch_to_targets(
-                        b2, targets, header.seq_dict.names
+                if si in cand_masks:
+                    # mask-only: clear candidate rows' valid bit (the
+                    # writers filter on valid; no keep-side copy)
+                    ds = realign_mod.mask_out_candidates(
+                        ds, targets, header.seq_dict.names,
+                        mask=cand_masks[si],
                     )
-                    ds = ds.take_rows(np.flatnonzero(tidx < 0))
                 if table is not None:
                     ds = bqsr_mod.apply_recalibration(ds, table, gl)
-                if ds.batch.n_rows:
+                if int(np.asarray(ds.batch.valid).sum()):
                     _submit_write(si, ds)
             if realigned is not None:
                 if table is not None:

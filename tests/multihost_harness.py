@@ -204,14 +204,15 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
         n_valid = ds.batch.n_rows
         if targets:
             b = ds.batch.to_numpy()
-            tidx = realign_mod.map_batch_to_targets(
+            keep = realign_mod.candidate_mask(
                 b, targets, header.seq_dict.names
             )
-            keep = tidx >= 0
             if keep.any():
                 cand_local.append(ds.take_rows(np.flatnonzero(keep)))
-                ds = ds.take_rows(np.flatnonzero(~keep))
-                n_valid = ds.batch.n_rows
+                ds = realign_mod.mask_out_candidates(
+                    ds, targets, header.seq_dict.names, mask=keep
+                )
+                n_valid = int(np.asarray(ds.batch.valid).sum())
         if n_valid:
             total, mism, _rg, g = bqsr_mod._observe_device(ds, None)
             parts.append((np.asarray(total), np.asarray(mism), g))
@@ -286,13 +287,11 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
     for si in mine:
         ds = with_dup(load(si), si)
         if targets:
-            b = ds.batch.to_numpy()
-            tidx = realign_mod.map_batch_to_targets(
-                b, targets, header.seq_dict.names
+            ds = realign_mod.mask_out_candidates(
+                ds, targets, header.seq_dict.names
             )
-            ds = ds.take_rows(np.flatnonzero(tidx < 0))
         ds = bqsr_mod.apply_recalibration(ds, table, gl)
-        if ds.batch.n_rows:
+        if int(np.asarray(ds.batch.valid).sum()):
             _write_part(out_dir, si, ds, "snappy")
     if realigned is not None:
         realigned = bqsr_mod.apply_recalibration(realigned, table, gl)
