@@ -342,3 +342,46 @@ def test_sweep_gemm_kernel_matches_scan_kernel():
     )
     np.testing.assert_array_equal(np.asarray(sq), np.asarray(bq)[0, :n])
     np.testing.assert_array_equal(np.asarray(so), np.asarray(bo)[0, :n])
+
+
+def test_realign_overlap_work_runs_exactly_once(ref_resources, monkeypatch):
+    """The overlap_work hook fires exactly once on every service path:
+    the native sweep window, the no-target early returns, the forced
+    Python fallback, and — the real double-run hazard — the native path
+    running the hook at dispatch and THEN handing off to the fallback
+    (it feeds BQSR histograms; a double run skews the table)."""
+    from adam_tpu import native
+
+    ds = load_alignments(str(ref_resources / "artificial.sam"))
+    calls = {"n": 0}
+
+    def hook():
+        calls["n"] += 1
+
+    out = ra.realign_indels(ds, overlap_work=hook)
+    assert calls["n"] == 1
+    assert out.batch.n_rows == ds.batch.n_rows
+
+    # no targets: early return still runs the hook once
+    calls["n"] = 0
+    rows = np.flatnonzero(np.asarray(ds.batch.cigar_n) == 1)[:2]
+    assert len(rows) > 0, "fixture lost its pure-match reads"
+    ra.realign_indels(ds.take_rows(rows), overlap_work=hook)
+    assert calls["n"] == 1
+
+    # forced Python fallback path
+    calls["n"] = 0
+    monkeypatch.setenv("ADAM_TPU_REALIGN", "py")
+    ra.realign_indels(ds, overlap_work=hook)
+    monkeypatch.delenv("ADAM_TPU_REALIGN")
+    assert calls["n"] == 1
+
+    # native->fallback handoff AFTER the hook already ran: the native
+    # path's MD rewrite fails late, the Python oracle serves the call,
+    # and the hook must still have run exactly once
+    calls["n"] = 0
+    monkeypatch.setattr(native, "md_move_batch",
+                        lambda *a, **k: None)
+    out2 = ra.realign_indels(ds, overlap_work=hook)
+    assert calls["n"] == 1
+    assert out2.batch.n_rows == ds.batch.n_rows
