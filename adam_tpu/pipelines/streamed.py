@@ -291,14 +291,9 @@ def transform_streamed(
     write_errs: list[BaseException] = []
     futures = []
     with ThreadPoolExecutor(max_workers=max(1, n_writers)) as pool:
-        for i, w in enumerate(windows):
-            if table is not None:
-                w = bqsr_mod.apply_recalibration(w, table, gl)
-            windows[i] = None  # free as we go
-            if window_valid[i]:
-                futures.append(
-                    pool.submit(_write_part, out_path, i, w, compression)
-                )
+        # the realigned part applies and submits FIRST: it is the
+        # largest part, so its encode+write should overlap the window
+        # applies instead of draining serially after them
         if realigned is not None:
             if table is not None:
                 realigned = bqsr_mod.apply_recalibration(
@@ -310,6 +305,14 @@ def transform_streamed(
                     compression,
                 )
             )
+        for i, w in enumerate(windows):
+            if table is not None:
+                w = bqsr_mod.apply_recalibration(w, table, gl)
+            windows[i] = None  # free as we go
+            if window_valid[i]:
+                futures.append(
+                    pool.submit(_write_part, out_path, i, w, compression)
+                )
         stats["apply_split_s"] = time.perf_counter() - t
 
         t = time.perf_counter()
