@@ -38,7 +38,7 @@ class AlignmentDataset:
         return context.load_alignments(path, **kw)
 
     def save(self, path: str, sort_order: Optional[str] = None,
-             compression: str = "snappy") -> None:
+             compression: str = "zstd") -> None:
         """Dispatch on extension like adamSave/adamSAMSave."""
         p = str(path)
         if p.endswith(".sam"):

@@ -51,8 +51,8 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         "(FASTQ pairing/export paths)",
     )
     parser.add_argument(
-        "-parquet_compression_codec", default="snappy",
-        choices=["uncompressed", "snappy", "gzip", "lzo", "zstd"],
+        "-parquet_compression_codec", default="zstd",
+        choices=["uncompressed", "snappy", "gzip", "zstd"],
         help="parquet compression codec",
     )
     parser.add_argument(

@@ -38,6 +38,17 @@ from adam_tpu.models.dictionaries import (
     SequenceRecord,
 )
 
+def parquet_codec_kw(compression: str) -> dict:
+    """Writer kwargs for a codec name — ONE place pins zstd at level 1
+    (measured faster than snappy at ~45% smaller parts; pyarrow's
+    current default zstd level happens to equal 1, but the pin protects
+    the measured write cost against upstream default drift)."""
+    kw = {"compression": compression}
+    if compression == "zstd":
+        kw["compression_level"] = 1
+    return kw
+
+
 # Full column list (the AlignmentRecordField analog).
 ALIGNMENT_FIELDS = [
     "readName", "sequence", "qual", "flags", "contig", "start", "end",
@@ -223,7 +234,7 @@ def to_arrow_alignments(
 
 def save_alignments(
     path: str, batch: ReadBatch, side: ReadSidecar, header: SamHeader,
-    compression: str = "snappy",
+    compression: str = "zstd",
 ) -> None:
     from adam_tpu.utils import instrumentation as ins
 
@@ -235,8 +246,9 @@ def save_alignments(
         # readName/sequence/qual columns builds dicts it then abandons
         # (~20% of write time on a WGS-shaped part)
         pq.write_table(
-            table, path, compression=compression,
+            table, path,
             use_dictionary=["contig", "mateContig", "recordGroupName"],
+            **parquet_codec_kw(compression),
         )
 
 
@@ -485,7 +497,7 @@ def _seq_dict_from_meta(meta) -> "SequenceDictionary":
 
 
 def save_genotypes(path: str, variants, genotypes, seq_dict,
-                   compression: str = "snappy",
+                   compression: str = "zstd",
                    typed_annotations=None) -> None:
     """``typed_annotations``: ``{adamKey: [value-or-None per variant]}``
     from formats/annotations.split_typed — stored as real typed
@@ -545,7 +557,7 @@ def save_genotypes(path: str, variants, genotypes, seq_dict,
             )
     vt = pa.table(cols).replace_schema_metadata(_seq_dict_meta(seq_dict))
     pq.write_table(vt, os.path.join(path, "variants.parquet"),
-                   compression=compression)
+                   **parquet_codec_kw(compression))
 
     gt = pa.table(
         {
@@ -580,7 +592,7 @@ def save_genotypes(path: str, variants, genotypes, seq_dict,
         }
     )
     pq.write_table(gt, os.path.join(path, "genotypes.parquet"),
-                   compression=compression)
+                   **parquet_codec_kw(compression))
 
 
 def _likelihood_matrix(col, m: int, what: str) -> np.ndarray:
@@ -830,7 +842,7 @@ def load_genotypes(path: str, contig_names=None, projection=None,
 # Feature storage (features2adam target).
 # ===================================================================
 
-def save_features(path: str, feats, compression: str = "snappy") -> None:
+def save_features(path: str, feats, compression: str = "zstd") -> None:
     side = feats.sidecar
     t = pa.table(
         {
@@ -853,7 +865,7 @@ def save_features(path: str, feats, compression: str = "snappy") -> None:
             ),
         }
     )
-    pq.write_table(t, path, compression=compression)
+    pq.write_table(t, path, **parquet_codec_kw(compression))
 
 
 def load_features(path: str, projection=None, filters=None):
@@ -904,7 +916,7 @@ def load_features(path: str, projection=None, filters=None):
 # ===================================================================
 
 def save_fragments(path: str, fragments, seq_dict,
-                   descriptions=None, compression: str = "snappy") -> None:
+                   descriptions=None, compression: str = "zstd") -> None:
     b = fragments.to_numpy()
     rows = np.flatnonzero(np.asarray(b.valid))
     # descriptions: contig_idx -> description; read_fasta hands back a
@@ -942,7 +954,7 @@ def save_fragments(path: str, fragments, seq_dict,
             ),
         }
     ).replace_schema_metadata(_seq_dict_meta(seq_dict))
-    pq.write_table(t, path, compression=compression)
+    pq.write_table(t, path, **parquet_codec_kw(compression))
 
 
 def load_fragments(path: str, projection=None, filters=None):

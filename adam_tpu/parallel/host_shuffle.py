@@ -25,7 +25,7 @@ def shuffle_alignments_to_shards(
     batches: Iterable,
     n_shards: int,
     out_dir: str,
-    compression: str = "snappy",
+    compression: str = "zstd",
     fmt: str = "parquet",
 ) -> list[str]:
     """Stream (batch, sidecar, header) triples into per-genome-bin shards.
@@ -89,9 +89,11 @@ def shuffle_alignments_to_shards(
                     continue
                 table = to_arrow_alignments(sub, sub_side, header)
                 if s not in writers:
+                    from adam_tpu.io.parquet import parquet_codec_kw
+
                     paths[s] = shard_path(s)
                     writers[s] = pq.ParquetWriter(
-                        paths[s], table.schema, compression=compression
+                        paths[s], table.schema, **parquet_codec_kw(compression)
                     )
                 writers[s].write_table(table)
     finally:
@@ -105,7 +107,7 @@ def shuffle_bam_to_shards(
     n_shards: int,
     out_dir: str,
     batch_reads: int = 500_000,
-    compression: str = "snappy",
+    compression: str = "zstd",
 ) -> list[str]:
     """Windowed BAM reader -> genome-bin Parquet shards, end to end out
     of core (a WGS BAM never resides in memory)."""

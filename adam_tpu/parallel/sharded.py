@@ -58,7 +58,7 @@ def transform_sharded(
     known_snps=None,
     known_indels=None,
     consensus_model: str = "reads",
-    compression: str = "snappy",
+    compression: str = "zstd",
     shuffle_dir: str | None = None,
     batch_reads: int = 500_000,
     max_indel_size: int | None = None,

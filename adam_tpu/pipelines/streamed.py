@@ -110,7 +110,7 @@ def transform_streamed(
     known_indels=None,
     consensus_model: str = "reads",
     window_reads: int = 262_144,
-    compression: str = "snappy",
+    compression: str = "zstd",
     n_writers: int = 3,
     max_indel_size: int | None = None,
     max_consensus_number: int | None = None,

@@ -60,10 +60,12 @@ def flatten_table(table: pa.Table) -> pa.Table:
 
 
 def flatten_parquet(in_path: str, out_path: str,
-                    compression: str = "snappy") -> None:
+                    compression: str = "zstd") -> None:
     table = pq.read_table(in_path)
     meta = table.schema.metadata
     flat = flatten_table(table)
     if meta:
         flat = flat.replace_schema_metadata(meta)
-    pq.write_table(flat, out_path, compression=compression)
+    from adam_tpu.io.parquet import parquet_codec_kw
+
+    pq.write_table(flat, out_path, **parquet_codec_kw(compression))

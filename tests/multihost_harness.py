@@ -292,10 +292,10 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
             )
         ds = bqsr_mod.apply_recalibration(ds, table, gl)
         if int(np.asarray(ds.batch.valid).sum()):
-            _write_part(out_dir, si, ds, "snappy")
+            _write_part(out_dir, si, ds, "zstd")
     if realigned is not None:
         realigned = bqsr_mod.apply_recalibration(realigned, table, gl)
-        _write_part(out_dir, len(shard_paths), realigned, "snappy")
+        _write_part(out_dir, len(shard_paths), realigned, "zstd")
     barrier("done")
     import resource
 
