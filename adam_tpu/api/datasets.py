@@ -143,15 +143,26 @@ class AlignmentDataset:
 
         return sort.sort_by_reference_position(self)
 
-    def mark_duplicates(self) -> "AlignmentDataset":
+    def mark_duplicates(self, backend: Optional[str] = None) -> "AlignmentDataset":
+        """``backend`` picks the per-residue kernel set — ``device`` (jit
+        chip kernels, the default when an accelerator is attached),
+        ``native`` (threaded C++), or ``numpy``; None defers to
+        ``ADAM_TPU_BQSR_BACKEND`` / topology (see
+        :func:`adam_tpu.pipelines.bqsr.bqsr_backend`)."""
         from adam_tpu.pipelines import markdup
 
-        return markdup.mark_duplicates(self)
+        return markdup.mark_duplicates(self, backend=backend)
 
-    def recalibrate_base_qualities(self, known_snps=None, **kw) -> "AlignmentDataset":
+    def recalibrate_base_qualities(
+        self, known_snps=None, backend: Optional[str] = None, **kw
+    ) -> "AlignmentDataset":
+        """``backend`` as in :meth:`mark_duplicates` — one flag selects
+        the kernel set for every per-residue pass."""
         from adam_tpu.pipelines.bqsr import recalibrate_base_qualities
 
-        return recalibrate_base_qualities(self, known_snps=known_snps, **kw)
+        return recalibrate_base_qualities(
+            self, known_snps=known_snps, backend=backend, **kw
+        )
 
     def realign_indels(self, **kw) -> "AlignmentDataset":
         from adam_tpu.pipelines.realign import realign_indels

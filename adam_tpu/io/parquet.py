@@ -232,14 +232,9 @@ def to_arrow_alignments(
     return table.replace_schema_metadata(_header_meta(header))
 
 
-def save_alignments(
-    path: str, batch: ReadBatch, side: ReadSidecar, header: SamHeader,
-    compression: str = "zstd",
-) -> None:
+def _write_encoded(table: "pa.Table", path: str, compression: str) -> None:
     from adam_tpu.utils import instrumentation as ins
 
-    with ins.TIMERS.time(ins.PARQUET_ENCODE):
-        table = to_arrow_alignments(batch, side, header)
     with ins.TIMERS.time(ins.PARQUET_WRITE):
         # dictionary-encode only the low-cardinality name columns:
         # letting the writer attempt dictionaries on the mostly-unique
@@ -250,6 +245,89 @@ def save_alignments(
             use_dictionary=["contig", "mateContig", "recordGroupName"],
             **parquet_codec_kw(compression),
         )
+
+
+def save_alignments(
+    path: str, batch: ReadBatch, side: ReadSidecar, header: SamHeader,
+    compression: str = "zstd",
+) -> None:
+    from adam_tpu.utils import instrumentation as ins
+
+    with ins.TIMERS.time(ins.PARQUET_ENCODE):
+        table = to_arrow_alignments(batch, side, header)
+    _write_encoded(table, path, compression)
+
+
+class PartWriterPool:
+    """Double-buffered part-file writer (the streamed pipeline's pass C
+    sink).
+
+    Two stages per part: **encode** (columnar batch -> arrow table; CPU
+    work, ``n_encoders`` threads) hands off to a **single write thread**
+    (compression + disk; releases the GIL), with at most
+    ``inflight_parts`` parts alive inside the pool at once.  Encode of
+    part i+1 runs while part i's bytes compress/flush — the flat
+    ThreadPoolExecutor it replaces serialized both halves inside one
+    task, so a slow flush stalled the next encode.  The gate is taken in
+    :meth:`submit` (the producer blocks) and released after the part's
+    bytes hit disk, so peak memory is ``inflight_parts`` decoded parts —
+    a gate taken any later would let submits queue every pending part's
+    decoded batch behind the encoder threads.
+    """
+
+    def __init__(self, n_encoders: int = 2, inflight_parts: int = 3,
+                 compression: str = "zstd"):
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._enc = ThreadPoolExecutor(max(1, n_encoders))
+        self._io = ThreadPoolExecutor(1)
+        self._gate = threading.BoundedSemaphore(max(1, inflight_parts))
+        self._compression = compression
+        self._futures: list = []
+
+    def submit(self, path: str, batch: ReadBatch, side: ReadSidecar,
+               header: SamHeader) -> None:
+        from adam_tpu.utils import instrumentation as ins
+
+        def encode():
+            try:
+                with ins.TIMERS.time(ins.PARQUET_ENCODE):
+                    table = to_arrow_alignments(batch, side, header)
+                return self._io.submit(write, table)
+            except BaseException:
+                self._gate.release()
+                raise
+
+        def write(table):
+            try:
+                _write_encoded(table, path, self._compression)
+            finally:
+                self._gate.release()
+
+        self._gate.acquire()  # backpressure: bound whole parts in flight
+        try:
+            self._futures.append(self._enc.submit(encode))
+        except BaseException:
+            self._gate.release()
+            raise
+
+    def close(self) -> None:
+        """Drain both stages; re-raise the first error (encode or write)."""
+        errs = []
+        for f in self._futures:
+            try:
+                wf = f.result()
+            except BaseException as e:
+                errs.append(e)
+                continue
+            err = wf.exception()
+            if err is not None:
+                errs.append(err)
+        self._enc.shutdown()
+        self._io.shutdown()
+        if errs:
+            raise errs[0]
 
 
 def load_alignments(

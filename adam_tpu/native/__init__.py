@@ -76,6 +76,34 @@ _BASE_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC"]
 _FLAG_SETS = [_BASE_FLAGS + ["-march=native"], _BASE_FLAGS]
 
 
+@functools.lru_cache(maxsize=1)
+def _compiler_fingerprint() -> str:
+    """First line of ``g++ --version`` — in the cache tag so a toolchain
+    upgrade (ABI/codegen change) can never serve a stale binary."""
+    try:
+        res = subprocess.run(
+            ["g++", "--version"], capture_output=True, timeout=20
+        )
+        if res.returncode == 0 and res.stdout:
+            return res.stdout.decode("utf-8", "replace").splitlines()[0]
+    except Exception:
+        pass
+    return "unknown-compiler"
+
+
+def _cache_dir() -> str:
+    """Build-cache directory: ``ADAM_TPU_NATIVE_CACHE`` override, else a
+    per-user cache dir (XDG) — never inside the package tree, so opaque
+    host-specific binaries cannot end up in version control."""
+    env = os.environ.get("ADAM_TPU_NATIVE_CACHE")
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "adam_tpu", "native")
+
+
 def _build_so() -> Optional[str]:
     try:
         h = hashlib.sha256()
@@ -84,10 +112,9 @@ def _build_so() -> Optional[str]:
                 h.update(fh.read())
     except OSError:
         return None  # missing source: degrade to the Python fallbacks
+    h.update(_compiler_fingerprint().encode())
     src_hash = h.copy()
-    build_dir = os.environ.get(
-        "ADAM_TPU_NATIVE_CACHE", os.path.join(_DIR, "_build")
-    )
+    build_dir = _cache_dir()
     for flags in _FLAG_SETS:
         h = src_hash.copy()
         h.update(" ".join(flags).encode())

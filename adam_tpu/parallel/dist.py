@@ -25,12 +25,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from adam_tpu.formats.batch import ReadBatch
 from adam_tpu.ops import flagstat as fs
 from adam_tpu.ops import kmer as kmer_ops
-from adam_tpu.parallel.mesh import SHARD_AXIS, genome_mesh
+from adam_tpu.parallel.mesh import SHARD_AXIS, genome_mesh, shard_map
 
 
 def _row_specs(batch: ReadBatch):
@@ -366,7 +365,7 @@ def distributed_sort_rows(keys, payload, mesh):
 # --------------------------------------------------------------------------
 @partial(jax.jit, static_argnames=("mesh",))
 def _markdup_columns_jit(batch: ReadBatch, mesh):
-    from adam_tpu.ops import cigar as cigar_ops
+    from adam_tpu.pipelines.markdup import markdup_columns_local
 
     @partial(
         shard_map,
@@ -376,18 +375,13 @@ def _markdup_columns_jit(batch: ReadBatch, mesh):
         check_vma=False,
     )
     def run(local):
-        five = cigar_ops.five_prime_position(
+        # same traced body as the single-chip default path — the mesh
+        # variant only adds the sharding
+        return markdup_columns_local(
             local.start, local.end, local.flags,
             local.cigar_ops, local.cigar_lens, local.cigar_n,
+            local.quals, local.lengths,
         )
-        in_read = (
-            jnp.arange(local.quals.shape[1])[None, :]
-            < local.lengths[:, None]
-        )
-        score = jnp.where(
-            in_read & (local.quals >= 15), local.quals, 0
-        ).sum(axis=1, dtype=jnp.int32)
-        return five, score
 
     return run(batch)
 

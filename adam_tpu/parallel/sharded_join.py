@@ -135,11 +135,15 @@ def _spill_batches(
     try:
         for b, _side, _header in batches:
             contig_idx = np.asarray(b.contig_idx)
+            # start >= 0 guards records flagged mapped with POS=0
+            # (start == -1): without it start_bin lands them one bin
+            # before the contig's first, spilling junk bin--00001 files
             keep = np.flatnonzero(
                 np.asarray(b.valid)
                 & np.asarray(b.is_mapped)
                 & (contig_idx >= 0)
                 & (contig_idx < n_contigs)
+                & (np.asarray(b.start) >= 0)
             )
             spill.append(
                 contig_idx[keep],

@@ -25,6 +25,7 @@ no strings, no hashing, one fused kernel per pass.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -44,6 +45,59 @@ N_DINUC = 17  # 16 (prev,cur) pairs + index 16 = None ("NN")
 DINUC_NONE = 16
 MIN_ACCEPTABLE_QUALITY = 5
 MAX_QUAL = 50
+
+
+# --------------------------------------------------------------------------
+# Per-residue kernel backend selection
+# --------------------------------------------------------------------------
+BACKENDS = ("device", "native", "numpy")
+_CHIP_PRESENT: Optional[bool] = None
+
+
+def chip_present() -> bool:
+    """True when an accelerator (non-CPU jax device) is attached.
+
+    Probed once per process: ``jax.devices()`` initializes the backend,
+    which on the tunneled chip can take seconds — never in a hot loop.
+    """
+    global _CHIP_PRESENT
+    if _CHIP_PRESENT is None:
+        try:
+            _CHIP_PRESENT = any(
+                d.platform not in ("cpu",) for d in jax.devices()
+            )
+        except Exception:
+            _CHIP_PRESENT = False
+    return _CHIP_PRESENT
+
+
+def bqsr_backend(override: Optional[str] = None) -> str:
+    """Resolve the per-residue pass backend: ``device`` (jit scatter/
+    gather kernels on the attached chip), ``native`` (threaded C++ host
+    walks), or ``numpy`` (pure-host vectorized twins).
+
+    Order: explicit ``override`` arg, then ``ADAM_TPU_BQSR_BACKEND``,
+    then the topology default — **device when a chip is present** (the
+    round-5 tunnel re-measured ~1.1 GB/s, so the [N, L] traffic that
+    justified the host-first split no longer does; see docs/PERF.md),
+    native on CPU-only hosts with the toolchain, numpy otherwise.
+    """
+    b = (override or os.environ.get("ADAM_TPU_BQSR_BACKEND", "")).strip().lower()
+    if b:
+        if b not in BACKENDS:
+            src = (
+                "backend argument" if override
+                else "ADAM_TPU_BQSR_BACKEND"
+            )
+            raise ValueError(
+                f"{src}={b!r}: expected one of {BACKENDS}"
+            )
+        return b
+    if chip_present():
+        return "device"
+    from adam_tpu import native
+
+    return "native" if native.available() else "numpy"
 
 
 # --------------------------------------------------------------------------
@@ -170,6 +224,35 @@ def observe_kernel(
     )
 
 
+def observe_kernel_np(
+    bases, quals, lengths, flags, read_group_idx,
+    residue_ok, is_mismatch, read_ok,
+    n_rg: int, lmax: int,
+):
+    """Host twin of :func:`observe_kernel` (bincount over the same fused
+    i32 covariate keys) — the ``numpy`` backend and the differential
+    oracle for the device scatter-add."""
+    n_cyc = 2 * lmax + 1
+    cycles = compute_cycles_np(lengths, flags, lmax)
+    dinucs = compute_dinucs_np(bases, lengths, flags, lmax)
+    q = np.clip(np.asarray(quals).astype(np.int32), 0, N_QUAL - 1)
+    rg = np.where(
+        np.asarray(read_group_idx) >= 0, np.asarray(read_group_idx), n_rg - 1
+    ).astype(np.int32)
+    include = np.asarray(residue_ok) & np.asarray(read_ok)[:, None]
+    flat_key = (
+        ((rg[:, None] * N_QUAL + q) * n_cyc + (cycles + lmax)) * N_DINUC
+        + dinucs
+    ).astype(np.int64)
+    size = n_rg * N_QUAL * n_cyc * N_DINUC
+    shape = (n_rg, N_QUAL, n_cyc, N_DINUC)
+    total = np.bincount(flat_key[include], minlength=size).astype(np.int64)
+    mism = np.bincount(
+        flat_key[include & np.asarray(is_mismatch)], minlength=size
+    ).astype(np.int64)
+    return total.reshape(shape), mism.reshape(shape)
+
+
 class ObservationTable:
     """Dense covariate histogram + CSV emission compatible with the
     reference's ObservationTable.toCSV (GATK-style)."""
@@ -218,16 +301,26 @@ class ObservationTable:
 
 
 def _observe_device(
-    ds: AlignmentDataset, known_snps: Optional[SnpTable] = None
+    ds: AlignmentDataset, known_snps: Optional[SnpTable] = None,
+    backend: Optional[str] = None,
 ):
     """Run the observation pass -> (total, mism, rg_names, lmax).
 
-    The histograms are **host numpy arrays** when the native threaded
-    histogram ran (the single-chip default), and **device arrays** when
-    the jit scatter-add fallback ran; downstream consumers dispatch on
-    ``isinstance(total, np.ndarray)`` so each path stays on its side of
-    the device link (the sharded psum variant lives in
-    parallel/dist.distributed_observe)."""
+    Backend dispatch (:func:`bqsr_backend`):
+
+    * ``device`` — the jit scatter-add histogram (:func:`observe_kernel`)
+      on the attached chip.  The histograms come back **lazy** (device
+      arrays): per-window dispatches queue asynchronously and callers
+      fetch the compact [n_rg, 94, 2L+1, 17] tables at the merge barrier
+      (the sharded psum variant lives in parallel/dist.distributed_observe).
+    * ``native`` — the threaded C++ cigar/MD walk; histograms are host
+      numpy arrays and downstream table math stays host-side.  Falls
+      back to the device kernel when the toolchain is unavailable.
+    * ``numpy`` — :func:`observe_kernel_np`, the pure-host oracle.
+
+    Downstream consumers dispatch on ``isinstance(total, np.ndarray)`` so
+    each path stays on its side of the device link."""
+    backend = bqsr_backend(backend)
     b = ds.batch.to_numpy()
     lmax = b.lmax
     from adam_tpu import native
@@ -235,7 +328,9 @@ def _observe_device(
 
     n = b.n_rows
     md_col = StringColumn.of(ds.sidecar.md)
-    use_native = native.available() and len(md_col) >= n
+    use_native = (
+        backend == "native" and native.available() and len(md_col) >= n
+    )
     if use_native:
         # the native walk parses each read's MD inline — no host-side
         # [N, L] mismatch mask, no vectorized MD tokenize pass
@@ -265,22 +360,18 @@ def _observe_device(
 
     g = grid_rows(b.n_rows)
     gl = grid_cols(lmax)
-    # Single-device topology: the device scatter-add's payoff is the
-    # cross-chip psum (parallel/dist.distributed_observe keeps it); with
-    # one chip the threaded host histogram is exact and avoids shipping
-    # [N, L] mask arrays to a possibly-throttled device.
     snp_active = known_snps is not None and len(known_snps)
     residue_ok = None
     snp_keys = None
-    if snp_active and native.available():
+    if snp_active and use_native:
         # known-SNP masking runs inside the native kernel's cigar walk
         # (sorted site-key binary search per residue) — the [N, L] i64
         # position matrix (~3 GB at WGS batch sizes) never materializes
         snp_keys = known_snps.site_keys(ds.seq_dict.names)
 
     def _python_residue_mask():
-        # jax fallback: residue filter built host-side — q>0, ACGT base,
-        # aligned to reference, not a known SNP
+        # device/numpy backends: residue filter built host-side — q>0,
+        # ACGT base, aligned to reference, not a known SNP
         ref_pos = cigar_ops.reference_positions_np(
             b.cigar_ops, b.cigar_lens, b.cigar_n, b.start, lmax
         )
@@ -295,18 +386,15 @@ def _observe_device(
             )
         return rok
 
-    if not native.available():
-        residue_ok = _python_residue_mask()
-
-    nat = native.bqsr_observe(
-        b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
-        b.cigar_ops, b.cigar_lens, b.cigar_n,
-        residue_ok & read_ok[:, None] if residue_ok is not None else None,
-        is_mm, read_ok, n_rg, gl,
-        contig_idx=b.contig_idx, start=b.start, snp_keys=snp_keys,
-        md_buf=md_col.buf if use_native else None,
-        md_off=md_col.offsets[: n + 1] if use_native else None,
-    )
+    nat = None
+    if use_native:
+        nat = native.bqsr_observe(
+            b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
+            b.cigar_ops, b.cigar_lens, b.cigar_n,
+            None, is_mm, read_ok, n_rg, gl,
+            contig_idx=b.contig_idx, start=b.start, snp_keys=snp_keys,
+            md_buf=md_col.buf, md_off=md_col.offsets[: n + 1],
+        )
     if nat is not None:
         total, mism = nat  # host arrays: downstream table math stays host
     else:
@@ -316,23 +404,43 @@ def _observe_device(
             )
         if residue_ok is None:
             residue_ok = _python_residue_mask()
-        total, mism = observe_kernel(
-            jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
-            jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
-            jnp.asarray(pad_rows_np(b.lengths, g, 0)),
-            jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-            jnp.asarray(pad_rows_np(b.read_group_idx, g, -1)),
-            jnp.asarray(pad_rows_np(residue_ok, g, False, cols=gl)),
-            jnp.asarray(pad_rows_np(is_mm, g, False, cols=gl)),
-            jnp.asarray(pad_rows_np(read_ok, g, False)),
-            n_rg, gl,
-        )
+        if backend == "numpy":
+            total, mism = observe_kernel_np(
+                b.bases, b.quals, b.lengths, b.flags, b.read_group_idx,
+                residue_ok, is_mm, read_ok, n_rg, lmax,
+            )
+            # center the [-lmax, lmax] cycle slots inside the grid-width
+            # table so every backend returns the same [.., 2*gl+1, ..]
+            # shape (merge_observations pads by gl, not lmax)
+            if gl != lmax:
+                shape = (n_rg, N_QUAL, 2 * gl + 1, N_DINUC)
+                t2 = np.zeros(shape, np.int64)
+                m2 = np.zeros(shape, np.int64)
+                off = gl - lmax
+                t2[:, :, off : off + 2 * lmax + 1, :] = total
+                m2[:, :, off : off + 2 * lmax + 1, :] = mism
+                total, mism = t2, m2
+        else:
+            total, mism = observe_kernel(
+                jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
+                jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+                jnp.asarray(pad_rows_np(b.lengths, g, 0)),
+                jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+                jnp.asarray(pad_rows_np(b.read_group_idx, g, -1)),
+                jnp.asarray(pad_rows_np(residue_ok, g, False, cols=gl)),
+                jnp.asarray(pad_rows_np(is_mm, g, False, cols=gl)),
+                jnp.asarray(pad_rows_np(read_ok, g, False)),
+                n_rg, gl,
+            )
     rg_names = ds.read_groups.names + ["null"]
     # visit accounting (BaseQualityRecalibration.scala:99-123's logging)
+    # — host-resident histograms only: summing a device-backend result
+    # here would block on the scatter-add and fetch per window,
+    # silently defeating the lazy dispatch the device path exists for
     import logging
 
     log = logging.getLogger(__name__)
-    if log.isEnabledFor(logging.INFO):
+    if isinstance(total, np.ndarray) and log.isEnabledFor(logging.INFO):
         n_visited = int(np.asarray(total).sum())
         log.info(
             "BQSR observe: %d reads eligible of %d; %d residues visited, "
@@ -510,24 +618,59 @@ def recalibrate_kernel(
     return jnp.where(apply_mask, new_q, quals).astype(jnp.uint8)
 
 
+@partial(jax.jit, static_argnames=("lmax",))
+def apply_table_kernel(
+    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+    phred_table, lmax: int,
+):
+    """Apply a pre-solved u8 recalibration table on device -> u8[N, L].
+
+    The per-residue work is one 4-d gather keyed on (rg, reported qual,
+    cycle, dinuc) plus the Q5-floor apply mask — the device half of the
+    streamed pipeline's pass C (the table itself was solved at the merge
+    barrier).  The table's cycle axis spans [-gl, gl] with
+    gl = (n_cyc - 1) // 2 >= lmax, so smaller windows gather from the
+    middle of a wider merged table."""
+    n_rg = phred_table.shape[0]
+    gl = (phred_table.shape[2] - 1) // 2
+    rg = jnp.where(read_group_idx >= 0, read_group_idx, n_rg - 1).astype(jnp.int32)
+    q = jnp.clip(quals.astype(jnp.int32), 0, N_QUAL - 1)
+    cycles = compute_cycles(lengths, flags, lmax) + gl
+    dinucs = compute_dinucs(bases, lengths, flags, lmax)
+    new_q = phred_table[rg[:, None], q, cycles, dinucs]
+    in_read = jnp.arange(lmax)[None, :] < lengths[:, None]
+    apply_mask = (
+        in_read
+        & (quals >= MIN_ACCEPTABLE_QUALITY)
+        & (quals < schema.QUAL_PAD)
+        & has_qual[:, None]
+        & valid[:, None]
+    )
+    return jnp.where(apply_mask, new_q, quals).astype(jnp.uint8)
+
+
 def merge_observations(parts: list[tuple]) -> tuple:
     """Sum per-window (total, mism, gl) histograms into one global
     (total, mism, gl) — the host-side analog of the sharded psum.
 
     Cycle slots are centered (index = cycle + gl, table width 2*gl+1),
     so windows with smaller lmax pad into the middle of the widest
-    window's table.
+    window's table.  Device-resident parts (the lazy ``device`` observe
+    backend) are fetched here, at the barrier, via the chunked transfer
+    helper — each is a compact [n_rg, 94, 2g+1, 17] table, never [N, L].
     """
+    from adam_tpu.utils.transfer import device_fetch
+
     gl = max(p[2] for p in parts)
     n_cyc = 2 * gl + 1
-    t0 = np.asarray(parts[0][0])
-    shape = (t0.shape[0], t0.shape[1], n_cyc, t0.shape[3])
+    s0 = parts[0][0].shape  # .shape is metadata — no transfer
+    shape = (s0[0], s0[1], n_cyc, s0[3])
     total = np.zeros(shape, np.int64)
     mism = np.zeros(shape, np.int64)
     for t, m, g in parts:
         off = gl - g
-        total[:, :, off : off + 2 * g + 1, :] += np.asarray(t)
-        mism[:, :, off : off + 2 * g + 1, :] += np.asarray(m)
+        total[:, :, off : off + 2 * g + 1, :] += device_fetch(t)
+        mism[:, :, off : off + 2 * g + 1, :] += device_fetch(m)
     return total, mism, gl
 
 
@@ -552,30 +695,95 @@ def recalibrate_base_qualities(
     ds: AlignmentDataset,
     known_snps: Optional[SnpTable] = None,
     dump_observation_table: Optional[str] = None,
+    backend: Optional[str] = None,
 ) -> AlignmentDataset:
-    total, mism, rg_names, lmax = _observe_device(ds, known_snps)
+    total, mism, rg_names, lmax = _observe_device(ds, known_snps, backend)
     if dump_observation_table:
-        dump_observation_csv(total, mism, rg_names, lmax, dump_observation_table)
-    # the delta-stack table is built on device from the psum-able
-    # histograms, but the per-residue application is a pure GATHER — run
-    # it host-side from the compact u8 table (n_rg x 94 x cycles x 17,
-    # ~4 MB) instead of fetching the full [N, L] qual matrix (~100 MB on
-    # a WGS-scale batch; the device link is the pipeline bottleneck)
+        dump_observation_csv(
+            np.asarray(total), np.asarray(mism), rg_names, lmax,
+            dump_observation_table,
+        )
+    # the delta-stack table is built from the psum-able histograms, but
+    # the *solved* table is compact (n_rg x 94 x cycles x 17, ~4 MB) —
     # table math runs wherever the histograms live: host arrays (the
-    # single-chip native-observe path) stay host; device arrays (the
-    # sharded psum path) use the device kernel and fetch the tiny table
+    # native-observe path) stay host; device arrays use the device
+    # kernel and fetch only the tiny u8 table
     phred_table = solve_recalibration_table(total, mism)
-    return apply_recalibration(ds, phred_table, lmax)
+    return apply_recalibration(ds, phred_table, lmax, backend)
+
+
+def apply_recalibration_dispatch(
+    ds: AlignmentDataset, phred_table: np.ndarray, gl: int,
+    backend: Optional[str] = None,
+):
+    """Start the per-residue table application for one window -> opaque
+    handle for :func:`apply_recalibration_finish`.
+
+    On the ``device`` backend this ships the window's [N, L] bases/quals
+    and *dispatches* the gather kernel without blocking — the streamed
+    pipeline double-buffers: window i's result is fetched (and its part
+    encoded) while window i+1's gather runs on the chip.  The other
+    backends compute eagerly and the handle is just the result."""
+    backend = bqsr_backend(backend)
+    b = ds.batch.to_numpy()
+    if backend == "device":
+        from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+
+        n = b.n_rows
+        L = b.lmax
+        g = grid_rows(n)
+        glc = grid_cols(L)
+        new_dev = apply_table_kernel(
+            jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=glc)),
+            jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=glc)),
+            jnp.asarray(pad_rows_np(b.lengths, g, 0)),
+            jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+            jnp.asarray(pad_rows_np(b.read_group_idx, g, -1)),
+            jnp.asarray(pad_rows_np(b.has_qual, g, False)),
+            jnp.asarray(pad_rows_np(b.valid, g, False)),
+            jnp.asarray(np.ascontiguousarray(phred_table, np.uint8)),
+            glc,
+        )[:n, :L]  # device-side slice: fetch exactly the real rows/lanes
+        return ds, b, new_dev
+    from adam_tpu import native
+
+    new_quals = None
+    if backend == "native":
+        new_quals = native.bqsr_apply(
+            b.bases, np.asarray(b.quals), b.lengths, b.flags,
+            b.read_group_idx, b.has_qual, b.valid, phred_table, gl,
+        )
+    if new_quals is None:
+        new_quals = _apply_table_np(b, phred_table, gl)
+    return ds, b, new_quals
+
+
+def apply_recalibration_finish(handle) -> AlignmentDataset:
+    """Fetch a dispatched window (chunked transfer for device results)
+    and finish the host half: stash pre-recalibration quals as OQ."""
+    from adam_tpu.utils.transfer import device_fetch
+
+    ds, b, new_quals = handle
+    new_quals = device_fetch(new_quals)
+    return _stash_orig_quals(ds, b, new_quals)
 
 
 def apply_recalibration(
-    ds: AlignmentDataset, phred_table: np.ndarray, gl: int
+    ds: AlignmentDataset, phred_table: np.ndarray, gl: int,
+    backend: Optional[str] = None,
 ) -> AlignmentDataset:
     """Apply a solved recalibration table to one batch/window (the
     Recalibrator.scala:28-60 pass): gather new quals from the compact
     table, stash originals as OQ.  ``gl`` is the table's grid-aligned
     lane count (cycle slots span [-gl, gl])."""
-    b = ds.batch.to_numpy()
+    return apply_recalibration_finish(
+        apply_recalibration_dispatch(ds, phred_table, gl, backend)
+    )
+
+
+def _apply_table_np(b, phred_table: np.ndarray, gl: int) -> np.ndarray:
+    """Numpy twin of the table application (the ``numpy`` backend and
+    the native-unavailable fallback)."""
     n_rg = phred_table.shape[0]
     n_cyc = phred_table.shape[2]
     L = b.lmax
@@ -584,40 +792,40 @@ def apply_recalibration(
         np.asarray(b.read_group_idx) >= 0, np.asarray(b.read_group_idx),
         n_rg - 1,
     ).astype(np.int32)
-    from adam_tpu import native
-
-    new_quals = native.bqsr_apply(
-        b.bases, quals, b.lengths, b.flags, b.read_group_idx,
-        b.has_qual, b.valid, phred_table, gl,
+    # fused i32 flat index into the raveled table: one gather,
+    # minimal [N, L] temporaries
+    idx = compute_cycles_np(b.lengths, b.flags, L)
+    idx += gl
+    q32 = np.minimum(quals, N_QUAL - 1).astype(np.int32)
+    q32 += rg[:, None] * N_QUAL
+    q32 *= n_cyc
+    idx += q32
+    del q32
+    idx *= N_DINUC
+    idx += compute_dinucs_np(b.bases, b.lengths, b.flags, L)
+    new_q = phred_table.ravel()[idx]
+    del idx
+    in_read = np.arange(L)[None, :] < np.asarray(b.lengths)[:, None]
+    apply_mask = (
+        in_read
+        & (quals >= MIN_ACCEPTABLE_QUALITY)
+        & (quals < schema.QUAL_PAD)
+        & np.asarray(b.has_qual)[:, None]
+        & np.asarray(b.valid)[:, None]
     )
-    if new_quals is None:
-        # fused i32 flat index into the raveled table: one gather,
-        # minimal [N, L] temporaries (numpy fallback)
-        idx = compute_cycles_np(b.lengths, b.flags, L)
-        idx += gl
-        q32 = np.minimum(quals, N_QUAL - 1).astype(np.int32)
-        q32 += rg[:, None] * N_QUAL
-        q32 *= n_cyc
-        idx += q32
-        del q32
-        idx *= N_DINUC
-        idx += compute_dinucs_np(b.bases, b.lengths, b.flags, L)
-        new_q = phred_table.ravel()[idx]
-        del idx
-        in_read = np.arange(L)[None, :] < np.asarray(b.lengths)[:, None]
-        apply_mask = (
-            in_read
-            & (quals >= MIN_ACCEPTABLE_QUALITY)
-            & (quals < schema.QUAL_PAD)
-            & np.asarray(b.has_qual)[:, None]
-            & np.asarray(b.valid)[:, None]
-        )
-        new_quals = np.where(apply_mask, new_q, quals).astype(np.uint8)
-    # stash original quals in the sidecar (setOrigQual, Recalibrator.scala:36-40)
-    # — vectorized: encode the pre-recalibration qual matrix as a string
-    # column and merge it into rows that had no OQ yet.
+    return np.where(apply_mask, new_q, quals).astype(np.uint8)
+
+
+def _stash_orig_quals(
+    ds: AlignmentDataset, b, new_quals: np.ndarray
+) -> AlignmentDataset:
+    """Install recalibrated quals and stash the pre-recalibration matrix
+    as OQ (setOrigQual, Recalibrator.scala:36-40) — vectorized: encode
+    the old qual matrix as a string column and merge it into rows that
+    had no OQ yet."""
     from dataclasses import replace as dc_replace
 
+    from adam_tpu import native
     from adam_tpu.formats.strings import StringColumn
 
     side = ds.sidecar

@@ -34,6 +34,77 @@ from adam_tpu.formats.strings import StringColumn
 from adam_tpu.ops import cigar as cigar_ops
 
 
+def markdup_columns_local(
+    start, end, flags, ops, lens, n_ops, quals, lengths
+):
+    """[N, L] duplicate-marking reductions for one (device-resident)
+    batch slice -> (five_prime i64[N], score i32[N]).
+
+    Traceable body shared by the single-chip jit wrapper below and the
+    mesh ``shard_map`` variant (parallel/dist.distributed_markdup) — the
+    5'-clipped key via the device CIGAR walk, the bucket score via a
+    masked segment sum.  Only these compact per-row columns ever cross
+    the device link; the group-subgroup-argmax cascade stays host-side.
+    """
+    import jax.numpy as jnp
+
+    five = cigar_ops.five_prime_position(start, end, flags, ops, lens, n_ops)
+    in_read = jnp.arange(quals.shape[1])[None, :] < lengths[:, None]
+    score = jnp.where(in_read & (quals >= 15), quals, 0).sum(
+        axis=1, dtype=jnp.int32
+    )
+    return five, score
+
+
+_COLUMNS_JIT = None  # lazily-built module-level jit (one compile per shape)
+
+
+def markdup_columns_dispatch(batch):
+    """Dispatch the [N, L] markdup reductions on the default device ->
+    lazy (five, score) device arrays for the batch's real rows.
+
+    Row-padded to the pow2 grid so the compile cache sees a handful of
+    shapes; the streamed pipeline dispatches window i+1 here while
+    window i's columns are being fetched/summarized (double buffer)."""
+    global _COLUMNS_JIT
+    if _COLUMNS_JIT is None:
+        import jax
+
+        _COLUMNS_JIT = jax.jit(markdup_columns_local)
+
+    import jax.numpy as jnp
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+
+    b = batch.to_numpy()
+    n = b.n_rows
+    g = grid_rows(n)
+    # quantize BOTH axes, not just rows: windows differ in lmax and max
+    # cigar-op count, and every distinct shape is a fresh trace+compile
+    # serialized inside pass A's ingest loop (the walks mask by
+    # lengths/cigar_n, so the padding lanes are inert)
+    gl = grid_cols(b.lmax)
+    gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
+    five, score = _COLUMNS_JIT(
+        jnp.asarray(pad_rows_np(b.start, g, -1)),
+        jnp.asarray(pad_rows_np(b.end, g, -1)),
+        jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+        jnp.asarray(pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD, cols=gc)),
+        jnp.asarray(pad_rows_np(b.cigar_lens, g, 0, cols=gc)),
+        jnp.asarray(pad_rows_np(b.cigar_n, g, 0)),
+        jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+        jnp.asarray(pad_rows_np(b.lengths, g, 0)),
+    )
+    return five[:n], score[:n]
+
+
+def markdup_columns_device(batch):
+    """Blocking variant of :func:`markdup_columns_dispatch` -> host
+    (five i64[N], score i32[N])."""
+    five, score = markdup_columns_dispatch(batch)
+    return np.asarray(five), np.asarray(score)
+
+
 def _sequence_hashes(bases: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     """Deterministic per-read sequence hash (unmapped-read grouping key).
 
@@ -316,11 +387,23 @@ def apply_duplicate_flags(flags: np.ndarray, dup: np.ndarray) -> np.ndarray:
     ).astype(np.int32)
 
 
-def mark_duplicates(ds: AlignmentDataset) -> AlignmentDataset:
+def mark_duplicates(
+    ds: AlignmentDataset, backend: str | None = None
+) -> AlignmentDataset:
+    """Single-batch duplicate marking.  ``backend`` follows the shared
+    per-residue flag (:func:`adam_tpu.pipelines.bqsr.bqsr_backend`):
+    ``device`` runs the [N, L] key/score reductions on the chip (the
+    default when one is attached); the host twins otherwise."""
+    from adam_tpu.pipelines.bqsr import bqsr_backend
+
     b = ds.batch.to_numpy()
     if b.n_rows == 0:
         return ds
-    s = row_summary(ds, b)
+    if bqsr_backend(backend) == "device":
+        five, score = markdup_columns_device(ds.batch)
+        s = row_summary(ds, b, five_prime=five, score=score)
+    else:
+        s = row_summary(ds, b)
     dup = resolve_duplicates(s)
     new_flags = apply_duplicate_flags(np.asarray(b.flags), dup)
     return ds.with_batch(b.replace(flags=new_flags))

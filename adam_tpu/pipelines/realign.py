@@ -795,15 +795,26 @@ def realign_indels(
     on the time-sliced bench chip is the realign tail's dominant wall.
     Runs exactly once whichever implementation serves the call (a
     once-guard here covers the native path handing off to the fallback
-    AFTER it already ran the work)."""
+    AFTER it already ran the work).  Whether the work actually ran inside
+    the native sweep-dispatch window (i.e. genuinely hidden under the
+    device queue drain) is reported back on the callable itself as
+    ``overlap_ran_in_dispatch`` — the streamed pipeline's stage table
+    only credits the overlap when it really happened (on the Python
+    fallback and the no-target early-outs the work runs serially)."""
     if overlap_work is not None:
         _orig_overlap = overlap_work
         _overlap_state = {"done": False}
 
-        def overlap_work():
+        def overlap_work(in_dispatch: bool = False):
             if not _overlap_state["done"]:
                 _overlap_state["done"] = True
+                try:
+                    _orig_overlap.overlap_ran_in_dispatch = bool(in_dispatch)
+                except (AttributeError, TypeError):
+                    pass  # exotic callable: accounting stays pessimistic
                 _orig_overlap()
+
+        overlap_work._accepts_in_dispatch = True
 
     if consensus_model != "smithwaterman" and os.environ.get(
         "ADAM_TPU_REALIGN", ""
@@ -1342,11 +1353,14 @@ def _realign_indels_native(
         _ins.TIMERS.add(label, int((now - _t0) * 1e9))
         _t0 = now
 
-    def _overlap_once():
+    def _overlap_once(in_dispatch: bool = False):
         nonlocal overlap_work
         if overlap_work is not None:
             w, overlap_work = overlap_work, None
-            w()
+            if getattr(w, "_accepts_in_dispatch", False):
+                w(in_dispatch=in_dispatch)
+            else:
+                w()
 
     b = ds.batch.to_numpy()
     n = b.n_rows
@@ -1581,7 +1595,9 @@ def _realign_indels_native(
                 )))
 
         _phase("Realign: sweep dispatch (host assembly)")
-        _overlap_once()  # host work hides under the device queue drain
+        # host work hides under the device queue drain — genuinely
+        # overlapped only when sweeps are actually in flight
+        _overlap_once(in_dispatch=bool(pending))
         _phase("Realign: overlapped host work")
         if pending:
             # one fused fetch: per-chunk fetches each pay a tunnel

@@ -22,6 +22,7 @@ Smith-Waterman GCUPS (BASELINE metric 2), packed k-mer counting (metric
 baseline's split.
 """
 
+import hashlib
 import json
 import os
 import subprocess
@@ -37,21 +38,74 @@ sys.path.insert(0, os.path.join(_REPO, "tools"))
 N_READS = 1_000_000
 READ_LEN = 100
 _TAG = f"adam_tpu_bench_wgs_{N_READS}_{READ_LEN}_v3"
-_SYNTH = os.path.join(tempfile.gettempdir(), _TAG + ".sam")
-_KNOWN = os.path.join(tempfile.gettempdir(), _TAG + ".known.vcf")
+
+
+def _bench_cache_dir() -> str:
+    """Per-user 0o700 input-cache directory.
+
+    The old cache lived at fixed world-readable /tmp paths validated
+    only by file size — any co-tenant could pre-create (or truncate) the
+    path and the bench would silently measure their bytes.  The cache is
+    now keyed by uid, created 0o700, ownership-checked, and every input
+    is content-hash-validated against a manifest written at generation."""
+    base = os.environ.get("ADAM_TPU_BENCH_CACHE") or os.path.join(
+        tempfile.gettempdir(), f"adam_tpu_bench_u{os.getuid()}"
+    )
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    # ownership check BEFORE chmod: a co-tenant can pre-create the path
+    # under sticky /tmp, and chmod-by-non-owner would raise a bare
+    # PermissionError instead of this explanation
+    st = os.stat(base)
+    if st.st_uid != os.getuid():
+        raise RuntimeError(
+            f"bench cache {base} is owned by uid {st.st_uid}, not "
+            f"{os.getuid()} — refusing to trust its contents"
+        )
+    os.chmod(base, 0o700)
+    return base
+
+
+_CACHE = _bench_cache_dir()
+_SYNTH = os.path.join(_CACHE, _TAG + ".sam")
+_KNOWN = os.path.join(_CACHE, _TAG + ".known.vcf")
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _inputs_valid(sam: str, known: str) -> bool:
+    """True when both cached inputs match their generation-time hashes."""
+    try:
+        with open(sam + ".manifest.json") as fh:
+            m = json.load(fh)
+        return (
+            _sha256(sam) == m["sam_sha256"]
+            and _sha256(known) == m["known_sha256"]
+        )
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def _stamp_inputs(sam: str, known: str) -> None:
+    with open(sam + ".manifest.json", "w") as fh:
+        json.dump(
+            {"sam_sha256": _sha256(sam), "known_sha256": _sha256(known)}, fh
+        )
 
 
 def _ensure_synth() -> None:
-    if (
-        os.path.exists(_SYNTH)
-        and os.path.getsize(_SYNTH) > N_READS * 100
-        and os.path.exists(_KNOWN)
-    ):
+    if _inputs_valid(_SYNTH, _KNOWN):
         return
     from make_wgs_sam import make_wgs
 
     # 4 contigs x 800 kb at 1M x 100 bp ~= 31x coverage
     make_wgs(_SYNTH, N_READS, READ_LEN, known_sites_out=_KNOWN)
+    _stamp_inputs(_SYNTH, _KNOWN)
 
 
 def _known_table():
@@ -146,30 +200,68 @@ def _host_load() -> float:
         return float("nan")
 
 
+# Below this sustained matmul rate the granted slice is so starved that
+# a timed window measures the scheduler, not the framework (quiet
+# windows probe 6-22 TFLOP/s; the floor only rejects near-zero grants).
+_PROBE_FLOOR_TFLOPS = float(
+    os.environ.get("ADAM_TPU_BENCH_PROBE_FLOOR", "2.0")
+)
+
+
+def _probe_paced(max_retries: int = 3, wait_s: float = 15.0):
+    """Matmul-probe the chip, waiting out starved slices.
+
+    Returns (probe_tflops, skipped) where ``skipped`` lists the
+    below-floor probes that were waited out — recorded in the artifact
+    so a paced window is distinguishable from a lucky one.  After
+    ``max_retries`` waits the window runs anyway (the bench must
+    terminate on a permanently-starved slice) with its low probe
+    recorded next to it."""
+    skipped = []
+    probe_tf = _matmul_probe()
+    while (
+        probe_tf == probe_tf  # not NaN: probe failure pacing is pointless
+        and probe_tf < _PROBE_FLOOR_TFLOPS
+        and len(skipped) < max_retries
+    ):
+        skipped.append(probe_tf)
+        time.sleep(wait_s)
+        probe_tf = _matmul_probe()
+    return probe_tf, skipped
+
+
 def _run_streamed(known, trials: int = 1, probe: bool = True) -> dict:
     """Best-of-``trials`` timed runs (the shared bench chip is
     time-sliced; identical runs vary several-x, so one sample measures
     the scheduler, not the framework).  Every trial records the
     same-window matmul-probe fraction and host 1-min load so the spread
-    is attributable; the returned dict carries best-trial stages plus
-    the full per-window context under ``windows``/``spread``."""
+    is attributable — and is paced by :func:`_probe_paced`, so a window
+    doesn't start on a slice too starved to measure anything.  The
+    returned dict carries best-trial stages plus the full per-window
+    context under ``windows``/``spread``."""
     from adam_tpu.pipelines.streamed import transform_streamed
 
     best = None
     windows = []
     for _ in range(max(1, trials)):
-        probe_tf = _matmul_probe() if probe else float("nan")
+        if probe:
+            probe_tf, skipped = _probe_paced()
+        else:
+            probe_tf, skipped = float("nan"), []
         load0 = _host_load()
         with tempfile.TemporaryDirectory() as td:
             stats = transform_streamed(
                 _SYNTH, os.path.join(td, "out.adam"), known_snps=known
             )
-        windows.append({
+        w = {
             "total_s": round(stats["total_s"], 2),
             "probe_tflops_before": probe_tf,
             "host_load_before": load0,
             "host_load_after": _host_load(),
-        })
+        }
+        if skipped:
+            w["probe_skipped"] = skipped
+        windows.append(w)
         if best is None or stats["total_s"] < best["total_s"]:
             best = stats
     totals = sorted(w["total_s"] for w in windows)
@@ -217,11 +309,14 @@ def _cpu_child() -> None:
         pass
     known = _known_table()
     _warmup_compiles(known)
-    # one trial: the forced-CPU child is deterministic (no time-sliced
-    # chip variance) and a second 1M run risks the caller's timeout
+    # two trials: the forced-CPU child has no chip variance but DOES
+    # share the single time-sliced host core — one sample measured
+    # 8.2-25.4 s across round-5 windows, which alone swings vs_baseline
+    # 0.89-2.85.  Two windows plus the recorded loadavg let the parent
+    # pair quiet-against-quiet.
     # no matmul probe in the CPU child: a 4096^3 bf16 loop takes ~45s
     # on the single host core and would dwarf the measurement
-    stats = _run_streamed(known, trials=1, probe=False)
+    stats = _run_streamed(known, trials=2, probe=False)
     print(json.dumps(stats))
 
 
@@ -322,22 +417,19 @@ def _scale_4m(budget_spent_s: float) -> Optional[dict]:
     if os.environ.get("ADAM_TPU_BENCH_SKIP_4M"):
         return None
     tag = f"adam_tpu_bench_wgs_4000000_{READ_LEN}_v3"
-    path = os.path.join(tempfile.gettempdir(), tag + ".sam")
-    known = os.path.join(tempfile.gettempdir(), tag + ".known.vcf")
-    cached = (
-        os.path.exists(path)
-        and os.path.getsize(path) > 4_000_000 * 100
-        and os.path.exists(known)
-    )
+    path = os.path.join(_CACHE, tag + ".sam")
+    known = os.path.join(_CACHE, tag + ".known.vcf")
+    cached = _inputs_valid(path, known)
     # budget: the driver gives the whole bench one wall budget; the 4M
     # leg (~1-3 min warm) only runs when the main legs left room, and
-    # input generation (~10 min, one-time per machine) only with plenty
+    # input generation (~10 min, one-time per user) only with plenty
     if budget_spent_s > (900 if cached else 420):
         return None
     if not cached:
         from make_wgs_sam import make_wgs
 
         make_wgs(path, 4_000_000, READ_LEN, known_sites_out=known)
+        _stamp_inputs(path, known)
     child = r"""
 import json, os, resource, sys, tempfile, time
 sys.path.insert(0, %(repo)r)
@@ -373,6 +465,62 @@ print(json.dumps({"reads_4m_s": round(wall, 1),
     return None
 
 
+def _vs_baseline_windows(stages: dict, cpu_stats: dict) -> dict:
+    """Chip-vs-CPU ratios from the recorded windows.
+
+    Both legs run on the same time-shared host core minutes apart, so a
+    single best-vs-best ratio swings 0.89-2.85 between runs.  Three
+    estimates, most-robust first: ``median`` (median chip window over
+    median CPU window — the headline), ``quiet`` (the chip window with
+    the best granted slice against the least-loaded CPU window — the
+    upper bound honest pairing allows), and ``best`` (the old
+    best-vs-best, kept for continuity with r04/r05 artifacts)."""
+    chip_w = stages.get("windows") or []
+    cpu_w = cpu_stats.get("windows") or []
+    if not chip_w or not cpu_w:
+        return {}
+
+    def _median(ts):
+        # true median: even-length lists average the middle pair (with 2
+        # CPU windows, index len//2 alone would pick the WORSE one and
+        # flatter the chip)
+        ts = sorted(ts)
+        mid = len(ts) // 2
+        return ts[mid] if len(ts) % 2 else (ts[mid - 1] + ts[mid]) / 2
+
+    chip_t = sorted(w["total_s"] for w in chip_w)
+    cpu_t = sorted(w["total_s"] for w in cpu_w)
+    out = {
+        "median": round(_median(cpu_t) / _median(chip_t), 2),
+        "best": round(cpu_t[0] / chip_t[0], 2),
+    }
+    def _probe_of(w):
+        p = w.get("probe_tflops_before")
+        # NaN (failed probe) must sort as "no grant evidence", not
+        # poison the tuple comparison into picking an arbitrary window
+        return p if (p is not None and p == p) else 0.0
+
+    quiet_chip = min(
+        chip_w, key=lambda w: (-_probe_of(w), w["total_s"])
+    )
+    def _load_of(w):
+        ld = w.get("host_load_before")
+        # non-finite load (unreadable loadavg) must never win "quietest"
+        return ld if (ld is not None and ld == ld) else float("inf")
+
+    quiet_cpu = min(
+        cpu_w, key=lambda w: (_load_of(w), w["total_s"])
+    )
+    out["quiet"] = round(quiet_cpu["total_s"] / quiet_chip["total_s"], 2)
+    out["quiet_pairing"] = {
+        "chip_probe_tflops": quiet_chip.get("probe_tflops_before"),
+        "chip_total_s": quiet_chip["total_s"],
+        "cpu_load": quiet_cpu.get("host_load_before"),
+        "cpu_total_s": quiet_cpu["total_s"],
+    }
+    return out
+
+
 def main() -> None:
     t_bench0 = time.perf_counter()
     _ensure_synth()
@@ -386,9 +534,14 @@ def main() -> None:
     try:
         cpu_stats = _cpu_baseline()
         cpu_rps = cpu_stats["n_reads"] / cpu_stats["total_s"]
-        vs = rps / cpu_rps if cpu_rps > 0 else None
+        pairing = _vs_baseline_windows(stages, cpu_stats)
+        # headline ratio: median window against median window (the old
+        # best-vs-best headline is pairing["best"])
+        vs = pairing.get("median") or (
+            rps / cpu_rps if cpu_rps > 0 else None
+        )
     except Exception:
-        cpu_stats, cpu_rps, vs = {}, float("nan"), None
+        cpu_stats, cpu_rps, vs, pairing = {}, float("nan"), None, {}
 
     try:
         sw_info = _sw_gcups()
@@ -408,9 +561,10 @@ def main() -> None:
                 "unit": (
                     "reads/sec (1M-read WGS-shaped SAM at ~31x: streamed "
                     "ingest+markdup+BQSR(known-sites)+realign+parquet "
-                    "parts, one chip; value = best of 3 windows, median "
-                    "= median window — the chip slice is time-shared; "
-                    "CPU baseline = same input/code on host cores)"
+                    "parts, one chip; value = best of 3 probe-paced "
+                    "windows, median = median window — the chip slice is "
+                    "time-shared; CPU baseline = same input/code on host "
+                    "cores, 2 windows, vs_baseline = median-vs-median)"
                 ),
                 "vs_baseline": round(vs, 2) if vs is not None else None,
             })
@@ -430,8 +584,11 @@ def main() -> None:
 
     configs = {
         "cfg2_markdup_derived_rps": _cfg("resolve_s"),
+        # the device backend records dispatch/fetch as disjoint rows
+        # next to the host apply share — all of them are BQSR wall
         "cfg3_bqsr_known_sites_derived_rps": _cfg(
-            "observe_s", "solve_s", "apply_split_s"
+            "observe_s", "obs_merge_fetch_s", "solve_s", "apply_split_s",
+            "apply_device_dispatch_s", "apply_device_fetch_s",
         ),
         "cfg4_realign_derived_rps": _cfg("realign_s"),
     }
@@ -443,6 +600,7 @@ def main() -> None:
                 "sw": sw_info,
                 "kmers_per_sec": round(kps, 1),
                 "cpu_baseline_reads_per_sec": round(cpu_rps, 1),
+                "vs_baseline_windows": pairing or None,
                 **configs,
                 **(scale4m or {}),
                 "chip_windows": stages.get("windows"),

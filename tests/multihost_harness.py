@@ -81,7 +81,7 @@ def main() -> None:
     # ---- psum-combined flagstat-style reduction across processes ----
     import jax.numpy as jnp
     from functools import partial
-    from jax import shard_map
+    from adam_tpu.parallel.mesh import shard_map
 
     @jax.jit
     @partial(
@@ -117,7 +117,7 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
 
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from adam_tpu.parallel.mesh import shard_map
     from jax.experimental import multihost_utils
     from jax.sharding import NamedSharding, PartitionSpec as P
 
