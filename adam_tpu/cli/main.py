@@ -10,9 +10,11 @@ registry, run, print the timing report on ``-print_metrics``.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 
 from adam_tpu.utils import instrumentation as ins
+from adam_tpu.utils import telemetry as tele
 
 
 class Command:
@@ -36,7 +38,25 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
     (Args4j.scala:23-28, ParquetArgs.scala:24-35)."""
     parser.add_argument(
         "-print_metrics", action="store_true",
-        help="print metrics to the log on completion",
+        help="print metrics to the log on completion (timer table plus "
+        "the telemetry counters/gauges recorded under it)",
+    )
+    parser.add_argument(
+        "--metrics-json", dest="metrics_json", default=None, metavar="PATH",
+        help="write the telemetry snapshot (spans, counters, gauges, and "
+        "the timer table as machine-readable JSON) to PATH on completion",
+    )
+    parser.add_argument(
+        "--trace-out", dest="trace_out", default=None, metavar="PATH",
+        help="write the flight recorder as a Chrome-trace JSON file "
+        "loadable in chrome://tracing or Perfetto (per-thread tracks "
+        "show the streamed tokenize/dispatch/encode/write overlap)",
+    )
+    parser.add_argument(
+        "--xprof-dir", dest="xprof_dir", default=None, metavar="DIR",
+        help="wrap the command in a jax profiler trace written to DIR "
+        "(xprof/TensorBoard view of the device work; reentrant-safe "
+        "no-op if a trace is already active)",
     )
     parser.add_argument(
         "-log_level", default="warning",
@@ -116,9 +136,20 @@ def main(argv=None) -> int:
         level=getattr(logging, args.log_level.upper()),
         format="%(asctime)s %(name)s %(levelname)s: %(message)s",
     )
-    ins.TIMERS.recording = bool(args.print_metrics)
+    # any observability sink switches recording on: the timer table, the
+    # JSON snapshot and the Chrome trace all read the same run
+    want_metrics = bool(
+        args.print_metrics or args.metrics_json or args.trace_out
+    )
+    ins.TIMERS.recording = want_metrics
+    tele.TRACE.recording = want_metrics
+    xprof = (
+        ins.device_trace(args.xprof_dir) if args.xprof_dir
+        else contextlib.nullcontext()
+    )
     try:
-        rc = cmd.run(args)
+        with xprof:
+            rc = cmd.run(args)
     except BrokenPipeError:  # e.g. `adam-tpu print ... | head`
         try:
             sys.stdout.close()
@@ -129,8 +160,19 @@ def main(argv=None) -> int:
         if args.print_metrics:
             try:
                 print(ins.TIMERS.report())
+                print(tele.TRACE.report())
             except BrokenPipeError:
                 pass
+        for path, dump in (
+            (args.metrics_json, tele.TRACE.dump_json),
+            (args.trace_out, tele.TRACE.dump_chrome_trace),
+        ):
+            if path:
+                try:
+                    dump(path)
+                except OSError as e:
+                    print(f"telemetry export to {path} failed: {e}",
+                          file=sys.stderr)
     return int(rc or 0)
 
 

@@ -233,9 +233,14 @@ def to_arrow_alignments(
 
 
 def _write_encoded(table: "pa.Table", path: str, compression: str) -> None:
-    from adam_tpu.utils import instrumentation as ins
+    import os
 
-    with ins.TIMERS.time(ins.PARQUET_WRITE):
+    from adam_tpu.utils import instrumentation as ins
+    from adam_tpu.utils import telemetry as tele
+
+    with ins.TIMERS.time(ins.PARQUET_WRITE), tele.TRACE.span(
+        tele.SPAN_PART_WRITE, path=os.path.basename(path)
+    ):
         # dictionary-encode only the low-cardinality name columns:
         # letting the writer attempt dictionaries on the mostly-unique
         # readName/sequence/qual columns builds dicts it then abandons
@@ -245,6 +250,12 @@ def _write_encoded(table: "pa.Table", path: str, compression: str) -> None:
             use_dictionary=["contig", "mateContig", "recordGroupName"],
             **parquet_codec_kw(compression),
         )
+    if tele.TRACE.recording:
+        tele.TRACE.count(tele.C_PARTS_WRITTEN)
+        try:
+            tele.TRACE.count(tele.C_BYTES_WRITTEN, os.path.getsize(path))
+        except OSError:
+            pass
 
 
 def save_alignments(
@@ -252,9 +263,14 @@ def save_alignments(
     compression: str = "zstd",
 ) -> None:
     from adam_tpu.utils import instrumentation as ins
+    from adam_tpu.utils import telemetry as tele
 
-    with ins.TIMERS.time(ins.PARQUET_ENCODE):
+    with ins.TIMERS.time(ins.PARQUET_ENCODE), tele.TRACE.span(
+        tele.SPAN_PART_ENCODE, rows=int(batch.n_rows)
+    ):
         table = to_arrow_alignments(batch, side, header)
+    if tele.TRACE.recording:
+        tele.TRACE.count(tele.C_BYTES_ENCODED, int(table.nbytes))
     _write_encoded(table, path, compression)
 
 
@@ -285,31 +301,60 @@ class PartWriterPool:
         self._gate = threading.BoundedSemaphore(max(1, inflight_parts))
         self._compression = compression
         self._futures: list = []
+        # submit-gate depth (parts alive inside the pool), sampled into
+        # the telemetry gauge at submit and at drain; the int itself is
+        # maintained unconditionally (one locked increment per PART) so
+        # toggling recording mid-run cannot skew the samples
+        self._depth = 0
+        self._depth_lock = threading.Lock()
+
+    def _sample_depth(self, delta: int) -> None:
+        from adam_tpu.utils import telemetry as tele
+
+        with self._depth_lock:
+            self._depth += delta
+            d = self._depth
+        tele.TRACE.gauge(tele.G_POOL_DEPTH, d)
 
     def submit(self, path: str, batch: ReadBatch, side: ReadSidecar,
                header: SamHeader) -> None:
         from adam_tpu.utils import instrumentation as ins
+        from adam_tpu.utils import telemetry as tele
+
+        def release():
+            # decrement BEFORE releasing the gate: a submitter unblocked
+            # by the release must never observe a depth above the
+            # inflight_parts bound the gauge exists to monitor
+            self._sample_depth(-1)
+            self._gate.release()
 
         def encode():
             try:
-                with ins.TIMERS.time(ins.PARQUET_ENCODE):
+                with ins.TIMERS.time(ins.PARQUET_ENCODE), tele.TRACE.span(
+                    tele.SPAN_PART_ENCODE, rows=int(batch.n_rows)
+                ):
                     table = to_arrow_alignments(batch, side, header)
+                if tele.TRACE.recording:
+                    tele.TRACE.count(
+                        tele.C_BYTES_ENCODED, int(table.nbytes)
+                    )
                 return self._io.submit(write, table)
             except BaseException:
-                self._gate.release()
+                release()
                 raise
 
         def write(table):
             try:
                 _write_encoded(table, path, self._compression)
             finally:
-                self._gate.release()
+                release()
 
         self._gate.acquire()  # backpressure: bound whole parts in flight
+        self._sample_depth(+1)
         try:
             self._futures.append(self._enc.submit(encode))
         except BaseException:
-            self._gate.release()
+            release()
             raise
 
     def close(self) -> None:

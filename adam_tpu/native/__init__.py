@@ -24,6 +24,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from adam_tpu.utils import instrumentation as _instr
+from adam_tpu.utils import telemetry as _tele
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "adamtok.cpp")
@@ -35,13 +36,16 @@ _LOAD_FAILED = False
 
 def _timed(timer_name: str):
     """Record a native dispatch under the instrumentation registry (the
-    InstrumentedOutputFormat analog, rdd/ADAMRDDFunctions.scala:161-164):
-    no-op unless ``-print_metrics`` switched recording on."""
+    InstrumentedOutputFormat analog, rdd/ADAMRDDFunctions.scala:161-164)
+    AND as a telemetry span of the same name on the calling thread's
+    flight-recorder track (the timer table aggregates; the span shows
+    where the dispatch sat in the streamed overlap): no-op unless
+    recording was switched on."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with _instr.TIMERS.time(timer_name):
+            with _instr.TIMERS.time(timer_name), _tele.TRACE.span(timer_name):
                 return fn(*args, **kwargs)
 
         return wrapper

@@ -414,6 +414,54 @@ def distributed_markdup(ds, mesh=None):
 
 
 # --------------------------------------------------------------------------
+# multihost telemetry aggregation
+# --------------------------------------------------------------------------
+def gather_host_telemetry(snapshot: dict | None = None) -> list[dict]:
+    """Gather every host's telemetry snapshot at a merge barrier ->
+    ``[snapshot_for_process_0, ..., snapshot_for_process_{n-1}]``.
+
+    The observability face of the driver-aggregate pattern: where the
+    reference's Spark listener collects per-executor task timings, the
+    multihost pipeline calls this at its merge barrier (see
+    tests/multihost_harness.py) so the report can show per-host skew
+    (``adam_tpu.utils.telemetry.merge_snapshots``).  Snapshots ship as
+    length-prefixed JSON bytes over a ``process_allgather`` — control
+    plane only, never per-read data.  Must be called by ALL processes
+    (it is a collective); single-process runs return ``[snapshot]``
+    without touching the collective machinery.
+    """
+    import json
+
+    from adam_tpu.utils import telemetry
+
+    if snapshot is None:
+        snapshot = telemetry.TRACE.snapshot()
+    try:
+        n_procs = jax.process_count()
+    except Exception:
+        n_procs = 1
+    if n_procs == 1:
+        return [snapshot]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(
+        json.dumps(snapshot, default=str).encode(), np.uint8
+    )
+    sizes = np.asarray(
+        multihost_utils.process_allgather(np.int64(payload.size))
+    ).reshape(-1)
+    cap = int(sizes.max())
+    buf = np.zeros(max(1, cap), np.uint8)
+    buf[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    gathered = gathered.reshape(n_procs, -1)
+    return [
+        json.loads(gathered[p, : int(sizes[p])].tobytes().decode())
+        for p in range(n_procs)
+    ]
+
+
+# --------------------------------------------------------------------------
 # halo (flank) exchange between genome-adjacent shards
 # --------------------------------------------------------------------------
 @partial(jax.jit, static_argnames=("flank", "mesh"))

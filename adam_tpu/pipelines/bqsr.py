@@ -39,6 +39,7 @@ from adam_tpu.models.snp_table import SnpTable
 from adam_tpu.ops import cigar as cigar_ops
 from adam_tpu.ops.mdtag import batch_md_arrays
 from adam_tpu.ops.phred import PHRED_TO_ERROR
+from adam_tpu.utils import telemetry as _tele
 
 N_QUAL = 94  # valid phred range 0..93 (QualityScore.scala)
 N_DINUC = 17  # 16 (prev,cur) pairs + index 16 = None ("NN")
@@ -321,6 +322,17 @@ def _observe_device(
     Downstream consumers dispatch on ``isinstance(total, np.ndarray)`` so
     each path stays on its side of the device link."""
     backend = bqsr_backend(backend)
+    # span carries the resolved backend so device-vs-host attribution is
+    # visible per window in the flight recorder
+    with _tele.TRACE.span(
+        _tele.SPAN_BQSR_OBSERVE, backend=backend, reads=int(ds.batch.n_rows)
+    ):
+        return _observe_impl(ds, known_snps, backend)
+
+
+def _observe_impl(
+    ds: AlignmentDataset, known_snps: Optional[SnpTable], backend: str
+):
     b = ds.batch.to_numpy()
     lmax = b.lmax
     from adam_tpu import native
@@ -725,6 +737,13 @@ def apply_recalibration_dispatch(
     encoded) while window i+1's gather runs on the chip.  The other
     backends compute eagerly and the handle is just the result."""
     backend = bqsr_backend(backend)
+    with _tele.TRACE.span(_tele.SPAN_BQSR_APPLY_DISPATCH, backend=backend):
+        return _apply_dispatch_impl(ds, phred_table, gl, backend)
+
+
+def _apply_dispatch_impl(
+    ds: AlignmentDataset, phred_table: np.ndarray, gl: int, backend: str
+):
     b = ds.batch.to_numpy()
     if backend == "device":
         from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
@@ -764,7 +783,8 @@ def apply_recalibration_finish(handle) -> AlignmentDataset:
     from adam_tpu.utils.transfer import device_fetch
 
     ds, b, new_quals = handle
-    new_quals = device_fetch(new_quals)
+    with _tele.TRACE.span(_tele.SPAN_BQSR_APPLY_FETCH):
+        new_quals = device_fetch(new_quals)
     return _stash_orig_quals(ds, b, new_quals)
 
 
@@ -776,9 +796,12 @@ def apply_recalibration(
     Recalibrator.scala:28-60 pass): gather new quals from the compact
     table, stash originals as OQ.  ``gl`` is the table's grid-aligned
     lane count (cycle slots span [-gl, gl])."""
-    return apply_recalibration_finish(
-        apply_recalibration_dispatch(ds, phred_table, gl, backend)
-    )
+    with _tele.TRACE.span(
+        _tele.SPAN_BQSR_APPLY_HOST, backend=bqsr_backend(backend)
+    ):
+        return apply_recalibration_finish(
+            apply_recalibration_dispatch(ds, phred_table, gl, backend)
+        )
 
 
 def _apply_table_np(b, phred_table: np.ndarray, gl: int) -> np.ndarray:

@@ -75,27 +75,34 @@ def markdup_columns_dispatch(batch):
     import jax.numpy as jnp
 
     from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+    from adam_tpu.utils import telemetry as _tele
 
-    b = batch.to_numpy()
-    n = b.n_rows
-    g = grid_rows(n)
-    # quantize BOTH axes, not just rows: windows differ in lmax and max
-    # cigar-op count, and every distinct shape is a fresh trace+compile
-    # serialized inside pass A's ingest loop (the walks mask by
-    # lengths/cigar_n, so the padding lanes are inert)
-    gl = grid_cols(b.lmax)
-    gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
-    five, score = _COLUMNS_JIT(
-        jnp.asarray(pad_rows_np(b.start, g, -1)),
-        jnp.asarray(pad_rows_np(b.end, g, -1)),
-        jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-        jnp.asarray(pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD, cols=gc)),
-        jnp.asarray(pad_rows_np(b.cigar_lens, g, 0, cols=gc)),
-        jnp.asarray(pad_rows_np(b.cigar_n, g, 0)),
-        jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
-        jnp.asarray(pad_rows_np(b.lengths, g, 0)),
-    )
-    return five[:n], score[:n]
+    with _tele.TRACE.span(
+        _tele.SPAN_MD_COLUMNS, backend="device",
+        reads=int(batch.n_rows),
+    ):
+        b = batch.to_numpy()
+        n = b.n_rows
+        g = grid_rows(n)
+        # quantize BOTH axes, not just rows: windows differ in lmax and
+        # max cigar-op count, and every distinct shape is a fresh
+        # trace+compile serialized inside pass A's ingest loop (the
+        # walks mask by lengths/cigar_n, so the padding lanes are inert)
+        gl = grid_cols(b.lmax)
+        gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
+        five, score = _COLUMNS_JIT(
+            jnp.asarray(pad_rows_np(b.start, g, -1)),
+            jnp.asarray(pad_rows_np(b.end, g, -1)),
+            jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+            jnp.asarray(
+                pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD, cols=gc)
+            ),
+            jnp.asarray(pad_rows_np(b.cigar_lens, g, 0, cols=gc)),
+            jnp.asarray(pad_rows_np(b.cigar_n, g, 0)),
+            jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+            jnp.asarray(pad_rows_np(b.lengths, g, 0)),
+        )
+        return five[:n], score[:n]
 
 
 def markdup_columns_device(batch):

@@ -58,17 +58,19 @@ from typing import Optional
 import numpy as np
 
 from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.utils import telemetry as tele
 
 _SENTINEL = object()
 
 
 def _ingest_windows(path: str, window_reads: int, out_q: queue.Queue,
-                    abort: threading.Event):
+                    abort: threading.Event, tr: tele.Tracer):
     """Ingest thread body: tokenize windows, push (batch, side, header).
 
     ``abort`` unblocks the bounded put when the consumer dies mid-stream
     — otherwise the thread (and the decoded input it holds) would be
-    pinned for the life of the process.
+    pinned for the life of the process.  ``tr`` records one
+    ``streamed.tokenize`` span per window on this thread's track.
     """
 
     def put(item) -> bool:
@@ -89,9 +91,15 @@ def _ingest_windows(path: str, window_reads: int, out_q: queue.Queue,
             it = sam_io.iter_bam_batches(p, batch_reads=window_reads)
         else:
             it = sam_io.iter_sam_batches(p, batch_reads=window_reads)
-        for batch, side, header in it:
-            if not put((batch, side, header)):
+        i = 0
+        while True:
+            with tr.span(tele.SPAN_TOKENIZE, window=i):
+                item = next(it, _SENTINEL)
+            if item is _SENTINEL:
+                break
+            if not put(item):
                 return
+            i += 1
         put(_SENTINEL)
     except BaseException as e:  # surface in the consumer
         put(e)
@@ -142,7 +150,13 @@ def transform_streamed(
     from adam_tpu.pipelines import markdup as md_mod
     from adam_tpu.pipelines import realign as realign_mod
 
-    t_start = time.perf_counter()
+    # Per-run tracer, ALWAYS recording: the returned stats dict is a
+    # derived view of its span data (telemetry.streamed_stats_view), so
+    # the two can never disagree.  The handful of stage/window spans it
+    # records per run is negligible next to the work; it folds into the
+    # global TRACE at the end when telemetry is enabled.
+    tr = tele.Tracer(recording=True)
+    t_start_ns = time.monotonic_ns()
     stats: dict = {}
     # one backend decision for every per-residue pass in this run: the
     # device kernels (BQSR observe/apply scatter-gathers, markdup [N, L]
@@ -165,7 +179,7 @@ def transform_streamed(
     in_q: queue.Queue = queue.Queue(maxsize=3)
     abort = threading.Event()
     ingest = threading.Thread(
-        target=_ingest_windows, args=(path, window_reads, in_q, abort),
+        target=_ingest_windows, args=(path, window_reads, in_q, abort, tr),
         daemon=True,
     )
     ingest.start()
@@ -174,105 +188,109 @@ def transform_streamed(
     summaries: list[dict] = []
     events = []
     header = None
-    t = time.perf_counter()
-    md_fetch_s = 0.0
+    n_reads = 0
     pend_cols = None  # device double buffer: (window ds, lazy (five, score))
 
     def _summarize(ds, cols):
-        nonlocal md_fetch_s
         if cols is None:
             summaries.append(md_mod.row_summary(ds))
             return
-        t0 = time.perf_counter()
-        five = np.asarray(cols[0])
-        score = np.asarray(cols[1])
-        md_fetch_s += time.perf_counter() - t0
+        with tr.span(tele.SPAN_MD_FETCH):
+            five = np.asarray(cols[0])
+            score = np.asarray(cols[1])
+        tr.count(tele.C_DEVICE_FETCHED)
         summaries.append(md_mod.row_summary(ds, five_prime=five, score=score))
 
-    try:
-        while True:
-            item = in_q.get()
-            if item is _SENTINEL:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            batch, side, header = item
-            ds = AlignmentDataset(batch, side, header)
-            windows.append(ds)
-            if mark_duplicates:
-                if use_device:
-                    # dispatch window i's [N, L] key/score reductions,
-                    # then summarize window i-1 (its columns had the
-                    # whole previous iteration to compute on the chip)
-                    cols = md_mod.markdup_columns_dispatch(batch)
-                    if pend_cols is not None:
-                        _summarize(*pend_cols)
-                    pend_cols = (ds, cols)
-                else:
-                    _summarize(ds, None)
-            if realign:
-                events.append(
-                    realign_mod.extract_indel_event_arrays(
-                        batch.to_numpy(), max_indel_size=mis
+    with tr.span(tele.SPAN_PASS_A):
+        try:
+            while True:
+                item = in_q.get()
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                batch, side, header = item
+                ds = AlignmentDataset(batch, side, header)
+                windows.append(ds)
+                n_reads += int(batch.valid.sum())
+                tr.count(tele.C_WINDOWS_INGESTED)
+                if mark_duplicates:
+                    if use_device:
+                        # dispatch window i's [N, L] key/score reductions,
+                        # then summarize window i-1 (its columns had the
+                        # whole previous iteration to compute on the chip)
+                        cols = md_mod.markdup_columns_dispatch(batch)
+                        tr.count(tele.C_DEVICE_DISPATCHED)
+                        tr.gauge(
+                            tele.G_DEVICE_INFLIGHT,
+                            2 if pend_cols is not None else 1,
+                        )
+                        if pend_cols is not None:
+                            _summarize(*pend_cols)
+                        pend_cols = (ds, cols)
+                    else:
+                        _summarize(ds, None)
+                if realign:
+                    events.append(
+                        realign_mod.extract_indel_event_arrays(
+                            batch.to_numpy(), max_indel_size=mis
+                        )
                     )
-                )
-        if pend_cols is not None:
-            _summarize(*pend_cols)
-            pend_cols = None
-    except BaseException:
-        abort.set()
-        raise
-    ingest.join()
-    stats["ingest_pass_s"] = time.perf_counter() - t
-    if use_device and mark_duplicates:
-        stats["md_cols_fetch_s"] = md_fetch_s
-    n_reads = int(sum(int(w.batch.valid.sum()) for w in windows))
+            if pend_cols is not None:
+                _summarize(*pend_cols)
+                pend_cols = None
+        except BaseException:
+            abort.set()
+            raise
+        ingest.join()
+    tr.count(tele.C_READS_INGESTED, n_reads)
     stats["n_reads"] = n_reads
     if header is None or not windows:
-        stats["total_s"] = time.perf_counter() - t_start
+        tr.add_span(tele.SPAN_TOTAL, t_start_ns,
+                    time.monotonic_ns() - t_start_ns)
+        stats.update(tele.streamed_stats_view(tr.snapshot()))
+        _finish_trace(tr, stats)
         return stats
 
     # ---- barrier 1: resolve duplicates + merge targets ----------------
-    t = time.perf_counter()
-    if mark_duplicates and summaries:
-        dup = md_mod.resolve_duplicates(md_mod.concat_summaries(summaries))
-        off = 0
-        for i, w in enumerate(windows):
-            n = w.batch.n_rows
-            b = w.batch.to_numpy()
-            new_flags = md_mod.apply_duplicate_flags(
-                np.asarray(b.flags), dup[off : off + n]
+    with tr.span(tele.SPAN_RESOLVE):
+        if mark_duplicates and summaries:
+            dup = md_mod.resolve_duplicates(md_mod.concat_summaries(summaries))
+            off = 0
+            for i, w in enumerate(windows):
+                n = w.batch.n_rows
+                b = w.batch.to_numpy()
+                new_flags = md_mod.apply_duplicate_flags(
+                    np.asarray(b.flags), dup[off : off + n]
+                )
+                windows[i] = w.with_batch(b.replace(flags=new_flags))
+                off += n
+            del summaries
+        targets = (
+            realign_mod.merge_events(
+                np.concatenate(events, axis=0) if events
+                else np.zeros((0, 5), np.int64),
+                header.seq_dict.names, mts,
             )
-            windows[i] = w.with_batch(b.replace(flags=new_flags))
-            off += n
-        del summaries
-    targets = (
-        realign_mod.merge_events(
-            np.concatenate(events, axis=0) if events
-            else np.zeros((0, 5), np.int64),
-            header.seq_dict.names, mts,
+            if realign
+            else []
         )
-        if realign
-        else []
-    )
-    stats["resolve_s"] = time.perf_counter() - t
 
     # ---- pass B: candidate split (pre-BQSR, reference order) ----------
-    t = time.perf_counter()
-    candidates: list[AlignmentDataset] = []
-    window_valid: list[int] = []
-    obs_parts = []
-    for i, w in enumerate(windows):
-        n_valid = w.batch.n_rows
-        if targets:
-            cand, w, n_valid = realign_mod.split_realign_candidates(
-                w, targets, header.seq_dict.names
-            )
-            if cand is not None:
-                candidates.append(cand)
-            windows[i] = w
-        window_valid.append(n_valid)
-    stats["split_s"] = time.perf_counter() - t
+    with tr.span(tele.SPAN_SPLIT):
+        candidates: list[AlignmentDataset] = []
+        window_valid: list[int] = []
+        obs_parts = []
+        for i, w in enumerate(windows):
+            n_valid = w.batch.n_rows
+            if targets:
+                cand, w, n_valid = realign_mod.split_realign_candidates(
+                    w, targets, header.seq_dict.names
+                )
+                if cand is not None:
+                    candidates.append(cand)
+                windows[i] = w
+            window_valid.append(n_valid)
 
     def _observe_remainders():
         # non-candidate rows are untouched by realignment, so their
@@ -281,24 +299,26 @@ def transform_streamed(
         # On the device backend the histograms come back LAZY: every
         # window's scatter-add queues on the chip and the compact
         # tables are fetched together at the merge barrier.
-        t0 = time.perf_counter()
-        if recalibrate:
-            for i, w in enumerate(windows):
-                if window_valid[i]:
-                    total, mism, _rg, g = bqsr_mod._observe_device(
-                        w, known_snps, backend
-                    )
-                    obs_parts.append((total, mism, g))
-        stats["observe_s"] = time.perf_counter() - t0
+        with tr.span(tele.SPAN_OBSERVE):
+            if recalibrate:
+                for i, w in enumerate(windows):
+                    if window_valid[i]:
+                        total, mism, _rg, g = bqsr_mod._observe_device(
+                            w, known_snps, backend
+                        )
+                        obs_parts.append((total, mism, g))
+                        if use_device:
+                            tr.count(tele.C_DEVICE_DISPATCHED)
 
     # ---- tail: realign the gathered candidates (observing remainders
     # under the device wait), then observe the realigned part with its
     # post-realignment alignments (markdup -> realign -> BQSR, the
     # reference's Transform composition) ---------------------------------
-    t = time.perf_counter()
+    t_tail_ns = time.monotonic_ns()
     realigned: Optional[AlignmentDataset] = None
     if candidates:
         cand = AlignmentDataset.concat(candidates)
+        tr.count(tele.C_CANDIDATE_ROWS, int(cand.batch.n_rows))
         realigned = realign_mod.realign_indels(
             cand,
             consensus_model=consensus_model,
@@ -314,6 +334,8 @@ def transform_streamed(
                 realigned, known_snps, backend
             )
             obs_parts.append((total, mism, g))
+            if use_device:
+                tr.count(tele.C_DEVICE_DISPATCHED)
         # subtract the observe wall from the tail ONLY when realign
         # reports it genuinely ran under the sweeps' device drain — on
         # the serial paths (Python fallback, no dispatched sweeps) the
@@ -322,42 +344,39 @@ def transform_streamed(
         hidden = bool(
             getattr(_observe_remainders, "overlap_ran_in_dispatch", False)
         )
-        stats["observe_overlap_hidden"] = hidden
-        tail = time.perf_counter() - t
-        stats["realign_s"] = (
-            tail - stats.get("observe_s", 0.0) if hidden else tail
-        )
     else:
         _observe_remainders()
         # no realignment ran: the tail wall IS the observe pass
-        stats["observe_overlap_hidden"] = False
-        stats["realign_s"] = max(
-            0.0, time.perf_counter() - t - stats.get("observe_s", 0.0)
-        )
+        hidden = False
+    tr.add_span(tele.SPAN_TAIL, t_tail_ns, time.monotonic_ns() - t_tail_ns)
+    tr.gauge(tele.G_OBSERVE_HIDDEN, 1 if hidden else 0)
+    stats["observe_overlap_hidden"] = hidden
 
     # ---- barrier 2: merge histograms, solve the table ------------------
-    t = time.perf_counter()
     table = None
     gl = 0
     if recalibrate and obs_parts:
-        total, mism, gl = bqsr_mod.merge_observations(obs_parts)
-        stats["obs_merge_fetch_s"] = time.perf_counter() - t
-        t = time.perf_counter()  # solve_s excludes the fetch: the stage
-        # rows are disjoint and sum to the barrier wall
-        if dump_observations:
-            bqsr_mod.dump_observation_csv(
-                total, mism, header.read_groups.names + ["null"], gl,
-                dump_observations,
-            )
-        table = bqsr_mod.solve_recalibration_table(total, mism)
-    stats["solve_s"] = time.perf_counter() - t
+        with tr.span(tele.SPAN_OBS_MERGE):
+            total, mism, gl = bqsr_mod.merge_observations(obs_parts)
+        if use_device:
+            tr.count(tele.C_DEVICE_FETCHED, len(obs_parts))
+        # solve excludes the fetch: the stage rows are disjoint and sum
+        # to the barrier wall
+        with tr.span(tele.SPAN_SOLVE):
+            if dump_observations:
+                bqsr_mod.dump_observation_csv(
+                    total, mism, header.read_groups.names + ["null"], gl,
+                    dump_observations,
+                )
+            table = bqsr_mod.solve_recalibration_table(total, mism)
+    else:
+        tr.add_span(tele.SPAN_SOLVE, time.monotonic_ns(), 0)
 
     # ---- pass C: apply || encode || part writes ------------------------
     # Three overlapped resources: the chip (device table gathers,
     # double-buffered so window i+1's gather runs while window i
     # fetches), the host CPU (OQ stash + arrow encode in the pool's
     # encoder threads), and the disk (the pool's dedicated write thread).
-    t = time.perf_counter()
     from adam_tpu.io.parquet import PartWriterPool
 
     # the realigned part applies and submits FIRST: it is the largest
@@ -369,8 +388,6 @@ def transform_streamed(
     parts.extend(
         (i, w) for i, w in enumerate(windows) if window_valid[i]
     )
-    apply_dispatch_s = 0.0
-    apply_finish_s = 0.0
     # 3 parts in flight: one writing, one encoding, one being applied/
     # submitted — each stage's resource stays busy without the pool
     # pinning more than 3 decoded windows
@@ -384,64 +401,75 @@ def transform_streamed(
                     ds.header)
 
     try:
-        if table is not None and use_device:
-            pend = None  # (part idx, dispatched handle)
-            for j in range(len(parts)):
-                idx, w = parts[j]
-                parts[j] = None  # the list must not pin every window
-                t0 = time.perf_counter()
-                handle = bqsr_mod.apply_recalibration_dispatch(
-                    w, table, gl, backend
-                )
-                del w
-                apply_dispatch_s += time.perf_counter() - t0
-                if idx < len(windows):
-                    windows[idx] = None  # free as we go
+        # the span wraps apply+submit only; the device dispatch/fetch
+        # walls inside it are their own DISJOINT child spans, so the
+        # derived apply_split_s (pass C minus dispatch minus fetch) sums
+        # with them to the pass wall instead of double-counting it
+        with tr.span(tele.SPAN_PASS_C):
+            if table is not None and use_device:
+                pend = None  # (part idx, dispatched handle)
+                for j in range(len(parts)):
+                    idx, w = parts[j]
+                    parts[j] = None  # the list must not pin every window
+                    with tr.span(tele.SPAN_APPLY_DISPATCH, window=idx):
+                        handle = bqsr_mod.apply_recalibration_dispatch(
+                            w, table, gl, backend
+                        )
+                    del w
+                    tr.count(tele.C_DEVICE_DISPATCHED)
+                    tr.gauge(
+                        tele.G_DEVICE_INFLIGHT, 2 if pend is not None else 1
+                    )
+                    if idx < len(windows):
+                        windows[idx] = None  # free as we go
+                    if pend is not None:
+                        with tr.span(tele.SPAN_APPLY_FETCH, window=pend[0]):
+                            done = bqsr_mod.apply_recalibration_finish(
+                                pend[1]
+                            )
+                        tr.count(tele.C_DEVICE_FETCHED)
+                        _submit(pend[0], done)
+                    pend = (idx, handle)
                 if pend is not None:
-                    t0 = time.perf_counter()
-                    done = bqsr_mod.apply_recalibration_finish(pend[1])
-                    apply_finish_s += time.perf_counter() - t0
+                    with tr.span(tele.SPAN_APPLY_FETCH, window=pend[0]):
+                        done = bqsr_mod.apply_recalibration_finish(pend[1])
+                    tr.count(tele.C_DEVICE_FETCHED)
                     _submit(pend[0], done)
-                pend = (idx, handle)
-            if pend is not None:
-                t0 = time.perf_counter()
-                done = bqsr_mod.apply_recalibration_finish(pend[1])
-                apply_finish_s += time.perf_counter() - t0
-                _submit(pend[0], done)
-            stats["apply_device_dispatch_s"] = apply_dispatch_s
-            stats["apply_device_fetch_s"] = apply_finish_s
-        else:
-            for j in range(len(parts)):
-                idx, w = parts[j]
-                parts[j] = None  # the list must not pin every window
-                if table is not None:
-                    w = bqsr_mod.apply_recalibration(w, table, gl, backend)
-                if idx < len(windows):
-                    windows[idx] = None  # free as we go
-                _submit(idx, w)
-        # the host-side share of pass C (OQ stash, encode submits,
-        # eager host applies) — the device dispatch/fetch walls are
-        # recorded as their own DISJOINT rows above, so the three rows
-        # sum to the pass wall instead of double-counting it
-        stats["apply_split_s"] = (
-            time.perf_counter() - t - apply_dispatch_s - apply_finish_s
-        )
+            else:
+                for j in range(len(parts)):
+                    idx, w = parts[j]
+                    parts[j] = None  # the list must not pin every window
+                    if table is not None:
+                        w = bqsr_mod.apply_recalibration(
+                            w, table, gl, backend
+                        )
+                    if idx < len(windows):
+                        windows[idx] = None  # free as we go
+                    _submit(idx, w)
     except BaseException:
         try:  # drain the pool, but surface the apply-path error
             pool.close()
         except BaseException:
             pass
         raise
-    t = time.perf_counter()
-    pool.close()
-    stats["write_wait_s"] = time.perf_counter() - t
-    stats["total_s"] = time.perf_counter() - t_start
+    with tr.span(tele.SPAN_WRITE_WAIT):
+        pool.close()
+    tr.add_span(tele.SPAN_TOTAL, t_start_ns,
+                time.monotonic_ns() - t_start_ns)
 
-    # Mirror the stage walls into the named-timer registry so
-    # ``-print_metrics`` decomposes the streamed flagship the way the
-    # reference's Metrics listener decomposes a Spark job (stage rows on
-    # top, the codec/write timers recorded inside tokenize/save below
-    # them sum to the same wall).
+    # Timing keys are a DERIVED VIEW of the run tracer's span data —
+    # the span-derived view and the stats dict cannot disagree.
+    stats.update(tele.streamed_stats_view(tr.snapshot()))
+    _finish_trace(tr, stats)
+    return stats
+
+
+def _finish_trace(tr: tele.Tracer, stats: dict) -> None:
+    """End-of-run telemetry plumbing: mirror the derived stage walls
+    into the named-timer registry (so ``-print_metrics`` decomposes the
+    streamed flagship the way the reference's Metrics listener
+    decomposes a Spark job) and fold the run tracer's events/metrics
+    into the global TRACE when telemetry is enabled."""
     from adam_tpu.utils import instrumentation as ins
 
     for key, label in (
@@ -460,4 +488,5 @@ def transform_streamed(
     ):
         if key in stats:
             ins.TIMERS.add(label, int(stats[key] * 1e9))
-    return stats
+    if tele.TRACE.recording:
+        tele.TRACE.absorb(tr)

@@ -39,10 +39,15 @@ class TimerRegistry:
     # read-modify-write on Timer.total_ns from losing updates.
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
-    def timer(self, name: str) -> Timer:
+    def _timer_locked(self, name: str) -> Timer:
+        # caller holds self._lock
         if name not in self.timers:
             self.timers[name] = Timer(name)
         return self.timers[name]
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timer_locked(name)
 
     @contextlib.contextmanager
     def time(self, name: str):
@@ -55,7 +60,7 @@ class TimerRegistry:
         finally:
             dt = time.monotonic_ns() - t0
             with self._lock:
-                t = self.timer(name)
+                t = self._timer_locked(name)
                 t.total_ns += dt
                 t.count += 1
 
@@ -66,24 +71,46 @@ class TimerRegistry:
         if not self.recording:
             return
         with self._lock:
-            t = self.timer(name)
+            t = self._timer_locked(name)
             t.total_ns += ns
             t.count += 1
 
     def reset(self) -> None:
+        """Clear timers; on the process-global ``TIMERS`` singleton also
+        clear the structured metrics layer's counters/gauges
+        (utils/telemetry.py) — one reset for the whole metrics surface,
+        so a re-run never reports stale values from either.  Private
+        registry instances reset only themselves: they must not wipe
+        global telemetry another surface is still accumulating."""
         with self._lock:
             self.timers.clear()
+        if self is globals().get("TIMERS"):
+            from adam_tpu.utils import telemetry  # late: it imports us
+
+            telemetry.TRACE.reset_metrics()
+
+    def snapshot(self) -> dict:
+        """Consistent copy ``{name: (count, total_ns)}`` taken under the
+        lock — safe to call concurrently with ``time()``/``add()`` from
+        writer threads (the unlocked ``report()`` iteration raced with
+        timer inserts)."""
+        with self._lock:
+            return {t.name: (t.count, t.total_ns) for t in self.timers.values()}
 
     def report(self) -> str:
         """Aggregated table, longest stages first (the Metrics printout)."""
-        rows = sorted(self.timers.values(), key=lambda t: -t.total_ns)
+        rows = sorted(
+            self.snapshot().items(), key=lambda kv: -kv[1][1]
+        )
         if not rows:
             return "Timings\n=======\n(no timers recorded)\n"
-        w = max(len(t.name) for t in rows)
+        w = max(len(name) for name, _ in rows)
         out = ["Timings", "======="]
         out.append(f"{'timer'.ljust(w)}  {'count':>7}  {'total s':>10}")
-        for t in rows:
-            out.append(f"{t.name.ljust(w)}  {t.count:>7}  {t.total_s:>10.3f}")
+        for name, (count, total_ns) in rows:
+            out.append(
+                f"{name.ljust(w)}  {count:>7}  {total_ns / 1e9:>10.3f}"
+            )
         return "\n".join(out) + "\n"
 
 
@@ -118,14 +145,54 @@ OBSERVE_WALK = "BQSR Observe Walk (native)"
 APPLY_WALK = "BQSR Apply Walk (native)"
 
 
+# jax.profiler supports ONE active trace per process; a second
+# concurrent start raises deep inside the profiler.  The flag makes
+# device_trace reentrant-safe: nested/concurrent entries warn + no-op.
+_DEVICE_TRACE_LOCK = threading.Lock()
+_DEVICE_TRACE_ACTIVE = False
+
+
 @contextlib.contextmanager
 def device_trace(log_dir: str):
     """jax profiler trace for a stage — the xprof face of the metrics
-    system (the reference's Spark-listener task timings analog)."""
-    import jax
+    system (the reference's Spark-listener task timings analog; the CLI
+    exposes it as ``--xprof-dir DIR`` around the transform pipeline).
 
-    with jax.profiler.trace(log_dir):
+    Reentrant-safe: when a trace is already active in this process the
+    inner entry logs a warning and no-ops instead of crashing the
+    profiler; degrades to a warning no-op when jax is unavailable.
+    """
+    global _DEVICE_TRACE_ACTIVE
+    import logging
+
+    log = logging.getLogger(__name__)
+    with _DEVICE_TRACE_LOCK:
+        if _DEVICE_TRACE_ACTIVE:
+            already = True
+        else:
+            _DEVICE_TRACE_ACTIVE = True
+            already = False
+    if already:
+        log.warning(
+            "device_trace(%s): a profiler trace is already active in "
+            "this process; nested trace request ignored", log_dir,
+        )
         yield
+        return
+    try:
+        try:
+            import jax
+        except Exception:
+            log.warning(
+                "device_trace(%s): jax unavailable; trace disabled", log_dir
+            )
+            yield
+            return
+        with jax.profiler.trace(log_dir):
+            yield
+    finally:
+        with _DEVICE_TRACE_LOCK:
+            _DEVICE_TRACE_ACTIVE = False
 
 
 def block(x):

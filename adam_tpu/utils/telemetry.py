@@ -1,0 +1,557 @@
+"""Structured tracing + metrics: spans, counters, gauges, flight recorder.
+
+The observability layer the reference gets from bdg-utils ``Metrics`` +
+Spark's listener-decomposed stage/task timings
+(``instrumentation/Timers.scala:25-81``, ``ADAMCommand.scala:56-89``),
+built for the overlapped streamed pipeline: flat named timers
+(:mod:`adam_tpu.utils.instrumentation`, which this module subsumes)
+cannot show queue depths, per-window latency, or where the
+tokenize/dispatch/fetch/encode/write overlap breaks down.
+
+Three primitives, one lock discipline (the ``TimerRegistry`` one —
+single mutex, read-modify-write only under it):
+
+* **spans** — ``with TRACE.span("bqsr.apply.dispatch", window=i):``
+  records a timestamped interval with thread and parent attribution
+  into (a) a per-name aggregate (count, total ns) and (b) a bounded
+  in-memory **flight recorder** (ring buffer — long runs cannot OOM;
+  evictions keep the newest events and are counted).
+* **counters** — monotonically accumulated ints (reads ingested, bytes
+  encoded/written, device windows dispatched/fetched).
+* **gauges** — sampled values with last/min/max/n (writer-pool queue
+  depth at submit/drain, device dispatch in-flight).
+
+Exports: :meth:`Tracer.to_json` (the ``--metrics-json`` snapshot, whose
+``timers`` section is byte-identical to the ``-print_metrics`` table)
+and :meth:`Tracer.to_chrome_trace` (the ``--trace-out`` view — complete
+events on per-thread tracks, loadable in chrome://tracing / Perfetto,
+so the streamed overlap is visually inspectable).
+
+Disabled-by-default cost is one branch per call site: ``span()``
+returns a shared no-op context manager and ``count()``/``gauge()``
+return immediately when ``recording`` is off (micro-benchmark in
+docs/OBSERVABILITY.md).  The streamed pipeline records its stage spans
+into a private always-on :class:`Tracer` (a handful of events per
+window) and derives its ``stats`` dict from them via
+:func:`streamed_stats_view`, so the dict and the span data can never
+disagree; the run tracer is absorbed into the global :data:`TRACE`
+when recording is on.
+
+Every span/counter/gauge name is declared here (the ``_span``/
+``_metric`` registrations below) — a **stable contract** documented in
+docs/OBSERVABILITY.md and lint-enforced by
+``scripts/check-telemetry-names``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+# One process-wide trace epoch so timestamps from every Tracer (the
+# global TRACE, streamed run tracers, absorbed events) land on a single
+# comparable time axis in the Chrome-trace export.
+_EPOCH_NS = time.monotonic_ns()
+
+# --------------------------------------------------------------------------
+# Name registry — the stable contract (docs/OBSERVABILITY.md)
+# --------------------------------------------------------------------------
+_REGISTERED_SPANS: set = set()
+_REGISTERED_METRICS: set = set()
+
+
+def _span(name: str) -> str:
+    _REGISTERED_SPANS.add(name)
+    return name
+
+
+def _metric(name: str) -> str:
+    _REGISTERED_METRICS.add(name)
+    return name
+
+
+# ---- streamed-pipeline stage spans (pipelines/streamed.py; the stats
+# dict keys derive from these via streamed_stats_view) ----
+SPAN_PASS_A = _span("streamed.pass_a.ingest")
+SPAN_TOKENIZE = _span("streamed.tokenize")
+SPAN_MD_FETCH = _span("streamed.markdup.fetch")
+SPAN_RESOLVE = _span("streamed.barrier.resolve")
+SPAN_SPLIT = _span("streamed.pass_b.split")
+SPAN_OBSERVE = _span("streamed.observe")
+SPAN_TAIL = _span("streamed.tail")
+SPAN_OBS_MERGE = _span("streamed.observe.merge_fetch")
+SPAN_SOLVE = _span("streamed.barrier.solve")
+SPAN_PASS_C = _span("streamed.pass_c")
+SPAN_APPLY_DISPATCH = _span("streamed.apply.dispatch")
+SPAN_APPLY_FETCH = _span("streamed.apply.fetch")
+SPAN_WRITE_WAIT = _span("streamed.write_wait")
+SPAN_TOTAL = _span("streamed.total")
+
+# ---- per-call spans with backend attribution (pipelines/bqsr.py,
+# pipelines/markdup.py) ----
+SPAN_BQSR_OBSERVE = _span("bqsr.observe.window")
+SPAN_BQSR_APPLY_DISPATCH = _span("bqsr.apply.dispatch")
+SPAN_BQSR_APPLY_FETCH = _span("bqsr.apply.fetch")
+SPAN_BQSR_APPLY_HOST = _span("bqsr.apply.host")
+SPAN_MD_COLUMNS = _span("markdup.columns.dispatch")
+
+# ---- io/parquet.py part-writer spans ----
+SPAN_PART_ENCODE = _span("parquet.part.encode")
+SPAN_PART_WRITE = _span("parquet.part.write")
+
+# ---- native tokenizer/codec spans share the timer-table names
+# (native/__init__.py records each dispatch as BOTH a timer row and a
+# span, so the flight recorder sees the codec work on its thread) ----
+from adam_tpu.utils import instrumentation as _ins  # noqa: E402
+
+for _n in (
+    _ins.TOKENIZE_INPUT, _ins.BGZF_CODEC, _ins.PARQUET_ENCODE,
+    _ins.PARQUET_WRITE, _ins.SAM_ENCODE, _ins.FASTQ_ENCODE,
+    _ins.OBSERVE_WALK, _ins.APPLY_WALK,
+):
+    _span(_n)
+
+# ---- counters ----
+C_READS_INGESTED = _metric("reads.ingested")
+C_WINDOWS_INGESTED = _metric("windows.ingested")
+C_DEVICE_DISPATCHED = _metric("device.windows.dispatched")
+C_DEVICE_FETCHED = _metric("device.windows.fetched")
+C_BYTES_ENCODED = _metric("parquet.bytes.encoded")
+C_BYTES_WRITTEN = _metric("parquet.bytes.written")
+C_PARTS_WRITTEN = _metric("parquet.parts.written")
+C_CANDIDATE_ROWS = _metric("realign.candidate_rows")
+
+# ---- gauges ----
+G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
+G_DEVICE_INFLIGHT = _metric("device.dispatch.in_flight")
+G_OBSERVE_HIDDEN = _metric("streamed.observe_overlap_hidden")
+
+#: Device-only metrics: the paired-CPU bench baseline zeroes these
+#: instead of omitting them so round-over-round diffs are key-stable.
+DEVICE_ONLY_COUNTERS = frozenset({C_DEVICE_DISPATCHED, C_DEVICE_FETCHED})
+DEVICE_ONLY_GAUGES = frozenset({G_DEVICE_INFLIGHT})
+
+
+def registered_spans() -> frozenset:
+    return frozenset(_REGISTERED_SPANS)
+
+
+def registered_metrics() -> frozenset:
+    return frozenset(_REGISTERED_METRICS)
+
+
+def registered_names() -> frozenset:
+    """Every declared span/counter/gauge name — the contract the
+    ``scripts/check-telemetry-names`` lint enforces against call-site
+    string literals."""
+    return frozenset(_REGISTERED_SPANS | _REGISTERED_METRICS)
+
+
+# --------------------------------------------------------------------------
+# Span context managers
+# --------------------------------------------------------------------------
+class _NullSpan:
+    """Shared no-op span: the disabled fast path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tr", "name", "attrs", "_t0", "_parent")
+
+    def __init__(self, tr: "Tracer", name: str, attrs: dict):
+        self._tr = tr
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        tls = self._tr._tls
+        self._parent = getattr(tls, "span", None)
+        tls.span = self
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.monotonic_ns() - self._t0
+        self._tr._tls.span = self._parent
+        self._tr._record(
+            self.name, self._t0, dur, self.attrs,
+            self._parent.name if self._parent is not None else None,
+        )
+        return False
+
+
+class Tracer:
+    """Span/counter/gauge recorder with a bounded flight recorder.
+
+    Thread-safe under one mutex (the ``TimerRegistry`` lock
+    discipline); per-name aggregates live OUTSIDE the ring, so span
+    totals stay exact even after the ring evicts old events.
+    """
+
+    def __init__(self, recording: bool = False, capacity: int | None = None):
+        if capacity is None:
+            raw = os.environ.get("ADAM_TPU_TRACE_EVENTS", "")
+            try:
+                capacity = int(raw)
+            except ValueError:
+                # the module-level TRACE constructs at import time from
+                # every entry point: a malformed tuning var must degrade
+                # to the default, not brick the CLI with a ValueError
+                if raw:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "ADAM_TPU_TRACE_EVENTS=%r is not an int; using "
+                        "default 65536", raw,
+                    )
+                capacity = 65536
+        self.recording = recording
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(1, capacity))
+        self._spans: dict = {}     # name -> [count, total_ns]
+        self._counters: dict = {}  # name -> int
+        self._gauges: dict = {}    # name -> {last, min, max, n}
+        self._tls = threading.local()
+        self._n_recorded = 0
+
+    # ---- recording --------------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Span context manager; a shared no-op when not recording."""
+        if not self.recording:
+            return _NULL_SPAN
+        return _Span(self, name, attrs)
+
+    def add_span(self, name: str, start_ns: int, dur_ns: int,
+                 thread: str | None = None, **attrs) -> None:
+        """Record an externally-measured interval (monotonic_ns clock)."""
+        if not self.recording:
+            return
+        self._record(name, start_ns, dur_ns, attrs, None, thread)
+
+    def _record(self, name, t0, dur, attrs, parent, thread=None):
+        ev = {
+            "name": name,
+            "ts_ns": t0,
+            "dur_ns": dur,
+            "thread": thread or threading.current_thread().name,
+        }
+        if parent:
+            ev["parent"] = parent
+        if attrs:
+            ev["args"] = dict(attrs)
+        with self._lock:
+            self._events.append(ev)
+            self._n_recorded += 1
+            agg = self._spans.get(name)
+            if agg is None:
+                self._spans[name] = [1, dur]
+            else:
+                agg[0] += 1
+                agg[1] += dur
+
+    def count(self, name: str, n: int = 1) -> None:
+        if not self.recording:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value) -> None:
+        if not self.recording:
+            return
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                self._gauges[name] = {
+                    "last": value, "min": value, "max": value, "n": 1,
+                }
+            else:
+                g["last"] = value
+                if value < g["min"]:
+                    g["min"] = value
+                if value > g["max"]:
+                    g["max"] = value
+                g["n"] += 1
+
+    # ---- reading ----------------------------------------------------------
+    def span_seconds(self) -> dict:
+        """Per-name total span seconds (concurrency-safe copy)."""
+        with self._lock:
+            return {k: v[1] / 1e9 for k, v in self._spans.items()}
+
+    def events(self) -> list:
+        """Copy of the flight-recorder ring (oldest surviving first)."""
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def snapshot(self) -> dict:
+        """Aggregate view (spans/counters/gauges), safe to call
+        concurrently with recording.  Does NOT include the event ring —
+        that is the Chrome-trace export's job."""
+        with self._lock:
+            return {
+                "spans": {
+                    k: {"count": v[0], "total_s": v[1] / 1e9}
+                    for k, v in self._spans.items()
+                },
+                "counters": dict(self._counters),
+                "gauges": {k: dict(v) for k, v in self._gauges.items()},
+                "events_recorded": self._n_recorded,
+                "events_retained": len(self._events),
+                "events_evicted": self._n_recorded - len(self._events),
+            }
+
+    # ---- lifecycle --------------------------------------------------------
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._spans.clear()
+            self._counters.clear()
+            self._gauges.clear()
+            self._n_recorded = 0
+
+    def reset_metrics(self) -> None:
+        """Clear counters + gauges only (TimerRegistry.reset delegates
+        here so one reset clears the whole metrics surface)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+    def absorb(self, other: "Tracer") -> None:
+        """Merge another tracer's events + aggregates into this one
+        (the streamed run tracer folds into the global TRACE)."""
+        with other._lock:
+            events = [dict(e) for e in other._events]
+            spans = {k: list(v) for k, v in other._spans.items()}
+            counters = dict(other._counters)
+            gauges = {k: dict(v) for k, v in other._gauges.items()}
+            n_rec = other._n_recorded
+        with self._lock:
+            self._events.extend(events)
+            self._n_recorded += n_rec
+            for k, (c, ns) in spans.items():
+                agg = self._spans.get(k)
+                if agg is None:
+                    self._spans[k] = [c, ns]
+                else:
+                    agg[0] += c
+                    agg[1] += ns
+            for k, v in counters.items():
+                self._counters[k] = self._counters.get(k, 0) + v
+            for k, g in gauges.items():
+                mine = self._gauges.get(k)
+                if mine is None:
+                    self._gauges[k] = dict(g)
+                else:
+                    mine["last"] = g["last"]
+                    mine["min"] = min(mine["min"], g["min"])
+                    mine["max"] = max(mine["max"], g["max"])
+                    mine["n"] += g["n"]
+
+    # ---- exports ----------------------------------------------------------
+    def to_json(self, timers=None, include_events: bool = False) -> dict:
+        """The ``--metrics-json`` document.  ``timers`` defaults to the
+        process-wide :data:`~adam_tpu.utils.instrumentation.TIMERS`;
+        its section carries the same (count, total_s) rows as the
+        printed ``-print_metrics`` table, so the two cannot drift.
+        ``include_events=True`` appends the flight-recorder ring (the
+        dump-on-error view)."""
+        if timers is None:
+            timers = _ins.TIMERS
+        doc = self.snapshot()
+        doc["timers"] = {
+            name: {"count": c, "total_s": ns / 1e9}
+            for name, (c, ns) in sorted(timers.snapshot().items())
+        }
+        doc["meta"] = {
+            "pid": os.getpid(),
+            "epoch_ns": _EPOCH_NS,
+            "schema": "adam_tpu.telemetry/1",
+        }
+        if include_events:
+            doc["events"] = self.events()
+        return doc
+
+    def to_chrome_trace(self) -> dict:
+        """Flight recorder -> Chrome trace-event JSON (Perfetto /
+        chrome://tracing).  Each recording thread gets its own track, so
+        the streamed tokenize/dispatch/fetch/encode/write overlap is
+        visually inspectable."""
+        evs = self.events()
+        pid = os.getpid()
+        tids: dict = {}
+        out = []
+        for e in evs:
+            th = e["thread"]
+            if th not in tids:
+                tids[th] = len(tids) + 1
+                out.append({
+                    "ph": "M", "pid": pid, "tid": tids[th],
+                    "name": "thread_name", "args": {"name": th},
+                })
+        for e in evs:
+            ev = {
+                "ph": "X",
+                "pid": pid,
+                "tid": tids[e["thread"]],
+                "name": e["name"],
+                "cat": "adam_tpu",
+                "ts": (e["ts_ns"] - _EPOCH_NS) / 1e3,  # microseconds
+                "dur": e["dur_ns"] / 1e3,
+            }
+            args = dict(e.get("args") or {})
+            if "parent" in e:
+                args["parent"] = e["parent"]
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def dump_json(self, path: str, timers=None,
+                  include_events: bool = False) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(timers, include_events=include_events),
+                      fh, indent=1, default=str)
+
+    def dump_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_chrome_trace(), fh, default=str)
+
+    def report(self) -> str:
+        """Counters/gauges table printed below the timer table by
+        ``-print_metrics``."""
+        snap = self.snapshot()
+        out = []
+        if snap["counters"]:
+            w = max(len(k) for k in snap["counters"])
+            out += ["Counters", "========"]
+            out.append(f"{'counter'.ljust(w)}  {'value':>14}")
+            for k in sorted(snap["counters"]):
+                out.append(f"{k.ljust(w)}  {snap['counters'][k]:>14}")
+            out.append("")
+        if snap["gauges"]:
+            w = max(len(k) for k in snap["gauges"])
+            out += ["Gauges", "======"]
+            out.append(
+                f"{'gauge'.ljust(w)}  {'last':>8}  {'min':>8}  {'max':>8}"
+                f"  {'samples':>8}"
+            )
+            for k in sorted(snap["gauges"]):
+                g = snap["gauges"][k]
+                out.append(
+                    f"{k.ljust(w)}  {g['last']:>8}  {g['min']:>8}"
+                    f"  {g['max']:>8}  {g['n']:>8}"
+                )
+            out.append("")
+        if not out:
+            return "Counters/Gauges\n===============\n(none recorded)\n"
+        return "\n".join(out)
+
+
+#: Process-wide tracer — the ``object Timers`` analog for the
+#: structured layer.  Off by default; the CLI flips it on for
+#: ``-print_metrics`` / ``--metrics-json`` / ``--trace-out``.
+TRACE = Tracer()
+
+
+# --------------------------------------------------------------------------
+# Derived views
+# --------------------------------------------------------------------------
+def streamed_stats_view(snap: dict) -> dict:
+    """Rebuild the streamed pipeline's timing ``stats`` keys from span
+    data (a :meth:`Tracer.snapshot`).  ``transform_streamed`` itself
+    calls this on its run tracer — the stats dict IS this view, so the
+    printed stats and the span data cannot disagree, and a test can
+    recompute the view from an exported snapshot.
+    """
+    spans = snap.get("spans", {})
+
+    def s(name):
+        e = spans.get(name)
+        return e["total_s"] if e else None
+
+    out = {}
+    for key, name in (
+        ("ingest_pass_s", SPAN_PASS_A),
+        ("md_cols_fetch_s", SPAN_MD_FETCH),
+        ("resolve_s", SPAN_RESOLVE),
+        ("split_s", SPAN_SPLIT),
+        ("observe_s", SPAN_OBSERVE),
+        ("obs_merge_fetch_s", SPAN_OBS_MERGE),
+        ("solve_s", SPAN_SOLVE),
+        ("apply_device_dispatch_s", SPAN_APPLY_DISPATCH),
+        ("apply_device_fetch_s", SPAN_APPLY_FETCH),
+        ("write_wait_s", SPAN_WRITE_WAIT),
+        ("total_s", SPAN_TOTAL),
+    ):
+        v = s(name)
+        if v is not None:
+            out[key] = v
+    tail = s(SPAN_TAIL)
+    if tail is not None:
+        obs = s(SPAN_OBSERVE) or 0.0
+        hidden = bool(
+            snap.get("gauges", {}).get(G_OBSERVE_HIDDEN, {}).get("last", 0)
+        )
+        had_candidates = (
+            snap.get("counters", {}).get(C_CANDIDATE_ROWS, 0) > 0
+        )
+        if had_candidates:
+            # subtract the observe wall only when it genuinely ran
+            # under the realign sweeps' device drain (streamed.py's
+            # observe_overlap_hidden semantics)
+            out["realign_s"] = tail - obs if hidden else tail
+        else:
+            out["realign_s"] = max(0.0, tail - obs)
+    pass_c = s(SPAN_PASS_C)
+    if pass_c is not None:
+        # host share of pass C: the device dispatch/fetch walls are
+        # their own disjoint rows
+        out["apply_split_s"] = (
+            pass_c
+            - (s(SPAN_APPLY_DISPATCH) or 0.0)
+            - (s(SPAN_APPLY_FETCH) or 0.0)
+        )
+    return out
+
+
+def key_stable_snapshot(tr: Tracer | None = None) -> dict:
+    """Snapshot with device-only counters/gauges ensured present (as
+    zeros) — the bench's paired-CPU-baseline path uses this so
+    round-over-round artifact diffs are key-stable."""
+    snap = (tr or TRACE).snapshot()
+    for name in sorted(DEVICE_ONLY_COUNTERS):
+        snap["counters"].setdefault(name, 0)
+    for name in sorted(DEVICE_ONLY_GAUGES):
+        snap["gauges"].setdefault(
+            name, {"last": 0, "min": 0, "max": 0, "n": 0}
+        )
+    return snap
+
+
+def merge_snapshots(snaps: list) -> dict:
+    """Combine per-host snapshots (parallel/dist.gather_host_telemetry)
+    into one report with per-host skew: for every span name, the
+    min/max total wall across hosts — the Spark-listener per-executor
+    skew view."""
+    skew = {}
+    for snap in snaps:
+        for name, e in snap.get("spans", {}).items():
+            sk = skew.setdefault(
+                name, {"min_s": e["total_s"], "max_s": e["total_s"]}
+            )
+            sk["min_s"] = min(sk["min_s"], e["total_s"])
+            sk["max_s"] = max(sk["max_s"], e["total_s"])
+    return {"n_hosts": len(snaps), "hosts": snaps, "span_skew": skew}

@@ -238,21 +238,36 @@ def _run_streamed(known, trials: int = 1, probe: bool = True) -> dict:
     is attributable — and is paced by :func:`_probe_paced`, so a window
     doesn't start on a slice too starved to measure anything.  The
     returned dict carries best-trial stages plus the full per-window
-    context under ``windows``/``spread``."""
+    context under ``windows``/``spread`` and the best trial's telemetry
+    snapshot under ``telemetry`` (key-stable: device-only counters/
+    gauges are zero-filled on the CPU-baseline path instead of omitted,
+    so round-over-round artifact diffs never churn on key sets)."""
     from adam_tpu.pipelines.streamed import transform_streamed
+    from adam_tpu.utils import telemetry as tele
 
     best = None
+    best_snap = None
     windows = []
+    was_recording = tele.TRACE.recording
     for _ in range(max(1, trials)):
         if probe:
             probe_tf, skipped = _probe_paced()
         else:
             probe_tf, skipped = float("nan"), []
         load0 = _host_load()
-        with tempfile.TemporaryDirectory() as td:
-            stats = transform_streamed(
-                _SYNTH, os.path.join(td, "out.adam"), known_snps=known
-            )
+        # per-trial telemetry window: reset + record so the snapshot
+        # attached to the artifact is the BEST trial's, not a blur of
+        # all trials
+        tele.TRACE.reset()
+        tele.TRACE.recording = True
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                stats = transform_streamed(
+                    _SYNTH, os.path.join(td, "out.adam"), known_snps=known
+                )
+        finally:
+            tele.TRACE.recording = was_recording
+        snap = tele.key_stable_snapshot()
         w = {
             "total_s": round(stats["total_s"], 2),
             "probe_tflops_before": probe_tf,
@@ -264,8 +279,10 @@ def _run_streamed(known, trials: int = 1, probe: bool = True) -> dict:
         windows.append(w)
         if best is None or stats["total_s"] < best["total_s"]:
             best = stats
+            best_snap = snap
     totals = sorted(w["total_s"] for w in windows)
     best = dict(best)
+    best["telemetry"] = best_snap
     best["windows"] = windows
     best["spread"] = {
         "min_s": totals[0],
@@ -618,6 +635,15 @@ def main() -> None:
                     k: round(v, 2)
                     for k, v in cpu_stats.items()
                     if k.endswith("_s") and isinstance(v, float)
+                },
+                # best-trial telemetry snapshots (spans/counters/gauges;
+                # utils/telemetry.py) — per-stage trajectories for
+                # future rounds.  Both legs are key-stable: the CPU
+                # baseline zero-fills device-only metrics instead of
+                # omitting them.
+                "telemetry": {
+                    "chip": stages.get("telemetry"),
+                    "cpu_baseline": cpu_stats.get("telemetry"),
                 },
             })
         )

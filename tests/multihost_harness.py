@@ -129,6 +129,12 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
     from adam_tpu.pipelines import realign as realign_mod
     from adam_tpu.pipelines.streamed import _write_part
 
+    # record per-host spans/counters so the merge-barrier telemetry
+    # gather below has real per-host data to show skew over
+    from adam_tpu.utils import telemetry as _telemetry
+
+    _telemetry.TRACE.recording = True
+
     mesh = genome_mesh(jax.devices())
     # only real shards: the candidate spills below also live here
     shard_paths = sorted(
@@ -281,6 +287,20 @@ def transform_main(coordinator: str, n_procs: int, pid: int,
     total = psum_table(pt)
     mism = psum_table(pm)
     table = bqsr_mod.solve_recalibration_table(total, mism)
+
+    # ---- telemetry gather at the merge barrier: every process ships
+    # its snapshot over the same DCN transport the psum rode, and pid 0
+    # writes the per-host skew report next to the output parts ----------
+    from adam_tpu.parallel import dist as dist_mod
+    from adam_tpu.utils import telemetry
+
+    host_snaps = dist_mod.gather_host_telemetry()
+    assert len(host_snaps) == n_procs
+    if pid == 0:
+        with open(os.path.join(out_dir, "telemetry.json"), "w") as fh:
+            import json
+
+            json.dump(telemetry.merge_snapshots(host_snaps), fh, default=str)
 
     # ---- pass C: apply the global table to shard remainders (re-split
     # under the same rule) and, on pid 0, to the realigned part ----------

@@ -1,0 +1,397 @@
+"""Telemetry layer: spans, counters, gauges, flight recorder, exports.
+
+The contract under test (utils/telemetry.py, docs/OBSERVABILITY.md):
+recording is lossless under concurrent writers (the ``TimerRegistry``
+lock discipline), the flight recorder is bounded and evicts oldest
+first, the JSON / Chrome-trace exports round-trip, and the streamed
+pipeline's ``stats`` dict is a pure derived view of its span data —
+recomputing the view from an exported snapshot reproduces it exactly.
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+
+import pytest
+
+from adam_tpu.utils import instrumentation as ins
+from adam_tpu.utils import telemetry as tele
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "tools")
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    """Tests toggle the process-wide TRACE/TIMERS; leave them as found."""
+    rec_t, rec_i = tele.TRACE.recording, ins.TIMERS.recording
+    yield
+    tele.TRACE.recording = rec_t
+    ins.TIMERS.recording = rec_i
+    tele.TRACE.reset()
+    ins.TIMERS.recording = True
+    ins.TIMERS.reset()
+    ins.TIMERS.recording = rec_i
+
+
+# --------------------------------------------------------------------------
+# core recorder
+# --------------------------------------------------------------------------
+def test_disabled_tracer_records_nothing():
+    tr = tele.Tracer(recording=False)
+    with tr.span(tele.SPAN_TOKENIZE, window=0):
+        pass
+    tr.count(tele.C_READS_INGESTED, 100)
+    tr.gauge(tele.G_POOL_DEPTH, 3)
+    snap = tr.snapshot()
+    assert snap["spans"] == {} and snap["counters"] == {}
+    assert snap["gauges"] == {} and snap["events_recorded"] == 0
+    # the disabled fast path hands back one shared no-op object
+    assert tr.span(tele.SPAN_SOLVE) is tr.span(tele.SPAN_TOKENIZE)
+
+
+def test_concurrent_recording_is_lossless():
+    """≥4 threads hammering spans+counters+gauges: nothing lost."""
+    tr = tele.Tracer(recording=True, capacity=1 << 16)
+    n_threads, per_thread = 8, 500
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            with tr.span(tele.SPAN_TOKENIZE, window=i):
+                pass
+            tr.count(tele.C_READS_INGESTED, 2)
+            tr.gauge(tele.G_POOL_DEPTH, tid)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    # concurrent readers must not race the writers (satellite: locked
+    # snapshot) — exercise while recording is in flight
+    for _ in range(50):
+        tr.snapshot()
+        tr.span_seconds()
+    for t in threads:
+        t.join()
+    snap = tr.snapshot()
+    total = n_threads * per_thread
+    assert snap["spans"][tele.SPAN_TOKENIZE]["count"] == total
+    assert snap["counters"][tele.C_READS_INGESTED] == 2 * total
+    assert snap["gauges"][tele.G_POOL_DEPTH]["n"] == total
+    assert snap["gauges"][tele.G_POOL_DEPTH]["min"] == 0
+    assert snap["gauges"][tele.G_POOL_DEPTH]["max"] == n_threads - 1
+    assert snap["events_recorded"] == total
+    assert snap["events_evicted"] == 0
+
+
+def test_ring_buffer_evicts_oldest_keeps_newest():
+    tr = tele.Tracer(recording=True, capacity=16)
+    t0 = 1_000_000
+    for i in range(100):
+        tr.add_span(tele.SPAN_TOKENIZE, t0 + i, 10, window=i)
+    evs = tr.events()
+    assert len(evs) == 16
+    # newest 16 survive, oldest first within the ring
+    assert [e["args"]["window"] for e in evs] == list(range(84, 100))
+    snap = tr.snapshot()
+    assert snap["events_recorded"] == 100
+    assert snap["events_retained"] == 16
+    assert snap["events_evicted"] == 84
+    # aggregates live OUTSIDE the ring: totals stay exact post-eviction
+    assert snap["spans"][tele.SPAN_TOKENIZE]["count"] == 100
+
+
+def test_span_nesting_records_parent_and_thread():
+    tr = tele.Tracer(recording=True)
+    with tr.span(tele.SPAN_PASS_C):
+        with tr.span(tele.SPAN_APPLY_DISPATCH, window=3):
+            pass
+    evs = tr.events()
+    # inner exits (and records) first
+    assert [e["name"] for e in evs] == [
+        tele.SPAN_APPLY_DISPATCH, tele.SPAN_PASS_C,
+    ]
+    assert evs[0]["parent"] == tele.SPAN_PASS_C
+    assert evs[0]["args"]["window"] == 3
+    assert "parent" not in evs[1]
+    assert evs[0]["thread"] == threading.current_thread().name
+
+
+def test_absorb_merges_aggregates_and_events():
+    a = tele.Tracer(recording=True)
+    b = tele.Tracer(recording=True)
+    for tr, k in ((a, 1), (b, 2)):
+        for _ in range(k):
+            with tr.span(tele.SPAN_SOLVE):
+                pass
+        tr.count(tele.C_PARTS_WRITTEN, k)
+        tr.gauge(tele.G_DEVICE_INFLIGHT, k)
+    a.absorb(b)
+    snap = a.snapshot()
+    assert snap["spans"][tele.SPAN_SOLVE]["count"] == 3
+    assert snap["counters"][tele.C_PARTS_WRITTEN] == 3
+    g = snap["gauges"][tele.G_DEVICE_INFLIGHT]
+    assert (g["min"], g["max"], g["n"], g["last"]) == (1, 2, 2, 2)
+    assert snap["events_recorded"] == 3
+
+
+# --------------------------------------------------------------------------
+# exports round-trip
+# --------------------------------------------------------------------------
+def _populated_tracer():
+    tr = tele.Tracer(recording=True)
+    with tr.span(tele.SPAN_PASS_A):
+        with tr.span(tele.SPAN_TOKENIZE, window=0):
+            pass
+    tr.count(tele.C_WINDOWS_INGESTED)
+    tr.gauge(tele.G_POOL_DEPTH, 2)
+    return tr
+
+
+def test_json_export_round_trips(tmp_path):
+    tr = _populated_tracer()
+    ins.TIMERS.recording = True
+    ins.TIMERS.reset()
+    ins.TIMERS.add(ins.SAM_ENCODE, 2_000_000_000)
+    p = str(tmp_path / "m.json")
+    tr.dump_json(p, include_events=True)
+    doc = json.load(open(p))
+    assert doc["meta"]["schema"] == "adam_tpu.telemetry/1"
+    # the snapshot sections survive the file round-trip verbatim
+    snap = tr.snapshot()
+    assert doc["spans"] == snap["spans"]
+    assert doc["counters"] == snap["counters"]
+    assert doc["gauges"] == snap["gauges"]
+    # the timers section is the TimerRegistry snapshot, same rows the
+    # printed table carries
+    assert doc["timers"][ins.SAM_ENCODE] == {"count": 1, "total_s": 2.0}
+    # include_events carries the flight recorder
+    assert [e["name"] for e in doc["events"]] == [
+        e["name"] for e in tr.events()
+    ]
+
+
+def test_chrome_trace_export_loads_and_tracks_threads(tmp_path):
+    tr = _populated_tracer()
+
+    def other_thread():
+        with tr.span(tele.SPAN_PART_ENCODE, rows=8):
+            pass
+
+    t = threading.Thread(target=other_thread, name="pw-enc-0")
+    t.start()
+    t.join()
+    p = str(tmp_path / "t.json")
+    tr.dump_chrome_trace(p)
+    doc = json.load(open(p))
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    # one thread_name metadata record per recording thread, distinct tids
+    names = {e["args"]["name"] for e in meta}
+    assert "pw-enc-0" in names and len(names) == 2
+    assert len({e["tid"] for e in meta}) == 2
+    # complete events carry microsecond ts/dur on the right track
+    by_name = {e["name"]: e for e in spans}
+    assert set(by_name) == {
+        tele.SPAN_PASS_A, tele.SPAN_TOKENIZE, tele.SPAN_PART_ENCODE,
+    }
+    ring = {e["name"]: e for e in tr.events()}
+    for name, ev in by_name.items():
+        assert ev["dur"] == pytest.approx(ring[name]["dur_ns"] / 1e3)
+    enc_tid = by_name[tele.SPAN_PART_ENCODE]["tid"]
+    tok_tid = by_name[tele.SPAN_TOKENIZE]["tid"]
+    assert enc_tid != tok_tid
+    # nesting attribution survives as args.parent
+    assert by_name[tele.SPAN_TOKENIZE]["args"]["parent"] == tele.SPAN_PASS_A
+
+
+def test_key_stable_snapshot_zero_fills_device_metrics():
+    tr = tele.Tracer(recording=True)
+    tr.count(tele.C_READS_INGESTED, 5)
+    snap = tele.key_stable_snapshot(tr)
+    for name in tele.DEVICE_ONLY_COUNTERS:
+        assert snap["counters"][name] == 0
+    for name in tele.DEVICE_ONLY_GAUGES:
+        assert snap["gauges"][name] == {
+            "last": 0, "min": 0, "max": 0, "n": 0,
+        }
+    # real values are never clobbered by the zero-fill
+    assert snap["counters"][tele.C_READS_INGESTED] == 5
+
+
+def test_merge_snapshots_reports_per_host_skew():
+    def host(total_s):
+        tr = tele.Tracer(recording=True)
+        tr.add_span(tele.SPAN_PASS_A, 0, int(total_s * 1e9))
+        return tr.snapshot()
+
+    merged = tele.merge_snapshots([host(1.0), host(3.0)])
+    assert merged["n_hosts"] == 2
+    sk = merged["span_skew"][tele.SPAN_PASS_A]
+    assert sk["min_s"] == pytest.approx(1.0)
+    assert sk["max_s"] == pytest.approx(3.0)
+
+
+# --------------------------------------------------------------------------
+# TimerRegistry satellites
+# --------------------------------------------------------------------------
+def test_timer_snapshot_safe_during_recording():
+    reg = ins.TimerRegistry(recording=True)
+    stop = threading.Event()
+
+    def hammer(i):
+        while not stop.is_set():
+            with reg.time(f"t{i}"):
+                pass
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            snap = reg.snapshot()
+            for name, (count, total_ns) in snap.items():
+                assert count >= 1 and total_ns >= 0
+            reg.report()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert set(reg.snapshot()) == {f"t{i}" for i in range(4)}
+
+
+def test_timers_reset_clears_telemetry_metrics():
+    ins.TIMERS.recording = True
+    tele.TRACE.recording = True
+    ins.TIMERS.add(ins.SAM_ENCODE, 1000)
+    tele.TRACE.count(tele.C_PARTS_WRITTEN, 7)
+    tele.TRACE.gauge(tele.G_POOL_DEPTH, 4)
+    ins.TIMERS.reset()
+    assert ins.TIMERS.snapshot() == {}
+    snap = tele.TRACE.snapshot()
+    # one reset clears the whole metrics surface (satellite 1)
+    assert snap["counters"] == {} and snap["gauges"] == {}
+
+
+def test_private_registry_reset_leaves_global_telemetry_alone():
+    tele.TRACE.recording = True
+    tele.TRACE.count(tele.C_PARTS_WRITTEN, 3)
+    reg = ins.TimerRegistry(recording=True)
+    reg.add(ins.SAM_ENCODE, 1000)
+    reg.reset()
+    assert reg.snapshot() == {}
+    # only the process-global TIMERS reset cascades into TRACE
+    assert tele.TRACE.snapshot()["counters"][tele.C_PARTS_WRITTEN] == 3
+
+
+def test_device_trace_reentrant_noop(tmp_path, caplog, monkeypatch):
+    """A second concurrent device_trace warns and no-ops instead of
+    crashing the profiler (satellite 2)."""
+    monkeypatch.setattr(ins, "_DEVICE_TRACE_ACTIVE", True)
+    with caplog.at_level("WARNING", logger="adam_tpu.utils.instrumentation"):
+        with ins.device_trace(str(tmp_path / "xprof")):
+            pass
+    assert any("already active" in r.message for r in caplog.records)
+    # the no-op inner exit must NOT release the outer trace's guard
+    assert ins._DEVICE_TRACE_ACTIVE is True
+
+
+# --------------------------------------------------------------------------
+# streamed pipeline: stats is a derived view of the span data
+# --------------------------------------------------------------------------
+def test_streamed_stats_equals_span_view(tmp_path):
+    """Smoke run of the streamed flagship (CPU): the returned ``stats``
+    timing keys must be exactly reproducible from the exported global
+    snapshot via streamed_stats_view — the dict IS the view."""
+    from adam_tpu.pipelines.streamed import transform_streamed
+    from make_synth_sam import make_sam
+
+    path = str(tmp_path / "in.sam")
+    make_sam(path, 2048, 100)
+    tele.TRACE.reset()
+    tele.TRACE.recording = True
+    try:
+        stats = transform_streamed(
+            path, str(tmp_path / "out.adam"), window_reads=512
+        )
+    finally:
+        tele.TRACE.recording = False
+    snap = tele.TRACE.snapshot()
+    view = tele.streamed_stats_view(snap)
+    assert view, "span view is empty — stage spans were not recorded"
+    for key, want in view.items():
+        assert stats[key] == want, key
+    # every stage wall the old hand-maintained dict carried is present
+    for key in ("ingest_pass_s", "resolve_s", "split_s", "observe_s",
+                "solve_s", "realign_s", "apply_split_s", "write_wait_s",
+                "total_s"):
+        assert key in view, key
+    # counters sanity: every read and window accounted for
+    assert snap["counters"][tele.C_READS_INGESTED] == 2048
+    assert snap["counters"][tele.C_WINDOWS_INGESTED] == 4
+    assert snap["counters"][tele.C_PARTS_WRITTEN] >= 1
+    assert snap["counters"][tele.C_BYTES_WRITTEN] > 0
+    # the writer pool's submit-gate gauge saw real depth samples
+    assert snap["gauges"][tele.G_POOL_DEPTH]["n"] >= 2
+    assert snap["gauges"][tele.G_POOL_DEPTH]["max"] >= 1
+    # per-window tokenize spans landed on the ingest thread's track
+    tok = snap["spans"].get(tele.SPAN_TOKENIZE)
+    assert tok and tok["count"] >= 4
+
+
+def test_cli_metrics_json_and_trace_out(tmp_path, capsys):
+    """Acceptance: transform with -print_metrics --metrics-json
+    --trace-out yields a counters/gauges table under the timer table, a
+    JSON snapshot whose per-stage walls match the printed rows, and a
+    Chrome trace with overlapping stage spans on distinct tracks."""
+    from adam_tpu.cli.main import main
+    from make_synth_sam import make_sam
+
+    sam = str(tmp_path / "in.sam")
+    make_sam(sam, 1024, 100)
+    mj = str(tmp_path / "m.json")
+    to = str(tmp_path / "t.json")
+    rc = main([
+        "transform", sam, str(tmp_path / "out.adam"), "-streaming",
+        "-mark_duplicate_reads", "-print_metrics",
+        "--metrics-json", mj, "--trace-out", to,
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Timings" in out and "Counters" in out
+    doc = json.load(open(mj))
+    # the printed timer rows and the JSON timers section are the same
+    # data: every printed (name, count, total) reappears in the JSON
+    lines = out.splitlines()
+    start = lines.index("=======") + 2  # skip header row
+    n_rows = 0
+    for line in lines[start:]:
+        if not line.strip():
+            break
+        m = re.fullmatch(r"(.+?)\s+(\d+)\s+(\d+\.\d{3})", line)
+        assert m, line
+        name, count, total = m.groups()
+        row = doc["timers"][name]
+        assert row["count"] == int(count)
+        assert round(row["total_s"], 3) == float(total)
+        n_rows += 1
+    assert n_rows >= 3
+    assert doc["counters"][tele.C_READS_INGESTED] == 1024
+    # the Chrome trace is loadable and shows the overlap: ingest-thread
+    # tokenize spans and main-thread stage spans on distinct tracks
+    trace = json.load(open(to))
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert tele.SPAN_TOKENIZE in names and tele.SPAN_PASS_A in names
+    tok_tids = {e["tid"] for e in spans if e["name"] == tele.SPAN_TOKENIZE}
+    ing_tids = {e["tid"] for e in spans if e["name"] == tele.SPAN_PASS_A}
+    assert tok_tids and ing_tids and not (tok_tids & ing_tids)
