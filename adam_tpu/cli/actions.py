@@ -370,7 +370,10 @@ class Transform(Command):
             else:
                 from adam_tpu.pipelines.streamed import transform_streamed
 
-                transform_streamed(args.input, args.output, **kw)
+                transform_streamed(
+                    args.input, args.output,
+                    devices=getattr(args, "devices", None), **kw,
+                )
             return 0
 
         with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):

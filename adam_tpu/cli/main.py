@@ -53,6 +53,13 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         "show the streamed tokenize/dispatch/encode/write overlap)",
     )
     parser.add_argument(
+        "--devices", dest="devices", type=int, default=None, metavar="N",
+        help="fan device work out over N attached chips (the streamed "
+        "pipeline round-robins windows across them; default: all "
+        "attached, or ADAM_TPU_DEVICES; N=1 forces the single-device "
+        "path; requests beyond the attached count are capped)",
+    )
+    parser.add_argument(
         "--xprof-dir", dest="xprof_dir", default=None, metavar="DIR",
         help="wrap the command in a jax profiler trace written to DIR "
         "(xprof/TensorBoard view of the device work; reentrant-safe "

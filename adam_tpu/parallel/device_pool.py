@@ -1,0 +1,396 @@
+"""Per-window device pool: round-robin dispatch across attached chips.
+
+The streamed flagship (pipelines/streamed.py) drives its per-residue
+passes as asynchronous device dispatches, but until this module existed
+every dispatch landed on ``jax.devices()[0]`` — one chip did all the
+work while the other attached devices idled (the MULTICHIP dry-runs
+attach 8).  Windows are independent until the two global barriers, so
+their device work can fan out: window *i*'s markdup reductions, observe
+scatter-adds and apply table-gathers run on device ``i % n`` while the
+single host core keeps doing what only it can (tokenize / encode /
+write).
+
+Three pieces:
+
+* :class:`DevicePool` — resolves the device set (``--devices N`` /
+  ``ADAM_TPU_DEVICES``, capped at what is attached), hands out the
+  round-robin device for a window, and places host arrays onto it
+  (``jax.device_put`` commits the inputs, so the jit dispatch follows
+  them to the chip).
+* **Compile prewarm** — :meth:`DevicePool.prewarm` compiles the
+  grid-quantized kernel set once per device, concurrently, *before*
+  the first window's device work.  Cold remote compiles cost 20-40 s
+  each (docs/PERF.md) and the jit executable cache is keyed per
+  device, so without this every chip after the first would pay its
+  compiles inside a timed window.  A process-wide cache dedupes:
+  re-running the pipeline in the same process (the bench's warmup ->
+  timed-run pattern) skips already-warm (kernel, shape, device) triples.
+* **Merge shape** — the pool never merges anything itself: per-device
+  observe histograms and markdup columns flow back through the same
+  compact per-window parts the single-chip path uses, and the merge
+  barriers sum them host-side (``bqsr.merge_observations`` fetches each
+  part from whichever device holds it).  This is the host-side analog
+  of ``parallel/dist.distributed_observe``'s psum — same reduction, no
+  mesh required, bitwise order-stable because parts merge in window
+  order.
+
+The pool is only engaged by the ``device`` backend with ``n > 1``; the
+``n == 1`` case returns ``None`` from :func:`make_pool` and the caller
+keeps its single-device path untouched.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from adam_tpu.utils import telemetry as tele
+
+log = logging.getLogger(__name__)
+
+#: Process-wide prewarm cache: (entry key, device id) triples already
+#: compiled+invoked.  Keyed per device because the jit executable cache
+#: is — warming device 0 does nothing for device 3.
+_PREWARMED: set = set()
+_PREWARM_LOCK = threading.Lock()
+
+
+def reset_prewarm_cache() -> None:
+    """Test hook: forget which (kernel, shape, device) triples are warm."""
+    with _PREWARM_LOCK:
+        _PREWARMED.clear()
+
+
+def resolve_device_count(requested: Optional[int] = None) -> int:
+    """How many devices the streamed pipeline should fan out over.
+
+    Order: explicit ``requested`` (the ``--devices`` flag), then
+    ``ADAM_TPU_DEVICES``, then every attached device.  Always capped at
+    the attached count and floored at 1; a request beyond the topology
+    is capped with a warning, not an error (the same command line must
+    work on an 8-chip pod and a 1-chip dev box).  Only an explicit
+    ``requested < 1`` raises — a malformed env value (non-int, zero,
+    negative) warns and falls back to all attached, the same degradation
+    every other ``ADAM_TPU_*`` tuning var gets: an env typo must not
+    crash a pipeline mid-run.
+    """
+    if requested is not None and requested < 1:
+        raise ValueError(f"--devices must be >= 1 (got {requested})")
+    if requested is None:
+        raw = os.environ.get("ADAM_TPU_DEVICES", "").strip()
+        if raw:
+            try:
+                requested = int(raw)
+            except ValueError:
+                requested = None
+            if requested is not None and requested < 1:
+                requested = None
+            if requested is None:
+                log.warning(
+                    "ADAM_TPU_DEVICES=%r is not a positive int; using all "
+                    "attached devices", raw,
+                )
+    import jax
+
+    try:
+        # local_devices, not devices: in a multi-process run this host
+        # may only address a slice of the global topology, and the pool
+        # must never round-robin onto a chip it cannot drive
+        attached = len(jax.local_devices())
+    except Exception:
+        attached = 1
+    if requested is None:
+        return max(1, attached)
+    if requested > attached:
+        log.warning(
+            "--devices %d requested but only %d attached; using %d",
+            requested, attached, attached,
+        )
+    return max(1, min(requested, attached))
+
+
+def _attr_id(dev):
+    """The span ``device=`` attribution value for one device: its jax
+    id, falling back to ``str(dev)`` — never None, so attribution can't
+    silently drop out of the ``device_spans`` aggregation or the
+    per-chip Chrome-trace tracks on an exotic backend."""
+    dev_id = getattr(dev, "id", None)
+    return dev_id if dev_id is not None else str(dev)
+
+
+def span_attrs(device=None) -> dict:
+    """Span attrs for a dispatch/fetch call site: ``{}`` on the
+    single-device path (no attribution noise), ``{"device": <id>}``
+    otherwise.  The one helper every layer (markdup, bqsr, streamed)
+    shares, so per-chip attribution cannot diverge between passes."""
+    if device is None:
+        return {}
+    return {"device": _attr_id(device)}
+
+
+def putter(device=None):
+    """The host->device placement callable every dispatch site shares:
+    ``jnp.asarray`` (default device, uncommitted — the single-chip
+    behavior) when ``device`` is None, else a committed
+    ``jax.device_put`` onto the given chip so the following jit call
+    dispatches there."""
+    if device is None:
+        import jax.numpy as jnp
+
+        return jnp.asarray
+    import jax
+
+    return lambda x: jax.device_put(x, device)
+
+
+class DevicePool:
+    """Round-robin window -> device placement over an explicit device set.
+
+    ``pool.device(i)`` is the device for window ``i`` (``i % n``);
+    ``pool.put(tree, i)`` commits host arrays onto it so the following
+    jit call dispatches there.  Per-device occupancy/skew reporting
+    comes from the ``device=<id>`` span attribution (the snapshot's
+    ``device_spans`` section), not from pool-side counters.
+    """
+
+    def __init__(self, devices: Optional[Sequence] = None,
+                 limit: Optional[int] = None):
+        import jax
+
+        devs = (
+            list(devices) if devices is not None
+            else list(jax.local_devices())
+        )
+        if limit is not None:
+            devs = devs[: max(1, limit)]
+        if not devs:
+            raise ValueError("DevicePool needs at least one device")
+        self.devices = devs
+
+    @property
+    def n(self) -> int:
+        return len(self.devices)
+
+    def device_index(self, window: int) -> int:
+        return window % len(self.devices)
+
+    def device(self, window: int):
+        return self.devices[window % len(self.devices)]
+
+    def device_id(self, window: int):
+        """The span ``device=<id>`` attribution value for window's
+        device (consistent across every layer via :func:`span_attrs`'s
+        ``_attr_id``; on a single host the ids are the pool ordinals)."""
+        return _attr_id(self.device(window))
+
+    def put(self, tree, window: int):
+        """Commit a pytree of host arrays onto window's device."""
+        import jax
+
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self.device(window)), tree
+        )
+
+    # ---- compile prewarm ----------------------------------------------
+    def prewarm(self, entries: Sequence[tuple], tracer=None) -> int:
+        """Compile the kernel set on every pool device, concurrently.
+
+        ``entries``: ``(key, fn)`` pairs where ``key`` names a
+        (kernel, grid-quantized shape) combination and ``fn(device)``
+        builds dummy device-resident args and invokes the kernel to
+        completion (populating the per-device jit executable cache).
+        Each (key, device) triple compiles **exactly once per process**
+        — the bench's warmup run pays the cold compiles, the timed run's
+        prewarm finds everything warm and is a no-op.  Returns the
+        number of (entry, device) compiles actually performed; spans
+        carry ``device=<k>`` attribution and land in ``tracer`` (the
+        streamed run tracer) so the telemetry snapshot proves the
+        compiles happened outside the timed windows.
+        """
+        tr = tracer if tracer is not None else tele.TRACE
+        todo: list[tuple] = []
+        claimed: set = set()
+        with _PREWARM_LOCK:
+            # claim under the lock so concurrent prewarms don't compile
+            # the same triple twice; a failed compile DISCARDS its claim
+            # below — a transient compile/RPC failure must stay
+            # retryable, or the next run pays the cold compile inside a
+            # timed window with no signal
+            for key, fn in entries:
+                for dev in self.devices:
+                    cache_key = (key, _device_key(dev))
+                    if cache_key not in _PREWARMED and cache_key not in claimed:
+                        claimed.add(cache_key)
+                        todo.append((key, fn, dev, cache_key))
+            _PREWARMED.update(claimed)
+        if not todo:
+            return 0
+
+        def _one(item):
+            key, fn, dev, cache_key = item
+            try:
+                with tr.span(
+                    tele.SPAN_POOL_PREWARM_COMPILE,
+                    device=_attr_id(dev), kernel=str(key[0]),
+                ):
+                    fn(dev)
+            except Exception:
+                # prewarm is purely an optimization: a transient
+                # compile/RPC failure must not abort a run that would
+                # otherwise succeed (the shape just compiles in-window
+                # later).  Discard the claim so a future prewarm retries.
+                with _PREWARM_LOCK:
+                    _PREWARMED.discard(cache_key)
+                log.warning(
+                    "prewarm of %s on device %s failed; the shape will "
+                    "compile at first dispatch instead",
+                    key, _device_key(dev), exc_info=True,
+                )
+                return 0
+            tr.count(tele.C_POOL_PREWARM_COMPILES)
+            return 1
+
+        # one thread per device: the compiles are remote-service RPCs
+        # (GIL released), so n devices' 20-40 s cold compiles overlap
+        # instead of serializing into an n * 30 s stall
+        with ThreadPoolExecutor(max_workers=self.n) as ex:
+            return sum(ex.map(_one, todo))
+
+
+def _device_key(dev) -> str:
+    """Stable per-device cache key (id is unique within a process)."""
+    return f"{getattr(dev, 'platform', '?')}:{getattr(dev, 'id', id(dev))}"
+
+
+def make_pool(requested: Optional[int] = None) -> Optional[DevicePool]:
+    """Resolve the device count and build a pool — or ``None`` for the
+    single-device topologies, so callers fall back to the existing
+    single-chip path with zero behavior change."""
+    n = resolve_device_count(requested)
+    if n <= 1:
+        return None
+    return DevicePool(limit=n)
+
+
+# --------------------------------------------------------------------------
+# Streamed-pipeline kernel set
+# --------------------------------------------------------------------------
+def streamed_prewarm_entries(
+    b, n_rg: int, *, mark_duplicates: bool = True, recalibrate: bool = True,
+) -> list[tuple]:
+    """The grid-quantized kernel set the streamed device path dispatches,
+    as prewarm entries derived from the first window's numpy view ``b``
+    (shapes AND dtypes must match the real dispatches bit-for-bit or the
+    jit cache treats the warm call as a different program).
+
+    Covers: markdup [N, L] key/score reductions (pass A), the BQSR
+    observe scatter-add (pass B), and the apply table-gather (pass C).
+    """
+    import jax
+
+    from adam_tpu.formats import schema
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+
+    g = grid_rows(b.n_rows)
+    gl = grid_cols(b.lmax)
+    gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
+
+    def _z(field, shape, fill=0):
+        dt = np.asarray(field).dtype
+        return np.full(shape, fill, dtype=dt)
+
+    entries: list[tuple] = []
+    if mark_duplicates:
+        def warm_markdup(dev, _z=_z, g=g, gl=gl, gc=gc):
+            from adam_tpu.pipelines.markdup import get_columns_jit
+
+            args = (
+                _z(b.start, (g,), -1), _z(b.end, (g,), -1),
+                _z(b.flags, (g,), schema.FLAG_UNMAPPED),
+                _z(b.cigar_ops, (g, gc), schema.CIGAR_PAD),
+                _z(b.cigar_lens, (g, gc)), _z(b.cigar_n, (g,)),
+                _z(b.quals, (g, gl), schema.QUAL_PAD), _z(b.lengths, (g,)),
+            )
+            out = get_columns_jit()(
+                *(jax.device_put(a, dev) for a in args)
+            )
+            jax.block_until_ready(out)
+
+        entries.append((("markdup.columns", g, gc, gl), warm_markdup))
+
+    if recalibrate:
+        def warm_observe(dev, _z=_z, g=g, gl=gl):
+            from adam_tpu.pipelines.bqsr import observe_kernel
+
+            args = (
+                _z(b.bases, (g, gl), schema.BASE_PAD),
+                _z(b.quals, (g, gl), schema.QUAL_PAD),
+                _z(b.lengths, (g,)),
+                _z(b.flags, (g,), schema.FLAG_UNMAPPED),
+                _z(b.read_group_idx, (g,), -1),
+                np.zeros((g, gl), bool), np.zeros((g, gl), bool),
+                np.zeros((g,), bool),
+            )
+            out = observe_kernel(
+                *(jax.device_put(a, dev) for a in args), n_rg, gl
+            )
+            jax.block_until_ready(out)
+
+        entries.append((("bqsr.observe", g, gl, n_rg), warm_observe))
+        # pass A can only assume the solved table will match window 0's
+        # grid width; pass C re-warms with the REAL merged width via
+        # apply_prewarm_entry (same key space, so uniform-lmax inputs
+        # dedupe it to a no-op)
+        entries.append(_apply_entry(b, n_rg, g, gl, 2 * gl + 1))
+    return entries
+
+
+def _apply_entry(b, n_rg: int, g: int, gl: int, n_cyc: int) -> tuple:
+    import jax
+
+    from adam_tpu.formats import schema
+
+    def _z(field, shape, fill=0):
+        dt = np.asarray(field).dtype
+        return np.full(shape, fill, dtype=dt)
+
+    def warm_apply(dev):
+        from adam_tpu.pipelines.bqsr import (
+            N_DINUC, N_QUAL, apply_table_kernel,
+        )
+
+        args = (
+            _z(b.bases, (g, gl), schema.BASE_PAD),
+            _z(b.quals, (g, gl), schema.QUAL_PAD),
+            _z(b.lengths, (g,)),
+            _z(b.flags, (g,), schema.FLAG_UNMAPPED),
+            _z(b.read_group_idx, (g,), -1),
+            np.zeros((g,), bool), np.zeros((g,), bool),
+            np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8),
+        )
+        out = apply_table_kernel(
+            *(jax.device_put(a, dev) for a in args), gl
+        )
+        jax.block_until_ready(out)
+
+    return (("bqsr.apply", g, gl, n_rg, n_cyc), warm_apply)
+
+
+def apply_prewarm_entry(b, n_rg: int, table_n_cyc: int) -> tuple:
+    """Pass-C re-warm entry: the apply table-gather keyed by the SOLVED
+    table's real cycle width.  ``merge_observations`` widens the table
+    to the maximum window grid, which can exceed the window-0 width the
+    pass-A prewarm assumed — without this, every device would pay the
+    apply compile inside pass C on variable-length inputs.  Shares the
+    pass-A entry's key space, so the uniform-lmax common case dedupes
+    to a no-op against the process-wide cache."""
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+
+    return _apply_entry(
+        b, n_rg, grid_rows(b.n_rows), grid_cols(b.lmax), table_n_cyc
+    )

@@ -303,7 +303,7 @@ class ObservationTable:
 
 def _observe_device(
     ds: AlignmentDataset, known_snps: Optional[SnpTable] = None,
-    backend: Optional[str] = None,
+    backend: Optional[str] = None, device=None,
 ):
     """Run the observation pass -> (total, mism, rg_names, lmax).
 
@@ -319,19 +319,27 @@ def _observe_device(
       back to the device kernel when the toolchain is unavailable.
     * ``numpy`` — :func:`observe_kernel_np`, the pure-host oracle.
 
-    Downstream consumers dispatch on ``isinstance(total, np.ndarray)`` so
-    each path stays on its side of the device link."""
+    ``device``: explicit jax device for the ``device`` backend's
+    scatter-add (the multi-chip pool's round-robin target); ``None``
+    keeps the default device.  Downstream consumers dispatch on
+    ``isinstance(total, np.ndarray)`` so each path stays on its side of
+    the device link."""
     backend = bqsr_backend(backend)
+    from adam_tpu.parallel.device_pool import span_attrs
+
     # span carries the resolved backend so device-vs-host attribution is
     # visible per window in the flight recorder
+    attrs = span_attrs(device)
     with _tele.TRACE.span(
-        _tele.SPAN_BQSR_OBSERVE, backend=backend, reads=int(ds.batch.n_rows)
+        _tele.SPAN_BQSR_OBSERVE, backend=backend,
+        reads=int(ds.batch.n_rows), **attrs,
     ):
-        return _observe_impl(ds, known_snps, backend)
+        return _observe_impl(ds, known_snps, backend, device)
 
 
 def _observe_impl(
-    ds: AlignmentDataset, known_snps: Optional[SnpTable], backend: str
+    ds: AlignmentDataset, known_snps: Optional[SnpTable], backend: str,
+    device=None,
 ):
     b = ds.batch.to_numpy()
     lmax = b.lmax
@@ -433,15 +441,18 @@ def _observe_impl(
                 m2[:, :, off : off + 2 * lmax + 1, :] = mism
                 total, mism = t2, m2
         else:
+            from adam_tpu.parallel.device_pool import putter
+
+            _put = putter(device)
             total, mism = observe_kernel(
-                jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
-                jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
-                jnp.asarray(pad_rows_np(b.lengths, g, 0)),
-                jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-                jnp.asarray(pad_rows_np(b.read_group_idx, g, -1)),
-                jnp.asarray(pad_rows_np(residue_ok, g, False, cols=gl)),
-                jnp.asarray(pad_rows_np(is_mm, g, False, cols=gl)),
-                jnp.asarray(pad_rows_np(read_ok, g, False)),
+                _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
+                _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+                _put(pad_rows_np(b.lengths, g, 0)),
+                _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+                _put(pad_rows_np(b.read_group_idx, g, -1)),
+                _put(pad_rows_np(residue_ok, g, False, cols=gl)),
+                _put(pad_rows_np(is_mm, g, False, cols=gl)),
+                _put(pad_rows_np(read_ok, g, False)),
                 n_rg, gl,
             )
     rg_names = ds.read_groups.names + ["null"]
@@ -726,7 +737,7 @@ def recalibrate_base_qualities(
 
 def apply_recalibration_dispatch(
     ds: AlignmentDataset, phred_table: np.ndarray, gl: int,
-    backend: Optional[str] = None,
+    backend: Optional[str] = None, device=None,
 ):
     """Start the per-residue table application for one window -> opaque
     handle for :func:`apply_recalibration_finish`.
@@ -734,15 +745,24 @@ def apply_recalibration_dispatch(
     On the ``device`` backend this ships the window's [N, L] bases/quals
     and *dispatches* the gather kernel without blocking — the streamed
     pipeline double-buffers: window i's result is fetched (and its part
-    encoded) while window i+1's gather runs on the chip.  The other
-    backends compute eagerly and the handle is just the result."""
+    encoded) while window i+1's gather runs on the chip.  ``device``
+    commits the inputs to an explicit chip (multi-chip round-robin);
+    ``phred_table`` may be a device-resident array (the pool replicates
+    the solved table once per device instead of re-shipping it per
+    window).  The other backends compute eagerly and the handle is just
+    the result."""
     backend = bqsr_backend(backend)
-    with _tele.TRACE.span(_tele.SPAN_BQSR_APPLY_DISPATCH, backend=backend):
-        return _apply_dispatch_impl(ds, phred_table, gl, backend)
+    from adam_tpu.parallel.device_pool import span_attrs
+
+    with _tele.TRACE.span(
+        _tele.SPAN_BQSR_APPLY_DISPATCH, backend=backend,
+        **span_attrs(device),
+    ):
+        return _apply_dispatch_impl(ds, phred_table, gl, backend, device)
 
 
 def _apply_dispatch_impl(
-    ds: AlignmentDataset, phred_table: np.ndarray, gl: int, backend: str
+    ds: AlignmentDataset, phred_table, gl: int, backend: str, device=None
 ):
     b = ds.batch.to_numpy()
     if backend == "device":
@@ -752,15 +772,22 @@ def _apply_dispatch_impl(
         L = b.lmax
         g = grid_rows(n)
         glc = grid_cols(L)
+        from adam_tpu.parallel.device_pool import putter
+
+        _put = putter(device)
+        if isinstance(phred_table, np.ndarray):
+            tbl = _put(np.ascontiguousarray(phred_table, np.uint8))
+        else:
+            tbl = phred_table  # already device-resident (pool-replicated)
         new_dev = apply_table_kernel(
-            jnp.asarray(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=glc)),
-            jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=glc)),
-            jnp.asarray(pad_rows_np(b.lengths, g, 0)),
-            jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-            jnp.asarray(pad_rows_np(b.read_group_idx, g, -1)),
-            jnp.asarray(pad_rows_np(b.has_qual, g, False)),
-            jnp.asarray(pad_rows_np(b.valid, g, False)),
-            jnp.asarray(np.ascontiguousarray(phred_table, np.uint8)),
+            _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=glc)),
+            _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=glc)),
+            _put(pad_rows_np(b.lengths, g, 0)),
+            _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+            _put(pad_rows_np(b.read_group_idx, g, -1)),
+            _put(pad_rows_np(b.has_qual, g, False)),
+            _put(pad_rows_np(b.valid, g, False)),
+            tbl,
             glc,
         )[:n, :L]  # device-side slice: fetch exactly the real rows/lanes
         return ds, b, new_dev

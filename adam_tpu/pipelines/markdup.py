@@ -26,6 +26,8 @@ same shape the sharded path uses: compact per-row summaries travel,
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from adam_tpu.api.datasets import AlignmentDataset
@@ -57,29 +59,47 @@ def markdup_columns_local(
 
 
 _COLUMNS_JIT = None  # lazily-built module-level jit (one compile per shape)
+_COLUMNS_JIT_LOCK = threading.Lock()
 
 
-def markdup_columns_dispatch(batch):
-    """Dispatch the [N, L] markdup reductions on the default device ->
-    lazy (five, score) device arrays for the batch's real rows.
+def get_columns_jit():
+    """The module-level jit of :func:`markdup_columns_local` (built
+    lazily; shared by the dispatch below and the device pool's prewarm
+    so both hit the same executable cache).  Locked: the prewarm calls
+    this from one thread per device, and a lost race here would warm a
+    discarded wrapper whose executable cache the real dispatches never
+    see."""
+    global _COLUMNS_JIT
+    if _COLUMNS_JIT is None:
+        with _COLUMNS_JIT_LOCK:
+            if _COLUMNS_JIT is None:
+                import jax
+
+                _COLUMNS_JIT = jax.jit(markdup_columns_local)
+    return _COLUMNS_JIT
+
+
+def markdup_columns_dispatch(batch, device=None):
+    """Dispatch the [N, L] markdup reductions on a device -> lazy
+    (five, score) device arrays for the batch's real rows.
 
     Row-padded to the pow2 grid so the compile cache sees a handful of
     shapes; the streamed pipeline dispatches window i+1 here while
-    window i's columns are being fetched/summarized (double buffer)."""
-    global _COLUMNS_JIT
-    if _COLUMNS_JIT is None:
-        import jax
-
-        _COLUMNS_JIT = jax.jit(markdup_columns_local)
-
-    import jax.numpy as jnp
+    window i's columns are being fetched/summarized (double buffer).
+    ``device``: an explicit jax device to commit the inputs to (the
+    multi-chip pool's round-robin target); ``None`` keeps the default
+    device, exactly the single-chip behavior."""
+    jit = get_columns_jit()
 
     from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+    from adam_tpu.parallel.device_pool import putter, span_attrs
     from adam_tpu.utils import telemetry as _tele
 
+    _put = putter(device)
+    attrs = span_attrs(device)
     with _tele.TRACE.span(
         _tele.SPAN_MD_COLUMNS, backend="device",
-        reads=int(batch.n_rows),
+        reads=int(batch.n_rows), **attrs,
     ):
         b = batch.to_numpy()
         n = b.n_rows
@@ -90,17 +110,15 @@ def markdup_columns_dispatch(batch):
         # walks mask by lengths/cigar_n, so the padding lanes are inert)
         gl = grid_cols(b.lmax)
         gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
-        five, score = _COLUMNS_JIT(
-            jnp.asarray(pad_rows_np(b.start, g, -1)),
-            jnp.asarray(pad_rows_np(b.end, g, -1)),
-            jnp.asarray(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-            jnp.asarray(
-                pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD, cols=gc)
-            ),
-            jnp.asarray(pad_rows_np(b.cigar_lens, g, 0, cols=gc)),
-            jnp.asarray(pad_rows_np(b.cigar_n, g, 0)),
-            jnp.asarray(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
-            jnp.asarray(pad_rows_np(b.lengths, g, 0)),
+        five, score = jit(
+            _put(pad_rows_np(b.start, g, -1)),
+            _put(pad_rows_np(b.end, g, -1)),
+            _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+            _put(pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD, cols=gc)),
+            _put(pad_rows_np(b.cigar_lens, g, 0, cols=gc)),
+            _put(pad_rows_np(b.cigar_n, g, 0)),
+            _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+            _put(pad_rows_np(b.lengths, g, 0)),
         )
         return five[:n], score[:n]
 

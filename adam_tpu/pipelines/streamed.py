@@ -42,6 +42,19 @@ window's BQSR observe scatter-adds on device and is fetched (compact
 histograms only) at the merge barrier, and pass C gathers recalibrated
 quals on device.
 
+With more than one chip attached the device work additionally fans out
+across a :class:`adam_tpu.parallel.device_pool.DevicePool`: window *i*'s
+markdup reductions, observe scatter-adds and apply table-gathers land on
+device ``i % n`` (``--devices N`` / ``ADAM_TPU_DEVICES`` select; the
+``n == 1`` topology keeps the single-chip path bit-for-bit), each device
+runs a double buffer deep in-flight queue, the solved recalibration
+table is replicated once per device, and the per-device observe
+histograms merge host-side at the barrier in window order — so the
+multi-chip output is bit-identical to the single-chip one.  A compile
+prewarm on the first window compiles the grid-quantized kernel set once
+per device concurrently, so 20-40 s cold remote compiles never land
+inside a window.
+
 Wall-clock goal: max(stage) instead of sum(stages) — host codecs and
 device kernels run at the same time, which is what a TPU-attached host
 should be doing.
@@ -53,6 +66,7 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -139,12 +153,17 @@ def transform_streamed(
     lod_threshold: float | None = None,
     max_target_size: int | None = None,
     dump_observations: Optional[str] = None,
+    devices: Optional[int] = None,
 ) -> dict:
     """Run the flagship transform as a streamed, overlapped pipeline.
 
     Output is a Parquet part-file directory (the reference's Spark
     executor layout); ``adam_tpu.io.context.load_alignments`` reads it
     back as one dataset.  Returns phase wall-times + read count.
+
+    ``devices`` caps the device-pool fan-out (default: every attached
+    device, or ``ADAM_TPU_DEVICES``); only the ``device`` backend uses
+    it, and ``devices=1`` is exactly the single-chip path.
     """
     from adam_tpu.pipelines import bqsr as bqsr_mod
     from adam_tpu.pipelines import markdup as md_mod
@@ -165,6 +184,18 @@ def transform_streamed(
     backend = bqsr_mod.bqsr_backend()
     use_device = backend == "device"
     stats["bqsr_backend"] = backend
+    # multi-chip fan-out: window i's device work round-robins to device
+    # i % n; None means single-device (the pre-pool path, bit-for-bit)
+    dpool = None
+    if use_device:
+        from adam_tpu.parallel import device_pool as dp_mod
+
+        dpool = dp_mod.make_pool(devices)
+    stats["n_devices"] = dpool.n if dpool is not None else (
+        1 if use_device else 0
+    )
+    if use_device:
+        tr.gauge(tele.G_POOL_DEVICES, stats["n_devices"])
     os.makedirs(out_path, exist_ok=True)
     if known_indels is not None and consensus_model == "reads":
         # supplying known indels implies the knowns consensus model (the
@@ -189,7 +220,13 @@ def transform_streamed(
     events = []
     header = None
     n_reads = 0
-    pend_cols = None  # device double buffer: (window ds, lazy (five, score))
+    # device in-flight queue of (window idx, ds, lazy (five, score)):
+    # depth 2 on the single-device path (the classic double buffer);
+    # with a pool, a double buffer PER device (2n) — round-robin keeps
+    # the drain order == window order, so summaries stay window-ordered
+    # and the duplicate resolve is bitwise independent of n
+    md_depth = 2 if dpool is None else 2 * dpool.n
+    pend_cols: deque = deque()
 
     def _summarize(ds, cols):
         if cols is None:
@@ -212,22 +249,52 @@ def transform_streamed(
                 batch, side, header = item
                 ds = AlignmentDataset(batch, side, header)
                 windows.append(ds)
+                win = len(windows) - 1
                 n_reads += int(batch.valid.sum())
                 tr.count(tele.C_WINDOWS_INGESTED)
+                if dpool is not None and win == 0:
+                    # compile the grid-quantized kernel set once per
+                    # device, concurrently, BEFORE any window's device
+                    # work — a 20-40 s cold remote compile must never
+                    # serialize inside a window (process-wide cache:
+                    # warm runs skip this entirely).  The umbrella span
+                    # records the WALL (the concurrent per-compile
+                    # spans sum past it), and the stats view subtracts
+                    # it back out of pass A's row.
+                    from adam_tpu.parallel.device_pool import (
+                        streamed_prewarm_entries,
+                    )
+
+                    t_pw = time.monotonic_ns()
+                    dpool.prewarm(
+                        streamed_prewarm_entries(
+                            batch.to_numpy(), len(ds.read_groups) + 1,
+                            mark_duplicates=mark_duplicates,
+                            recalibrate=recalibrate,
+                        ),
+                        tracer=tr,
+                    )
+                    tr.add_span(
+                        tele.SPAN_POOL_PREWARM, t_pw,
+                        time.monotonic_ns() - t_pw,
+                    )
                 if mark_duplicates:
                     if use_device:
-                        # dispatch window i's [N, L] key/score reductions,
-                        # then summarize window i-1 (its columns had the
-                        # whole previous iteration to compute on the chip)
-                        cols = md_mod.markdup_columns_dispatch(batch)
-                        tr.count(tele.C_DEVICE_DISPATCHED)
-                        tr.gauge(
-                            tele.G_DEVICE_INFLIGHT,
-                            2 if pend_cols is not None else 1,
+                        # dispatch window i's [N, L] key/score reductions
+                        # (on device i % n under a pool), then drain the
+                        # oldest in-flight window once the queue is full
+                        # — its columns had the whole queue depth to
+                        # compute on their chip
+                        cols = md_mod.markdup_columns_dispatch(
+                            batch,
+                            device=None if dpool is None else dpool.device(win),
                         )
-                        if pend_cols is not None:
-                            _summarize(*pend_cols)
-                        pend_cols = (ds, cols)
+                        tr.count(tele.C_DEVICE_DISPATCHED)
+                        pend_cols.append((win, ds, cols))
+                        tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_cols))
+                        if len(pend_cols) >= md_depth:
+                            _old_win, old_ds, old_cols = pend_cols.popleft()
+                            _summarize(old_ds, old_cols)
                     else:
                         _summarize(ds, None)
                 if realign:
@@ -236,9 +303,9 @@ def transform_streamed(
                             batch.to_numpy(), max_indel_size=mis
                         )
                     )
-            if pend_cols is not None:
-                _summarize(*pend_cols)
-                pend_cols = None
+            while pend_cols:
+                _old_win, old_ds, old_cols = pend_cols.popleft()
+                _summarize(old_ds, old_cols)
         except BaseException:
             abort.set()
             raise
@@ -303,8 +370,14 @@ def transform_streamed(
             if recalibrate:
                 for i, w in enumerate(windows):
                     if window_valid[i]:
+                        # round-robin: window i's scatter-add queues on
+                        # device i % n; the per-device histograms are
+                        # compact tables that merge host-side (in window
+                        # order) at the barrier — dist.distributed_observe's
+                        # psum shape, without needing a live mesh
                         total, mism, _rg, g = bqsr_mod._observe_device(
-                            w, known_snps, backend
+                            w, known_snps, backend,
+                            device=None if dpool is None else dpool.device(i),
                         )
                         obs_parts.append((total, mism, g))
                         if use_device:
@@ -331,7 +404,8 @@ def transform_streamed(
         )
         if recalibrate and realigned.batch.n_rows:
             total, mism, _rg, g = bqsr_mod._observe_device(
-                realigned, known_snps, backend
+                realigned, known_snps, backend,
+                device=None if dpool is None else dpool.device(len(windows)),
             )
             obs_parts.append((total, mism, g))
             if use_device:
@@ -407,34 +481,97 @@ def transform_streamed(
         # with them to the pass wall instead of double-counting it
         with tr.span(tele.SPAN_PASS_C):
             if table is not None and use_device:
-                pend = None  # (part idx, dispatched handle)
+                # replicate the solved u8 table once per pool device
+                # (~4 MB each) instead of re-shipping it per window
+                dev_tables = None
+                if dpool is not None:
+                    import jax
+
+                    tbl_c = np.ascontiguousarray(table, np.uint8)
+                    dev_tables = [
+                        jax.device_put(tbl_c, d) for d in dpool.devices
+                    ]
+                    # re-warm the apply gather against the SOLVED
+                    # table's real width: merge_observations can widen
+                    # the table past window 0's grid, which pass A's
+                    # prewarm assumed — uniform-lmax inputs dedupe this
+                    # to a no-op against the process-wide cache.  One
+                    # entry per distinct window grid shape.
+                    from adam_tpu.parallel.device_pool import (
+                        apply_prewarm_entry,
+                    )
+
+                    seen_dims = {}
+                    for item in parts:
+                        bw = item[1].batch
+                        seen_dims.setdefault(
+                            (bw.n_rows, bw.lmax), item[1]
+                        )
+                    t_pwc = time.monotonic_ns()
+                    dpool.prewarm(
+                        [
+                            apply_prewarm_entry(
+                                w.batch.to_numpy(), table.shape[0],
+                                table.shape[2],
+                            )
+                            for w in seen_dims.values()
+                        ],
+                        tracer=tr,
+                    )
+                    # umbrella wall for the re-warm: the stats view
+                    # folds it into prewarm_s and subtracts it from
+                    # apply_split_s, so compile time never shows up as
+                    # host encode/submit time
+                    tr.add_span(
+                        tele.SPAN_POOL_PREWARM_C, t_pwc,
+                        time.monotonic_ns() - t_pwc,
+                    )
+                # in-flight queue of (part idx, handle, slot): depth 2
+                # single-device (the classic double buffer); with a pool
+                # a double buffer per device — window j+1's gather on
+                # chip B runs while window j fetches from chip A
+                apply_depth = 2 if dpool is None else 2 * dpool.n
+                pend_q: deque = deque()
+
+                def _fetch_one():
+                    p_idx, p_handle, p_slot = pend_q.popleft()
+                    attrs = {} if dpool is None else {
+                        "device": dpool.device_id(p_slot)
+                    }
+                    with tr.span(
+                        tele.SPAN_APPLY_FETCH, window=p_idx, **attrs
+                    ):
+                        done = bqsr_mod.apply_recalibration_finish(p_handle)
+                    tr.count(tele.C_DEVICE_FETCHED)
+                    _submit(p_idx, done)
+
                 for j in range(len(parts)):
                     idx, w = parts[j]
                     parts[j] = None  # the list must not pin every window
-                    with tr.span(tele.SPAN_APPLY_DISPATCH, window=idx):
+                    if dpool is None:
+                        dev, tbl = None, table
+                    else:
+                        dev = dpool.device(j)
+                        tbl = dev_tables[dpool.device_index(j)]
+                    attrs = {} if dpool is None else {
+                        "device": dpool.device_id(j)
+                    }
+                    with tr.span(
+                        tele.SPAN_APPLY_DISPATCH, window=idx, **attrs
+                    ):
                         handle = bqsr_mod.apply_recalibration_dispatch(
-                            w, table, gl, backend
+                            w, tbl, gl, backend, device=dev
                         )
                     del w
                     tr.count(tele.C_DEVICE_DISPATCHED)
-                    tr.gauge(
-                        tele.G_DEVICE_INFLIGHT, 2 if pend is not None else 1
-                    )
+                    pend_q.append((idx, handle, j))
+                    tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_q))
                     if idx < len(windows):
                         windows[idx] = None  # free as we go
-                    if pend is not None:
-                        with tr.span(tele.SPAN_APPLY_FETCH, window=pend[0]):
-                            done = bqsr_mod.apply_recalibration_finish(
-                                pend[1]
-                            )
-                        tr.count(tele.C_DEVICE_FETCHED)
-                        _submit(pend[0], done)
-                    pend = (idx, handle)
-                if pend is not None:
-                    with tr.span(tele.SPAN_APPLY_FETCH, window=pend[0]):
-                        done = bqsr_mod.apply_recalibration_finish(pend[1])
-                    tr.count(tele.C_DEVICE_FETCHED)
-                    _submit(pend[0], done)
+                    if len(pend_q) >= apply_depth:
+                        _fetch_one()
+                while pend_q:
+                    _fetch_one()
             else:
                 for j in range(len(parts)):
                     idx, w = parts[j]
@@ -473,6 +610,7 @@ def _finish_trace(tr: tele.Tracer, stats: dict) -> None:
     from adam_tpu.utils import instrumentation as ins
 
     for key, label in (
+        ("prewarm_s", "Streamed Device Prewarm (per-device compiles)"),
         ("ingest_pass_s", "Streamed Pass A (ingest + summaries)"),
         ("md_cols_fetch_s", "Streamed MarkDup Columns (device fetch)"),
         ("resolve_s", "Streamed Barrier (dup resolve + targets)"),

@@ -98,6 +98,19 @@ SPAN_BQSR_APPLY_FETCH = _span("bqsr.apply.fetch")
 SPAN_BQSR_APPLY_HOST = _span("bqsr.apply.host")
 SPAN_MD_COLUMNS = _span("markdup.columns.dispatch")
 
+# ---- device pool (parallel/device_pool.py): multi-chip round-robin
+# dispatch + per-device compile prewarm.  Dispatch/fetch spans carry a
+# ``device=<k>`` attribution (the jax device id), which (a) aggregates
+# into the snapshot's ``device_spans`` section (per-chip occupancy/
+# skew) and (b) mirrors onto a per-chip ``device:<k>`` track in the
+# Chrome-trace export.  The prewarm records one WALL umbrella span per
+# run (concurrent per-compile spans sum past wall, so the derived
+# ``prewarm_s`` comes from the umbrella) plus one compile span per
+# (kernel shape, device). ----
+SPAN_POOL_PREWARM = _span("device.pool.prewarm")
+SPAN_POOL_PREWARM_C = _span("device.pool.prewarm.pass_c")
+SPAN_POOL_PREWARM_COMPILE = _span("device.pool.prewarm.compile")
+
 # ---- io/parquet.py part-writer spans ----
 SPAN_PART_ENCODE = _span("parquet.part.encode")
 SPAN_PART_WRITE = _span("parquet.part.write")
@@ -123,16 +136,20 @@ C_BYTES_ENCODED = _metric("parquet.bytes.encoded")
 C_BYTES_WRITTEN = _metric("parquet.bytes.written")
 C_PARTS_WRITTEN = _metric("parquet.parts.written")
 C_CANDIDATE_ROWS = _metric("realign.candidate_rows")
+C_POOL_PREWARM_COMPILES = _metric("device.pool.prewarm.compiles")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
 G_DEVICE_INFLIGHT = _metric("device.dispatch.in_flight")
 G_OBSERVE_HIDDEN = _metric("streamed.observe_overlap_hidden")
+G_POOL_DEVICES = _metric("device.pool.devices")
 
 #: Device-only metrics: the paired-CPU bench baseline zeroes these
 #: instead of omitting them so round-over-round diffs are key-stable.
-DEVICE_ONLY_COUNTERS = frozenset({C_DEVICE_DISPATCHED, C_DEVICE_FETCHED})
-DEVICE_ONLY_GAUGES = frozenset({G_DEVICE_INFLIGHT})
+DEVICE_ONLY_COUNTERS = frozenset(
+    {C_DEVICE_DISPATCHED, C_DEVICE_FETCHED, C_POOL_PREWARM_COMPILES}
+)
+DEVICE_ONLY_GAUGES = frozenset({G_DEVICE_INFLIGHT, G_POOL_DEVICES})
 
 
 def registered_spans() -> frozenset:
@@ -222,6 +239,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=max(1, capacity))
         self._spans: dict = {}     # name -> [count, total_ns]
+        self._dev_spans: dict = {} # name -> {device key -> [count, total_ns]}
         self._counters: dict = {}  # name -> int
         self._gauges: dict = {}    # name -> {last, min, max, n}
         self._tls = threading.local()
@@ -252,6 +270,7 @@ class Tracer:
             ev["parent"] = parent
         if attrs:
             ev["args"] = dict(attrs)
+        dev = (attrs or {}).get("device")
         with self._lock:
             self._events.append(ev)
             self._n_recorded += 1
@@ -261,6 +280,17 @@ class Tracer:
             else:
                 agg[0] += 1
                 agg[1] += dur
+            if dev is not None:
+                # per-device aggregate: the snapshot's device_spans
+                # section (chip occupancy + skew; time-sliced chips are
+                # NOT symmetric, so per-device walls must be separable)
+                per = self._dev_spans.setdefault(name, {})
+                dagg = per.get(dev)
+                if dagg is None:
+                    per[dev] = [1, dur]
+                else:
+                    dagg[0] += 1
+                    dagg[1] += dur
 
     def count(self, name: str, n: int = 1) -> None:
         if not self.recording:
@@ -306,6 +336,13 @@ class Tracer:
                     k: {"count": v[0], "total_s": v[1] / 1e9}
                     for k, v in self._spans.items()
                 },
+                "device_spans": {
+                    name: {
+                        str(d): {"count": v[0], "total_s": v[1] / 1e9}
+                        for d, v in per.items()
+                    }
+                    for name, per in self._dev_spans.items()
+                },
                 "counters": dict(self._counters),
                 "gauges": {k: dict(v) for k, v in self._gauges.items()},
                 "events_recorded": self._n_recorded,
@@ -318,6 +355,7 @@ class Tracer:
         with self._lock:
             self._events.clear()
             self._spans.clear()
+            self._dev_spans.clear()
             self._counters.clear()
             self._gauges.clear()
             self._n_recorded = 0
@@ -335,6 +373,10 @@ class Tracer:
         with other._lock:
             events = [dict(e) for e in other._events]
             spans = {k: list(v) for k, v in other._spans.items()}
+            dev_spans = {
+                k: {d: list(v) for d, v in per.items()}
+                for k, per in other._dev_spans.items()
+            }
             counters = dict(other._counters)
             gauges = {k: dict(v) for k, v in other._gauges.items()}
             n_rec = other._n_recorded
@@ -348,6 +390,15 @@ class Tracer:
                 else:
                     agg[0] += c
                     agg[1] += ns
+            for k, per in dev_spans.items():
+                mine = self._dev_spans.setdefault(k, {})
+                for d, (c, ns) in per.items():
+                    dagg = mine.get(d)
+                    if dagg is None:
+                        mine[d] = [c, ns]
+                    else:
+                        dagg[0] += c
+                        dagg[1] += ns
             for k, v in counters.items():
                 self._counters[k] = self._counters.get(k, 0) + v
             for k, g in gauges.items():
@@ -388,19 +439,27 @@ class Tracer:
         """Flight recorder -> Chrome trace-event JSON (Perfetto /
         chrome://tracing).  Each recording thread gets its own track, so
         the streamed tokenize/dispatch/fetch/encode/write overlap is
-        visually inspectable."""
+        visually inspectable.  Events carrying a ``device=<k>``
+        attribution (the multi-chip pool's dispatch/fetch/prewarm spans)
+        are additionally mirrored onto a ``device:<k>`` track — one
+        track per chip, so per-device queue occupancy and skew are
+        visible next to the host threads."""
         evs = self.events()
         pid = os.getpid()
         tids: dict = {}
         out = []
-        for e in evs:
-            th = e["thread"]
-            if th not in tids:
-                tids[th] = len(tids) + 1
+
+        def _tid(track: str) -> int:
+            if track not in tids:
+                tids[track] = len(tids) + 1
                 out.append({
-                    "ph": "M", "pid": pid, "tid": tids[th],
-                    "name": "thread_name", "args": {"name": th},
+                    "ph": "M", "pid": pid, "tid": tids[track],
+                    "name": "thread_name", "args": {"name": track},
                 })
+            return tids[track]
+
+        for e in evs:
+            _tid(e["thread"])
         for e in evs:
             ev = {
                 "ph": "X",
@@ -417,6 +476,11 @@ class Tracer:
             if args:
                 ev["args"] = args
             out.append(ev)
+            dev = (e.get("args") or {}).get("device")
+            if dev is not None:
+                mirror = dict(ev)
+                mirror["tid"] = _tid(f"device:{dev}")
+                out.append(mirror)
         return {"traceEvents": out, "displayTimeUnit": "ms"}
 
     def dump_json(self, path: str, timers=None,
@@ -484,7 +548,8 @@ def streamed_stats_view(snap: dict) -> dict:
 
     out = {}
     for key, name in (
-        ("ingest_pass_s", SPAN_PASS_A),
+        ("prewarm_s", SPAN_POOL_PREWARM),
+        ("ingest_pass_s", SPAN_PASS_A),  # prewarm subtracted below
         ("md_cols_fetch_s", SPAN_MD_FETCH),
         ("resolve_s", SPAN_RESOLVE),
         ("split_s", SPAN_SPLIT),
@@ -499,6 +564,20 @@ def streamed_stats_view(snap: dict) -> dict:
         v = s(name)
         if v is not None:
             out[key] = v
+    if "prewarm_s" in out and "ingest_pass_s" in out:
+        # the prewarm umbrella is nested inside pass A (it fires on the
+        # first ingested window): subtract it so the stage rows stay
+        # disjoint and sum to the pipeline wall
+        out["ingest_pass_s"] = max(
+            0.0, out["ingest_pass_s"] - out["prewarm_s"]
+        )
+    # the pass-C re-warm (the solved table's real width) is nested
+    # inside pass C: fold its wall into prewarm_s for the headline, and
+    # remember it for the apply_split subtraction below — real compile
+    # time must never masquerade as host encode/submit time
+    prewarm_c = s(SPAN_POOL_PREWARM_C)
+    if prewarm_c is not None:
+        out["prewarm_s"] = out.get("prewarm_s", 0.0) + prewarm_c
     tail = s(SPAN_TAIL)
     if tail is not None:
         obs = s(SPAN_OBSERVE) or 0.0
@@ -517,12 +596,14 @@ def streamed_stats_view(snap: dict) -> dict:
             out["realign_s"] = max(0.0, tail - obs)
     pass_c = s(SPAN_PASS_C)
     if pass_c is not None:
-        # host share of pass C: the device dispatch/fetch walls are
-        # their own disjoint rows
-        out["apply_split_s"] = (
+        # host share of pass C: the device dispatch/fetch walls (and
+        # any pass-C re-warm compiles) are their own disjoint rows
+        out["apply_split_s"] = max(
+            0.0,
             pass_c
             - (s(SPAN_APPLY_DISPATCH) or 0.0)
             - (s(SPAN_APPLY_FETCH) or 0.0)
+            - (prewarm_c or 0.0),
         )
     return out
 
@@ -538,6 +619,7 @@ def key_stable_snapshot(tr: Tracer | None = None) -> dict:
         snap["gauges"].setdefault(
             name, {"last": 0, "min": 0, "max": 0, "n": 0}
         )
+    snap.setdefault("device_spans", {})
     return snap
 
 

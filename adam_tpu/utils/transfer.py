@@ -9,12 +9,30 @@ the chunking is harmless (PCIe/DMA is far faster than any of this).
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 _MIN_CHUNK_BYTES = 8 * 1024 * 1024
-_MAX_THREADS = 8
+
+
+def _max_threads() -> int:
+    """Fetch-pool thread cap: bounded by the cores this process may
+    actually run on.  The hosted environment schedules ONE core
+    (``os.sched_getaffinity(0) == {0}``); the old fixed cap of 8 made
+    every large fetch spin up 8 threads that competed with the
+    PartWriterPool's encode threads for that single core — transfer RPCs
+    release the GIL, but chunk reassembly and executor bookkeeping do
+    not."""
+    try:
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux fallback
+        n = os.cpu_count() or 1
+    return max(1, min(8, n))
+
+
+_MAX_THREADS = _max_threads()
 
 
 def device_fetch(x, threads: int = _MAX_THREADS) -> np.ndarray:

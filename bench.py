@@ -146,15 +146,21 @@ def _warmup_compiles(known) -> None:
 
     _realign.warm_sweep_shapes()
     with tempfile.TemporaryDirectory() as td:
+        # same device fan-out as the timed run: the warmup pays the
+        # per-device prewarm compiles so the timed windows never do
         transform_streamed(
-            small, os.path.join(td, "w.adam"), known_snps=known
+            small, os.path.join(td, "w.adam"), known_snps=known,
+            devices=_DEVICES,
         )
 
 
-def _matmul_probe(reps: int = 10) -> float:
+def _matmul_probe(reps: int = 10, device=None) -> float:
     """Sustained bf16 matmul TFLOP/s right now — the granted-compute
     context recorded next to every timed window (the chip is
-    time-sliced; a number without its window's grant is not evidence)."""
+    time-sliced; a number without its window's grant is not evidence).
+    ``device`` probes an explicit chip (the multi-chip per-device leg:
+    time-sliced chips are NOT symmetric, so each pool device gets its
+    own number)."""
     import time
 
     import jax
@@ -165,6 +171,9 @@ def _matmul_probe(reps: int = 10) -> float:
         rng = np.random.default_rng(0)
         a = jnp.asarray(rng.standard_normal((4096, 4096)), jnp.bfloat16)
         bm = jnp.asarray(rng.standard_normal((4096, 4096)), jnp.bfloat16)
+        if device is not None:
+            a = jax.device_put(a, device)
+            bm = jax.device_put(bm, device)
 
         @jax.jit
         def loop(a0):
@@ -179,6 +188,52 @@ def _matmul_probe(reps: int = 10) -> float:
         return round(2 * 4096 ** 3 / dt / 1e12, 1)
     except Exception:
         return float("nan")
+
+
+#: --devices passthrough (None = all attached / ADAM_TPU_DEVICES).
+_DEVICES = None
+
+#: Zero-filled device leg: the CPU baseline records the SAME keys with
+#: empty/zero values so round-over-round artifact diffs stay key-stable.
+_NO_DEVICES = {
+    "n_devices": 0,
+    "devices_used": [],
+    "per_device_probe_tflops": [],
+    "error": None,
+}
+
+
+def _device_info(probe: bool = True) -> dict:
+    """The chip leg's device context: how many chips the pool fans out
+    over, which ones, and each one's same-window matmul probe (the
+    chips are time-sliced independently — per-device grant skew is
+    evidence, not noise).  On failure the zeros carry the error string
+    (key-stable either way): a multi-chip run must never silently
+    self-report as device-less."""
+    try:
+        import jax
+
+        from adam_tpu.parallel.device_pool import resolve_device_count
+
+        n = resolve_device_count(_DEVICES)
+        # local_devices to match DevicePool: in a multi-process run
+        # jax.devices() lists chips this host cannot probe
+        devs = list(jax.local_devices())[:n]
+        return {
+            "n_devices": n,
+            "devices_used": [int(getattr(d, "id", i))
+                             for i, d in enumerate(devs)],
+            "per_device_probe_tflops": [
+                _matmul_probe(device=d) if probe else float("nan")
+                for d in devs
+            ],
+            "error": None,
+        }
+    except Exception as e:
+        print(f"bench: device-info probe failed: {e!r}", file=sys.stderr)
+        out = dict(_NO_DEVICES)
+        out["error"] = repr(e)
+        return out
 
 
 def _denan(o):
@@ -263,7 +318,8 @@ def _run_streamed(known, trials: int = 1, probe: bool = True) -> dict:
         try:
             with tempfile.TemporaryDirectory() as td:
                 stats = transform_streamed(
-                    _SYNTH, os.path.join(td, "out.adam"), known_snps=known
+                    _SYNTH, os.path.join(td, "out.adam"), known_snps=known,
+                    devices=_DEVICES,
                 )
         finally:
             tele.TRACE.recording = was_recording
@@ -334,6 +390,8 @@ def _cpu_child() -> None:
     # no matmul probe in the CPU child: a 4096^3 bf16 loop takes ~45s
     # on the single host core and would dwarf the measurement
     stats = _run_streamed(known, trials=2, probe=False)
+    # key-stable device leg: zeros, not omission (see _NO_DEVICES)
+    stats["devices"] = dict(_NO_DEVICES)
     print(json.dumps(stats))
 
 
@@ -458,12 +516,12 @@ known = GenotypeDataset.load(%(known)r, contig_names=names).snp_table()
 t0 = time.perf_counter()
 with tempfile.TemporaryDirectory() as td:
     transform_streamed(%(path)r, os.path.join(td, "out.adam"),
-                       known_snps=known)
+                       known_snps=known, devices=%(devices)r)
 wall = time.perf_counter() - t0
 rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 print(json.dumps({"reads_4m_s": round(wall, 1),
                   "peak_rss_gb": round(rss, 2)}))
-""" % {"repo": _REPO, "path": path, "known": known}
+""" % {"repo": _REPO, "path": path, "known": known, "devices": _DEVICES}
     try:
         proc = subprocess.run(
             [sys.executable, "-c", child], capture_output=True, text=True,
@@ -610,10 +668,20 @@ def main() -> None:
         "cfg4_realign_derived_rps": _cfg("realign_s"),
     }
     scale4m = _scale_4m(time.perf_counter() - t_bench0)
+    # per-device probes AFTER the timed windows (probing 8 chips inside
+    # the measurement region would perturb it); chips are time-sliced
+    # independently, so the per-device spread is the skew context for
+    # the pool's round-robin dispatch
+    dev_info = _device_info()
     print(
         json.dumps(
             _denan({
                 "metric": "secondary",
+                "devices": {
+                    "chip": dev_info,
+                    "cpu_baseline": cpu_stats.get("devices")
+                    or dict(_NO_DEVICES),
+                },
                 "sw": sw_info,
                 "kmers_per_sec": round(kps, 1),
                 "cpu_baseline_reads_per_sec": round(cpu_rps, 1),
@@ -650,8 +718,32 @@ def main() -> None:
     )
 
 
+def _parse_devices_arg(argv: list) -> None:
+    """Consume ``--devices N`` / ``--devices=N`` from argv (sets the
+    module-level passthrough).  A missing or non-integer value is a
+    usage error, not a crash — and never a silent fall-through to
+    all-attached, which would mislabel the artifact."""
+    global _DEVICES
+    for i, a in enumerate(list(argv)):
+        if a == "--devices" or a.startswith("--devices="):
+            if a == "--devices":
+                val = argv[i + 1] if i + 1 < len(argv) else None
+                span = 2
+            else:
+                val = a.split("=", 1)[1]
+                span = 1
+            try:
+                _DEVICES = int(val)
+            except (TypeError, ValueError):
+                sys.exit(f"bench.py: --devices needs an integer (got {val!r})")
+            del argv[i : i + span]
+            return
+
+
 if __name__ == "__main__":
-    if len(sys.argv) >= 2 and sys.argv[1] == "--cpu-child":
+    argv = sys.argv[1:]
+    _parse_devices_arg(argv)
+    if argv and argv[0] == "--cpu-child":
         _cpu_child()
         sys.exit(0)
     main()
