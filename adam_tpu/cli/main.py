@@ -60,6 +60,13 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         "path; requests beyond the attached count are capped)",
     )
     parser.add_argument(
+        "--fault-spec", dest="fault_spec", default=None, metavar="SPEC",
+        help="arm deterministic fault injection at named pipeline "
+        "points (testing/CI only; e.g. 'device.dispatch=transient,"
+        "every=3' — grammar in docs/ROBUSTNESS.md; also honored from "
+        "ADAM_TPU_FAULTS)",
+    )
+    parser.add_argument(
         "--xprof-dir", dest="xprof_dir", default=None, metavar="DIR",
         help="wrap the command in a jax profiler trace written to DIR "
         "(xprof/TensorBoard view of the device work; reentrant-safe "
@@ -150,6 +157,14 @@ def main(argv=None) -> int:
     )
     ins.TIMERS.recording = want_metrics
     tele.TRACE.recording = want_metrics
+    if args.fault_spec:
+        from adam_tpu.utils import faults
+
+        try:
+            faults.install(args.fault_spec)
+        except ValueError as e:
+            print(f"--fault-spec: {e}", file=sys.stderr)
+            return 2
     xprof = (
         ins.device_trace(args.xprof_dir) if args.xprof_dir
         else contextlib.nullcontext()

@@ -14,6 +14,7 @@ reference gives to sidecar Avro files / header merging.
 from __future__ import annotations
 
 import json
+import os
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -37,6 +38,50 @@ from adam_tpu.models.dictionaries import (
     SequenceDictionary,
     SequenceRecord,
 )
+
+#: Staging subdirectory for in-progress part writes (crash consistency,
+#: docs/ROBUSTNESS.md): every Parquet write lands under
+#: ``<dir>/_temporary/`` and is PUBLISHED by an atomic ``os.replace``,
+#: so readers never observe a torn file.  The ``_`` prefix matters —
+#: pyarrow dataset discovery ignores underscore-prefixed entries (the
+#: Hadoop ``_temporary``/``_SUCCESS`` convention), so a crash's leftover
+#: staging files are invisible to every loader; the streamed pipeline
+#: purges the stale dir on its next run.
+TMP_DIR_NAME = "_temporary"
+
+
+def purge_stale_staging(out_dir: str) -> None:
+    """Remove a previous (crashed) run's staging dir under ``out_dir``.
+
+    Pipelines that own an output directory call this ONCE at startup,
+    before any writer is live — a SIGKILL'd run leaves its torn files
+    only in here, and a leftover file would keep the opportunistic
+    per-write rmdir failing (ENOTEMPTY) forever.  Never call this with
+    writers in flight: live claim files look identical to stale ones.
+    """
+    stale = os.path.join(out_dir, TMP_DIR_NAME)
+    if os.path.isdir(stale):
+        import logging
+        import shutil
+
+        logging.getLogger(__name__).warning(
+            "removing stale staging dir %s (a previous run died "
+            "mid-write)", stale,
+        )
+        shutil.rmtree(stale, ignore_errors=True)
+
+
+def _staging_path(path: str) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    tmp_dir = os.path.join(d, TMP_DIR_NAME)
+    try:
+        # single-level mkdir, NOT makedirs: a missing parent directory
+        # must stay the error it always was, not get silently created
+        os.mkdir(tmp_dir)
+    except FileExistsError:
+        pass
+    return os.path.join(tmp_dir, os.path.basename(path) + ".tmp")
+
 
 def parquet_codec_kw(compression: str) -> dict:
     """Writer kwargs for a codec name — ONE place pins zstd at level 1
@@ -233,23 +278,56 @@ def to_arrow_alignments(
 
 
 def _write_encoded(table: "pa.Table", path: str, compression: str) -> None:
-    import os
-
+    from adam_tpu.utils import faults
     from adam_tpu.utils import instrumentation as ins
     from adam_tpu.utils import telemetry as tele
 
+    tmp = _staging_path(path)
     with ins.TIMERS.time(ins.PARQUET_WRITE), tele.TRACE.span(
         tele.SPAN_PART_WRITE, path=os.path.basename(path)
     ):
-        # dictionary-encode only the low-cardinality name columns:
-        # letting the writer attempt dictionaries on the mostly-unique
-        # readName/sequence/qual columns builds dicts it then abandons
-        # (~20% of write time on a WGS-shaped part)
-        pq.write_table(
-            table, path,
-            use_dictionary=["contig", "mateContig", "recordGroupName"],
-            **parquet_codec_kw(compression),
-        )
+        faults.point("parquet.write")
+
+        def write_to(tmp_path_):
+            # dictionary-encode only the low-cardinality name columns:
+            # letting the writer attempt dictionaries on the mostly-
+            # unique readName/sequence/qual columns builds dicts it
+            # then abandons (~20% of write time on a WGS-shaped part)
+            pq.write_table(
+                table, tmp_path_,
+                use_dictionary=["contig", "mateContig", "recordGroupName"],
+                **parquet_codec_kw(compression),
+            )
+
+        # claim the staging slot with an empty file FIRST: concurrent
+        # writers share the staging dir (the sharded executor's thread
+        # pool), and a sibling's opportunistic rmdir below can delete
+        # it between our mkdir and our file create — but a non-empty
+        # dir is rmdir-proof (ENOTEMPTY), so once the claim lands the
+        # real write below cannot lose the race
+        while True:
+            try:
+                with open(tmp, "wb"):
+                    pass
+                break
+            except FileNotFoundError:
+                tmp = _staging_path(path)
+        try:
+            write_to(tmp)
+            # publish: readers either see the complete part or nothing
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    try:
+        # opportunistic: drop the staging dir once it empties (fails
+        # with ENOTEMPTY while sibling parts are still in flight)
+        os.rmdir(os.path.dirname(tmp))
+    except OSError:
+        pass
     if tele.TRACE.recording:
         tele.TRACE.count(tele.C_PARTS_WRITTEN)
         try:
@@ -307,6 +385,25 @@ class PartWriterPool:
         # toggling recording mid-run cannot skew the samples
         self._depth = 0
         self._depth_lock = threading.Lock()
+        # first worker failure, chronologically (encode OR write): the
+        # original exception object, so close() re-raises it with its
+        # traceback intact and submit() can fail fast instead of
+        # queueing parts behind a dead writer
+        self._failed: BaseException | None = None
+        self._fail_lock = threading.Lock()
+        self._staging_dirs: set = set()
+
+    def _record_failure(self, e: BaseException) -> None:
+        with self._fail_lock:
+            if self._failed is None:
+                self._failed = e
+
+    @property
+    def failed(self) -> BaseException | None:
+        """The first worker failure so far, or None (a producer can
+        poll this between submits to abort a doomed run early)."""
+        with self._fail_lock:
+            return self._failed
 
     def _sample_depth(self, delta: int) -> None:
         from adam_tpu.utils import telemetry as tele
@@ -318,8 +415,23 @@ class PartWriterPool:
 
     def submit(self, path: str, batch: ReadBatch, side: ReadSidecar,
                header: SamHeader) -> None:
+        from adam_tpu.utils import faults
         from adam_tpu.utils import instrumentation as ins
         from adam_tpu.utils import telemetry as tele
+
+        # fail fast: once any worker failed there is no point queueing
+        # (and gating on) further parts — surface the doomed run's first
+        # error to the producer NOW, with its original context chained
+        first = self.failed
+        if first is not None:
+            raise RuntimeError(
+                "PartWriterPool worker already failed; aborting submit "
+                f"of {path}"
+            ) from first
+        self._staging_dirs.add(
+            os.path.join(os.path.dirname(os.path.abspath(path)),
+                         TMP_DIR_NAME)
+        )
 
         def release():
             # decrement BEFORE releasing the gate: a submitter unblocked
@@ -330,6 +442,7 @@ class PartWriterPool:
 
         def encode():
             try:
+                faults.point("parquet.encode")
                 with ins.TIMERS.time(ins.PARQUET_ENCODE), tele.TRACE.span(
                     tele.SPAN_PART_ENCODE, rows=int(batch.n_rows)
                 ):
@@ -339,13 +452,20 @@ class PartWriterPool:
                         tele.C_BYTES_ENCODED, int(table.nbytes)
                     )
                 return self._io.submit(write, table)
-            except BaseException:
+            except BaseException as e:
+                # the gate MUST release on the error path: the producer
+                # may be blocked in submit() on a full gate, and an
+                # un-released slot would deadlock the abort
+                self._record_failure(e)
                 release()
                 raise
 
         def write(table):
             try:
                 _write_encoded(table, path, self._compression)
+            except BaseException as e:
+                self._record_failure(e)
+                raise
             finally:
                 release()
 
@@ -357,8 +477,29 @@ class PartWriterPool:
             release()
             raise
 
-    def close(self) -> None:
-        """Drain both stages; re-raise the first error (encode or write)."""
+    def _discard_staging(self) -> None:
+        """Remove any unpublished staging files (abort/error path);
+        published parts are untouched — the atomic-rename protocol
+        means there is nothing half-written outside the staging dir."""
+        for d in self._staging_dirs:
+            try:
+                for name in os.listdir(d):
+                    if name.endswith(".tmp"):
+                        try:
+                            os.unlink(os.path.join(d, name))
+                        except OSError:
+                            pass
+                os.rmdir(d)
+            except OSError:
+                pass
+
+    def close(self, abort: bool = False) -> None:
+        """Drain both stages; re-raise the first worker error — the
+        original exception object, so its traceback survives (close is
+        the producer's only window onto the worker threads' failures).
+        ``abort=True``: the producer is already unwinding from its own
+        error — drain, clean the staging files, and swallow nothing
+        into its traceback (the caller re-raises its own)."""
         errs = []
         for f in self._futures:
             try:
@@ -371,8 +512,13 @@ class PartWriterPool:
                 errs.append(err)
         self._enc.shutdown()
         self._io.shutdown()
-        if errs:
-            raise errs[0]
+        first = self.failed
+        if first is None and errs:
+            first = errs[0]
+        if abort or first is not None:
+            self._discard_staging()
+        if first is not None and not abort:
+            raise first
 
 
 def load_alignments(

@@ -49,9 +49,17 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from adam_tpu.utils import faults
+from adam_tpu.utils import retry as retry_mod
 from adam_tpu.utils import telemetry as tele
 
 log = logging.getLogger(__name__)
+
+
+class AllDevicesEvicted(RuntimeError):
+    """Every pool device has been evicted; callers fall back to the
+    ``native``/``numpy`` host backend (bit-identical by the backend
+    parity contract, tests/test_backend_parity.py)."""
 
 #: Process-wide prewarm cache: (entry key, device id) triples already
 #: compiled+invoked.  Keyed per device because the jit executable cache
@@ -171,16 +179,65 @@ class DevicePool:
         if not devs:
             raise ValueError("DevicePool needs at least one device")
         self.devices = devs
+        # eviction state: self.devices stays the full original set (so
+        # per-device replicas like pass C's dev_tables keep stable
+        # indices); round-robin placement runs over the survivors
+        self._dead: set = set()
+        self._evict_lock = threading.Lock()
 
     @property
     def n(self) -> int:
+        """The configured fan-out (evictions do not shrink it — it is
+        the stats/queue-depth constant, not the live device count)."""
         return len(self.devices)
 
+    # ---- eviction -----------------------------------------------------
+    def alive_devices(self) -> list:
+        with self._evict_lock:
+            return [
+                d for d in self.devices if _device_key(d) not in self._dead
+            ]
+
+    def evict(self, device, reason: str = "", tracer=None) -> bool:
+        """Remove a failed device from round-robin placement.
+
+        Returns True when this call actually evicted (False: already
+        dead, or ``device`` is None — the single-chip default-device
+        path has nothing to evict).  Counts ``device.evicted`` on
+        ``tracer`` (the streamed run tracer, so the count lands in the
+        ``--metrics-json`` snapshot) or the global TRACE.
+        """
+        if device is None:
+            return False
+        key = _device_key(device)
+        with self._evict_lock:
+            if key in self._dead:
+                return False
+            self._dead.add(key)
+            left = len(self.devices) - len(self._dead)
+        log.error(
+            "evicting device %s after spent retry budget%s; %d of %d "
+            "pool device(s) remain%s", key,
+            f" ({reason})" if reason else "", left, len(self.devices),
+            "" if left else " — falling back to the host backend",
+        )
+        (tracer if tracer is not None else tele.TRACE).count(
+            tele.C_DEVICE_EVICTED
+        )
+        return True
+
     def device_index(self, window: int) -> int:
-        return window % len(self.devices)
+        """Index of window's device in the ORIGINAL pool order (stable
+        under eviction — per-device replicas are keyed by it)."""
+        return self.devices.index(self.device(window))
 
     def device(self, window: int):
-        return self.devices[window % len(self.devices)]
+        alive = self.alive_devices()
+        if not alive:
+            raise AllDevicesEvicted(
+                f"all {len(self.devices)} pool devices evicted"
+            )
+        return alive[window % len(alive)]
 
     def device_id(self, window: int):
         """The span ``device=<id>`` attribution value for window's
@@ -220,9 +277,11 @@ class DevicePool:
             # the same triple twice; a failed compile DISCARDS its claim
             # below — a transient compile/RPC failure must stay
             # retryable, or the next run pays the cold compile inside a
-            # timed window with no signal
+            # timed window with no signal.  Evicted devices are skipped:
+            # replayed windows re-prewarm on survivors via the same
+            # process-wide cache (already-warm triples dedupe to no-ops).
             for key, fn in entries:
-                for dev in self.devices:
+                for dev in self.alive_devices():
                     cache_key = (key, _device_key(dev))
                     if cache_key not in _PREWARMED and cache_key not in claimed:
                         claimed.add(cache_key)
@@ -233,12 +292,22 @@ class DevicePool:
 
         def _one(item):
             key, fn, dev, cache_key = item
+
+            def compile_once():
+                faults.point("pool.prewarm", device=dev)
+                fn(dev)
+
             try:
                 with tr.span(
                     tele.SPAN_POOL_PREWARM_COMPILE,
                     device=_attr_id(dev), kernel=str(key[0]),
                 ):
-                    fn(dev)
+                    # transient compile/RPC failures retry in place
+                    # (exponential backoff) before degrading to the
+                    # warn-and-compile-in-window fallback below
+                    retry_mod.retry_call(
+                        compile_once, site="device.pool.prewarm"
+                    )
             except Exception:
                 # prewarm is purely an optimization: a transient
                 # compile/RPC failure must not abort a run that would

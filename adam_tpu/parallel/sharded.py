@@ -80,6 +80,12 @@ def transform_sharded(
     t_start = time.perf_counter()
     stats: dict = {}
     os.makedirs(out_path, exist_ok=True)
+    # same crash-consistency contract as the streamed pipeline: part
+    # writes stage under out_path/_temporary and a crashed run's
+    # leftovers purge here, before any writer is live
+    from adam_tpu.io.parquet import purge_stale_staging
+
+    purge_stale_staging(out_path)
     tmp = shuffle_dir or tempfile.mkdtemp(prefix="adam_tpu_shards_")
     own_tmp = shuffle_dir is None
     if known_indels is not None and consensus_model == "reads":

@@ -442,18 +442,30 @@ def _observe_impl(
                 total, mism = t2, m2
         else:
             from adam_tpu.parallel.device_pool import putter
+            from adam_tpu.utils import faults
+            from adam_tpu.utils import retry as _retry
 
             _put = putter(device)
-            total, mism = observe_kernel(
-                _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
-                _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
-                _put(pad_rows_np(b.lengths, g, 0)),
-                _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-                _put(pad_rows_np(b.read_group_idx, g, -1)),
-                _put(pad_rows_np(residue_ok, g, False, cols=gl)),
-                _put(pad_rows_np(is_mm, g, False, cols=gl)),
-                _put(pad_rows_np(read_ok, g, False)),
-                n_rg, gl,
+
+            def dispatch():
+                # ship + scatter-add as one retryable unit: the commit
+                # and the jit dispatch are the RPCs that drop on a
+                # tunneled chip, and re-running them is idempotent
+                faults.point("device.dispatch", device=device)
+                return observe_kernel(
+                    _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
+                    _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+                    _put(pad_rows_np(b.lengths, g, 0)),
+                    _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+                    _put(pad_rows_np(b.read_group_idx, g, -1)),
+                    _put(pad_rows_np(residue_ok, g, False, cols=gl)),
+                    _put(pad_rows_np(is_mm, g, False, cols=gl)),
+                    _put(pad_rows_np(read_ok, g, False)),
+                    n_rg, gl,
+                )
+
+            total, mism = _retry.retry_call(
+                dispatch, site="bqsr.observe.dispatch"
             )
     rg_names = ds.read_groups.names + ["null"]
     # visit accounting (BaseQualityRecalibration.scala:99-123's logging)
@@ -672,7 +684,7 @@ def apply_table_kernel(
     return jnp.where(apply_mask, new_q, quals).astype(jnp.uint8)
 
 
-def merge_observations(parts: list[tuple]) -> tuple:
+def merge_observations(parts: list[tuple], replays=None) -> tuple:
     """Sum per-window (total, mism, gl) histograms into one global
     (total, mism, gl) — the host-side analog of the sharded psum.
 
@@ -681,6 +693,14 @@ def merge_observations(parts: list[tuple]) -> tuple:
     window's table.  Device-resident parts (the lazy ``device`` observe
     backend) are fetched here, at the barrier, via the chunked transfer
     helper — each is a compact [n_rg, 94, 2g+1, 17] table, never [N, L].
+
+    ``replays``: optional per-part recovery hooks (parallel list; None
+    entries = no hook).  When a part's fetch still fails after the
+    transfer layer's retry budget, ``replays[k](exc)`` must return a
+    replacement host-resident ``(total, mism, g)`` — the streamed
+    pipeline's hook evicts the failed device and recomputes the window
+    on a survivor or the host backend, so a dead chip costs one window
+    replay instead of the whole run.
     """
     from adam_tpu.utils.transfer import device_fetch
 
@@ -690,10 +710,20 @@ def merge_observations(parts: list[tuple]) -> tuple:
     shape = (s0[0], s0[1], n_cyc, s0[3])
     total = np.zeros(shape, np.int64)
     mism = np.zeros(shape, np.int64)
-    for t, m, g in parts:
+    for k, (t, m, g) in enumerate(parts):
+        try:
+            tt = device_fetch(t)
+            mm = device_fetch(m)
+        except Exception as e:
+            replay = replays[k] if replays is not None else None
+            if replay is None:
+                raise
+            tt, mm, g = replay(e)
+            tt = np.asarray(tt)
+            mm = np.asarray(mm)
         off = gl - g
-        total[:, :, off : off + 2 * g + 1, :] += device_fetch(t)
-        mism[:, :, off : off + 2 * g + 1, :] += device_fetch(m)
+        total[:, :, off : off + 2 * g + 1, :] += tt
+        mism[:, :, off : off + 2 * g + 1, :] += mm
     return total, mism, gl
 
 
@@ -773,23 +803,30 @@ def _apply_dispatch_impl(
         g = grid_rows(n)
         glc = grid_cols(L)
         from adam_tpu.parallel.device_pool import putter
+        from adam_tpu.utils import faults
+        from adam_tpu.utils import retry as _retry
 
         _put = putter(device)
-        if isinstance(phred_table, np.ndarray):
-            tbl = _put(np.ascontiguousarray(phred_table, np.uint8))
-        else:
-            tbl = phred_table  # already device-resident (pool-replicated)
-        new_dev = apply_table_kernel(
-            _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=glc)),
-            _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=glc)),
-            _put(pad_rows_np(b.lengths, g, 0)),
-            _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-            _put(pad_rows_np(b.read_group_idx, g, -1)),
-            _put(pad_rows_np(b.has_qual, g, False)),
-            _put(pad_rows_np(b.valid, g, False)),
-            tbl,
-            glc,
-        )[:n, :L]  # device-side slice: fetch exactly the real rows/lanes
+
+        def dispatch():
+            faults.point("device.dispatch", device=device)
+            if isinstance(phred_table, np.ndarray):
+                tbl = _put(np.ascontiguousarray(phred_table, np.uint8))
+            else:
+                tbl = phred_table  # device-resident (pool-replicated)
+            return apply_table_kernel(
+                _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=glc)),
+                _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=glc)),
+                _put(pad_rows_np(b.lengths, g, 0)),
+                _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+                _put(pad_rows_np(b.read_group_idx, g, -1)),
+                _put(pad_rows_np(b.has_qual, g, False)),
+                _put(pad_rows_np(b.valid, g, False)),
+                tbl,
+                glc,
+            )[:n, :L]  # device-side slice: fetch only real rows/lanes
+
+        new_dev = _retry.retry_call(dispatch, site="bqsr.apply.dispatch")
         return ds, b, new_dev
     from adam_tpu import native
 
@@ -802,6 +839,13 @@ def _apply_dispatch_impl(
     if new_quals is None:
         new_quals = _apply_table_np(b, phred_table, gl)
     return ds, b, new_quals
+
+
+def apply_handle_dataset(handle) -> AlignmentDataset:
+    """The pre-recalibration dataset inside a dispatch handle — what a
+    recovery path re-dispatches when the handle's device died before
+    :func:`apply_recalibration_finish` could fetch it."""
+    return handle[0]
 
 
 def apply_recalibration_finish(handle) -> AlignmentDataset:

@@ -37,9 +37,23 @@ class StageCheckpointer:
         os.makedirs(directory, exist_ok=True)
         self._completed: list[str] = []
         mpath = os.path.join(directory, _MANIFEST)
+        m = None
         if os.path.exists(mpath):
-            with open(mpath) as fh:
-                m = json.load(fh)
+            try:
+                with open(mpath) as fh:
+                    m = json.load(fh)
+                if not isinstance(m, dict):
+                    raise ValueError(f"manifest is {type(m).__name__}, "
+                                     "not an object")
+            except (OSError, ValueError) as e:
+                # a torn/corrupt manifest (crashed writer, disk hiccup)
+                # must cost a recompute, not brick every future resume
+                logger.warning(
+                    "checkpoint manifest %s is unreadable (%s); treating "
+                    "as no checkpoint and restarting", mpath, e,
+                )
+                m = None
+        if m is not None:
             if m.get("stages") == self.stages:
                 self._completed = [
                     s for s in m.get("completed", [])
@@ -67,10 +81,24 @@ class StageCheckpointer:
 
     def mark(self, stage: str) -> None:
         self._completed.append(stage)
-        with open(os.path.join(self.dir, _MANIFEST), "w") as fh:
-            json.dump(
-                {"stages": self.stages, "completed": self._completed}, fh
-            )
+        mpath = os.path.join(self.dir, _MANIFEST)
+        tmp = mpath + ".tmp"
+        # temp + atomic rename: a crash mid-write leaves either the old
+        # complete manifest or the new one, never a torn file (and the
+        # init path above tolerates even that)
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(
+                    {"stages": self.stages, "completed": self._completed},
+                    fh,
+                )
+            os.replace(tmp, mpath)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
 
 def run_stages(

@@ -93,6 +93,8 @@ def markdup_columns_dispatch(batch, device=None):
 
     from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
     from adam_tpu.parallel.device_pool import putter, span_attrs
+    from adam_tpu.utils import faults
+    from adam_tpu.utils import retry as _retry
     from adam_tpu.utils import telemetry as _tele
 
     _put = putter(device)
@@ -110,16 +112,24 @@ def markdup_columns_dispatch(batch, device=None):
         # walks mask by lengths/cigar_n, so the padding lanes are inert)
         gl = grid_cols(b.lmax)
         gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
-        five, score = jit(
-            _put(pad_rows_np(b.start, g, -1)),
-            _put(pad_rows_np(b.end, g, -1)),
-            _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-            _put(pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD, cols=gc)),
-            _put(pad_rows_np(b.cigar_lens, g, 0, cols=gc)),
-            _put(pad_rows_np(b.cigar_n, g, 0)),
-            _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
-            _put(pad_rows_np(b.lengths, g, 0)),
-        )
+
+        def dispatch():
+            # the device_put + jit call is the RPC pair that fails
+            # transiently on a tunneled chip; the whole unit re-runs on
+            # a retry (device_put is idempotent — a fresh commit)
+            faults.point("device.dispatch", device=device)
+            return jit(
+                _put(pad_rows_np(b.start, g, -1)),
+                _put(pad_rows_np(b.end, g, -1)),
+                _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
+                _put(pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD, cols=gc)),
+                _put(pad_rows_np(b.cigar_lens, g, 0, cols=gc)),
+                _put(pad_rows_np(b.cigar_n, g, 0)),
+                _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
+                _put(pad_rows_np(b.lengths, g, 0)),
+            )
+
+        five, score = _retry.retry_call(dispatch, site="markdup.dispatch")
         return five[:n], score[:n]
 
 

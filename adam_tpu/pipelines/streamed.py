@@ -62,6 +62,7 @@ should be doing.
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import threading
@@ -72,9 +73,18 @@ from typing import Optional
 import numpy as np
 
 from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.parallel import device_pool as dp_mod
 from adam_tpu.utils import telemetry as tele
+from adam_tpu.utils.transfer import device_fetch
+
+log = logging.getLogger(__name__)
 
 _SENTINEL = object()
+
+#: Sentinel for "the device path is gone — run this window on the host
+#: backend" (returned by the per-run ``_pick_device`` closure after the
+#: last pool device is evicted, or after the single default chip fails).
+_HOST = object()
 
 
 def _ingest_windows(path: str, window_reads: int, out_q: queue.Queue,
@@ -188,8 +198,6 @@ def transform_streamed(
     # i % n; None means single-device (the pre-pool path, bit-for-bit)
     dpool = None
     if use_device:
-        from adam_tpu.parallel import device_pool as dp_mod
-
         dpool = dp_mod.make_pool(devices)
     stats["n_devices"] = dpool.n if dpool is not None else (
         1 if use_device else 0
@@ -197,6 +205,70 @@ def transform_streamed(
     if use_device:
         tr.gauge(tele.G_POOL_DEVICES, stats["n_devices"])
     os.makedirs(out_path, exist_ok=True)
+    # purge a crashed run's staging dir: io/parquet publishes each part
+    # by atomic rename out of out_path/_temporary, so a SIGKILL'd run
+    # leaves its torn files THERE (readers ignore the _-prefixed dir),
+    # never as truncated part-*.parquet — and a rerun starts clean
+    from adam_tpu.io.parquet import purge_stale_staging
+
+    purge_stale_staging(out_path)
+
+    # ---- resilience (docs/ROBUSTNESS.md): a device that spends its
+    # retry budget is evicted and its in-flight windows replay on the
+    # survivors; when the last device is gone, every remaining
+    # per-residue pass runs on the native/numpy host backend.  Output
+    # stays bit-identical on every path: the barrier merges are
+    # window-ordered and the backends are bit-parity twins
+    # (tests/test_backend_parity.py).
+    res = {"device_lost": False}
+
+    def _host_backend() -> str:
+        from adam_tpu import native
+
+        return "native" if native.available() else "numpy"
+
+    def _evict_or_lose(dev, exc) -> bool:
+        """Evict a failed device; True = survivors remain, False = the
+        device path is gone (callers fall back to the host backend)."""
+        if dpool is not None:
+            dpool.evict(dev, reason=str(exc), tracer=tr)
+            if dpool.alive_devices():
+                return True
+        else:
+            log.error(
+                "device path failed (%s); running the rest of this "
+                "pipeline on the %s host backend", exc, _host_backend(),
+            )
+        res["device_lost"] = True
+        return False
+
+    def _pick_device(win):
+        """Window's round-robin device: a jax device (pool), None (the
+        single-chip default device), or _HOST once the path is lost."""
+        if res["device_lost"]:
+            return _HOST
+        if dpool is None:
+            return None
+        try:
+            return dpool.device(win)
+        except dp_mod.AllDevicesEvicted:
+            res["device_lost"] = True
+            return _HOST
+
+    def _on_survivors(win, device_fn, host_fn):
+        """THE recovery loop, shared by every dispatch/replay site: run
+        ``device_fn(dev)`` on window's round-robin device, evicting and
+        walking to the next survivor on failure (transient retries
+        already happened inside the call), ``host_fn()`` once the
+        device path is lost."""
+        while True:
+            dev = _pick_device(win)
+            if dev is _HOST:
+                return host_fn()
+            try:
+                return device_fn(dev)
+            except Exception as e:
+                _evict_or_lose(dev, e)
     if known_indels is not None and consensus_model == "reads":
         # supplying known indels implies the knowns consensus model (the
         # reference's -known_indels flag semantics; realign_indels only
@@ -228,15 +300,42 @@ def transform_streamed(
     md_depth = 2 if dpool is None else 2 * dpool.n
     pend_cols: deque = deque()
 
-    def _summarize(ds, cols):
-        if cols is None:
-            summaries.append(md_mod.row_summary(ds))
+    def _md_dispatch(win, batch):
+        """Dispatch one window's [N, L] markdup reductions -> (device,
+        lazy cols), walking to the next survivor after a spent retry
+        budget; None = compute the summary on the host instead."""
+
+        def on_device(dev):
+            cols = md_mod.markdup_columns_dispatch(batch, device=dev)
+            tr.count(tele.C_DEVICE_DISPATCHED)
+            return dev, cols
+
+        return _on_survivors(win, on_device, lambda: None)
+
+    def _summarize(win, ds, dev, cols):
+        while cols is not None:
+            try:
+                with tr.span(tele.SPAN_MD_FETCH):
+                    five = np.asarray(device_fetch(cols[0]))
+                    score = np.asarray(device_fetch(cols[1]))
+            except Exception as e:
+                # fetch failed past the transfer layer's retry budget:
+                # evict the chip and replay the window's reductions on
+                # a survivor (the loop re-fetches), host when none left
+                with tr.span(tele.SPAN_POOL_REPLAY, window=win,
+                             **dp_mod.span_attrs(dev)):
+                    _evict_or_lose(dev, e)
+                    nxt = _md_dispatch(win, ds.batch)
+                if nxt is None:
+                    break
+                dev, cols = nxt
+                continue
+            tr.count(tele.C_DEVICE_FETCHED)
+            summaries.append(
+                md_mod.row_summary(ds, five_prime=five, score=score)
+            )
             return
-        with tr.span(tele.SPAN_MD_FETCH):
-            five = np.asarray(cols[0])
-            score = np.asarray(cols[1])
-        tr.count(tele.C_DEVICE_FETCHED)
-        summaries.append(md_mod.row_summary(ds, five_prime=five, score=score))
+        summaries.append(md_mod.row_summary(ds))
 
     with tr.span(tele.SPAN_PASS_A):
         try:
@@ -279,24 +378,27 @@ def transform_streamed(
                         time.monotonic_ns() - t_pw,
                     )
                 if mark_duplicates:
-                    if use_device:
-                        # dispatch window i's [N, L] key/score reductions
-                        # (on device i % n under a pool), then drain the
-                        # oldest in-flight window once the queue is full
-                        # — its columns had the whole queue depth to
-                        # compute on their chip
-                        cols = md_mod.markdup_columns_dispatch(
-                            batch,
-                            device=None if dpool is None else dpool.device(win),
-                        )
-                        tr.count(tele.C_DEVICE_DISPATCHED)
-                        pend_cols.append((win, ds, cols))
+                    # dispatch window i's [N, L] key/score reductions
+                    # (on device i % n under a pool), then drain the
+                    # oldest in-flight window once the queue is full —
+                    # its columns had the whole queue depth to compute
+                    # on their chip.  _md_dispatch handles eviction and
+                    # returns None on the host paths.
+                    disp = _md_dispatch(win, batch) if use_device else None
+                    if disp is not None:
+                        pend_cols.append((win, ds) + disp)
                         tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_cols))
                         if len(pend_cols) >= md_depth:
-                            _old_win, old_ds, old_cols = pend_cols.popleft()
-                            _summarize(old_ds, old_cols)
+                            _summarize(*pend_cols.popleft())
                     else:
-                        _summarize(ds, None)
+                        # the device path may have just died with OLDER
+                        # windows still in flight: drain them first —
+                        # summaries must stay window-ordered, or the
+                        # resolve barrier's offset slices apply
+                        # duplicate flags to the wrong windows' rows
+                        while pend_cols:
+                            _summarize(*pend_cols.popleft())
+                        _summarize(win, ds, None, None)
                 if realign:
                     events.append(
                         realign_mod.extract_indel_event_arrays(
@@ -304,8 +406,7 @@ def transform_streamed(
                         )
                     )
             while pend_cols:
-                _old_win, old_ds, old_cols = pend_cols.popleft()
-                _summarize(old_ds, old_cols)
+                _summarize(*pend_cols.popleft())
         except BaseException:
             abort.set()
             raise
@@ -348,6 +449,7 @@ def transform_streamed(
         candidates: list[AlignmentDataset] = []
         window_valid: list[int] = []
         obs_parts = []
+        obs_replays = []
         for i, w in enumerate(windows):
             n_valid = w.batch.n_rows
             if targets:
@@ -358,6 +460,49 @@ def transform_streamed(
                     candidates.append(cand)
                 windows[i] = w
             window_valid.append(n_valid)
+
+    def _observe_host(w):
+        total, mism, _rg, g = bqsr_mod._observe_device(
+            w, known_snps, _host_backend() if use_device else backend
+        )
+        return np.asarray(total), np.asarray(mism), g
+
+    def _obs_replay(i, w, dev):
+        """Recovery hook for window i's barrier fetch: evict the chip
+        that held its lazy histograms and recompute on a survivor (the
+        host backend when none remain), returning host arrays."""
+
+        def on_device(nd):
+            total, mism, _rg, g = bqsr_mod._observe_device(
+                w, known_snps, backend, device=nd
+            )
+            return device_fetch(total), device_fetch(mism), g
+
+        def replay(exc):
+            with tr.span(tele.SPAN_POOL_REPLAY, window=i,
+                         **dp_mod.span_attrs(dev)):
+                _evict_or_lose(dev, exc)
+                return _on_survivors(i, on_device, lambda: _observe_host(w))
+
+        return replay
+
+    def _observe_window(i, w):
+        """Observe one window -> ((total, mism, g), replay hook or
+        None), walking dispatch failures to the next survivor and to
+        the host backend when the pool is gone."""
+        if not use_device:
+            return _observe_host(w), None
+
+        def on_device(dev):
+            total, mism, _rg, g = bqsr_mod._observe_device(
+                w, known_snps, backend, device=dev
+            )
+            tr.count(tele.C_DEVICE_DISPATCHED)
+            return (total, mism, g), _obs_replay(i, w, dev)
+
+        return _on_survivors(
+            i, on_device, lambda: (_observe_host(w), None)
+        )
 
     def _observe_remainders():
         # non-candidate rows are untouched by realignment, so their
@@ -375,13 +520,9 @@ def transform_streamed(
                         # compact tables that merge host-side (in window
                         # order) at the barrier — dist.distributed_observe's
                         # psum shape, without needing a live mesh
-                        total, mism, _rg, g = bqsr_mod._observe_device(
-                            w, known_snps, backend,
-                            device=None if dpool is None else dpool.device(i),
-                        )
-                        obs_parts.append((total, mism, g))
-                        if use_device:
-                            tr.count(tele.C_DEVICE_DISPATCHED)
+                        part, replay = _observe_window(i, w)
+                        obs_parts.append(part)
+                        obs_replays.append(replay)
 
     # ---- tail: realign the gathered candidates (observing remainders
     # under the device wait), then observe the realigned part with its
@@ -403,13 +544,9 @@ def transform_streamed(
             overlap_work=_observe_remainders,
         )
         if recalibrate and realigned.batch.n_rows:
-            total, mism, _rg, g = bqsr_mod._observe_device(
-                realigned, known_snps, backend,
-                device=None if dpool is None else dpool.device(len(windows)),
-            )
-            obs_parts.append((total, mism, g))
-            if use_device:
-                tr.count(tele.C_DEVICE_DISPATCHED)
+            part, replay = _observe_window(len(windows), realigned)
+            obs_parts.append(part)
+            obs_replays.append(replay)
         # subtract the observe wall from the tail ONLY when realign
         # reports it genuinely ran under the sweeps' device drain — on
         # the serial paths (Python fallback, no dispatched sweeps) the
@@ -430,10 +567,23 @@ def transform_streamed(
     table = None
     gl = 0
     if recalibrate and obs_parts:
+        # count only the parts that are genuinely device-resident at
+        # the barrier — after a mid-run degradation some (or all) parts
+        # are host-computed and the merge fetches nothing for them
+        n_dev_parts = sum(
+            1 for t, _m, _g in obs_parts if not isinstance(t, np.ndarray)
+        )
         with tr.span(tele.SPAN_OBS_MERGE):
-            total, mism, gl = bqsr_mod.merge_observations(obs_parts)
-        if use_device:
-            tr.count(tele.C_DEVICE_FETCHED, len(obs_parts))
+            total, mism, gl = bqsr_mod.merge_observations(
+                obs_parts, replays=obs_replays
+            )
+        if n_dev_parts:
+            tr.count(tele.C_DEVICE_FETCHED, n_dev_parts)
+        # the replay hooks close over every window's dataset: release
+        # them NOW or pass C's free-as-we-go (windows[idx] = None)
+        # frees nothing and peak residency becomes ALL windows at once
+        obs_parts.clear()
+        obs_replays.clear()
         # solve excludes the fetch: the stage rows are disjoint and sum
         # to the barrier wall
         with tr.span(tele.SPAN_SOLVE):
@@ -480,7 +630,7 @@ def transform_streamed(
         # derived apply_split_s (pass C minus dispatch minus fetch) sums
         # with them to the pass wall instead of double-counting it
         with tr.span(tele.SPAN_PASS_C):
-            if table is not None and use_device:
+            if table is not None and use_device and not res["device_lost"]:
                 # replicate the solved u8 table once per pool device
                 # (~4 MB each) instead of re-shipping it per window
                 dev_tables = None
@@ -488,8 +638,14 @@ def transform_streamed(
                     import jax
 
                     tbl_c = np.ascontiguousarray(table, np.uint8)
+                    # replicas keyed by ORIGINAL pool index (stable
+                    # under eviction); dead devices get no replica —
+                    # _pick_device never hands them out
+                    alive_now = dpool.alive_devices()
                     dev_tables = [
-                        jax.device_put(tbl_c, d) for d in dpool.devices
+                        jax.device_put(tbl_c, d) if d in alive_now
+                        else None
+                        for d in dpool.devices
                     ]
                     # re-warm the apply gather against the SOLVED
                     # table's real width: merge_observations can widen
@@ -526,46 +682,83 @@ def transform_streamed(
                         tele.SPAN_POOL_PREWARM_C, t_pwc,
                         time.monotonic_ns() - t_pwc,
                     )
-                # in-flight queue of (part idx, handle, slot): depth 2
-                # single-device (the classic double buffer); with a pool
-                # a double buffer per device — window j+1's gather on
-                # chip B runs while window j fetches from chip A
+                # in-flight queue of (part idx, device, handle): depth
+                # 2 single-device (the classic double buffer); with a
+                # pool a double buffer per device — window j+1's gather
+                # on chip B runs while window j fetches from chip A
                 apply_depth = 2 if dpool is None else 2 * dpool.n
                 pend_q: deque = deque()
 
+                def _host_apply(w):
+                    return bqsr_mod.apply_recalibration(
+                        w, table, gl, _host_backend()
+                    )
+
+                def _device_table(dev):
+                    return (
+                        table if dpool is None
+                        else dev_tables[dpool.devices.index(dev)]
+                    )
+
+                def _replay_apply(p_idx, dev, w, exc):
+                    """Window p_idx's apply died on ``dev``: evict it
+                    and re-run dispatch+fetch synchronously on a
+                    survivor, host backend when none remain."""
+
+                    def on_device(nd):
+                        h = bqsr_mod.apply_recalibration_dispatch(
+                            w, _device_table(nd), gl, backend, device=nd
+                        )
+                        return bqsr_mod.apply_recalibration_finish(h)
+
+                    with tr.span(tele.SPAN_POOL_REPLAY, window=p_idx,
+                                 **dp_mod.span_attrs(dev)):
+                        _evict_or_lose(dev, exc)
+                        return _on_survivors(
+                            p_idx, on_device, lambda: _host_apply(w)
+                        )
+
                 def _fetch_one():
-                    p_idx, p_handle, p_slot = pend_q.popleft()
-                    attrs = {} if dpool is None else {
-                        "device": dpool.device_id(p_slot)
-                    }
-                    with tr.span(
-                        tele.SPAN_APPLY_FETCH, window=p_idx, **attrs
-                    ):
-                        done = bqsr_mod.apply_recalibration_finish(p_handle)
-                    tr.count(tele.C_DEVICE_FETCHED)
+                    p_idx, p_dev, p_handle = pend_q.popleft()
+                    attrs = dp_mod.span_attrs(p_dev)
+                    try:
+                        with tr.span(
+                            tele.SPAN_APPLY_FETCH, window=p_idx, **attrs
+                        ):
+                            done = bqsr_mod.apply_recalibration_finish(
+                                p_handle
+                            )
+                        tr.count(tele.C_DEVICE_FETCHED)
+                    except Exception as e:
+                        done = _replay_apply(
+                            p_idx, p_dev,
+                            bqsr_mod.apply_handle_dataset(p_handle), e,
+                        )
                     _submit(p_idx, done)
 
                 for j in range(len(parts)):
                     idx, w = parts[j]
                     parts[j] = None  # the list must not pin every window
-                    if dpool is None:
-                        dev, tbl = None, table
+
+                    def _dispatch_one(dev, idx=idx, w=w):
+                        with tr.span(
+                            tele.SPAN_APPLY_DISPATCH, window=idx,
+                            **dp_mod.span_attrs(dev),
+                        ):
+                            handle = bqsr_mod.apply_recalibration_dispatch(
+                                w, _device_table(dev), gl, backend,
+                                device=dev,
+                            )
+                        tr.count(tele.C_DEVICE_DISPATCHED)
+                        return dev, handle
+
+                    got = _on_survivors(j, _dispatch_one, lambda: None)
+                    if got is None:  # device path lost: apply host-side
+                        _submit(idx, _host_apply(w))
                     else:
-                        dev = dpool.device(j)
-                        tbl = dev_tables[dpool.device_index(j)]
-                    attrs = {} if dpool is None else {
-                        "device": dpool.device_id(j)
-                    }
-                    with tr.span(
-                        tele.SPAN_APPLY_DISPATCH, window=idx, **attrs
-                    ):
-                        handle = bqsr_mod.apply_recalibration_dispatch(
-                            w, tbl, gl, backend, device=dev
-                        )
+                        pend_q.append((idx,) + got)
+                        tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_q))
                     del w
-                    tr.count(tele.C_DEVICE_DISPATCHED)
-                    pend_q.append((idx, handle, j))
-                    tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_q))
                     if idx < len(windows):
                         windows[idx] = None  # free as we go
                     if len(pend_q) >= apply_depth:
@@ -573,19 +766,26 @@ def transform_streamed(
                 while pend_q:
                     _fetch_one()
             else:
+                # host path — also the full-degradation path: with the
+                # device backend lost, the per-residue apply runs on
+                # the native/numpy twin (bit-identical by parity)
+                apply_backend = (
+                    _host_backend() if use_device else backend
+                )
                 for j in range(len(parts)):
                     idx, w = parts[j]
                     parts[j] = None  # the list must not pin every window
                     if table is not None:
                         w = bqsr_mod.apply_recalibration(
-                            w, table, gl, backend
+                            w, table, gl, apply_backend
                         )
                     if idx < len(windows):
                         windows[idx] = None  # free as we go
                     _submit(idx, w)
     except BaseException:
-        try:  # drain the pool, but surface the apply-path error
-            pool.close()
+        try:  # drain the pool + discard its unpublished temp parts,
+            # but surface the apply-path error
+            pool.close(abort=True)
         except BaseException:
             pass
         raise

@@ -1,0 +1,271 @@
+"""Deterministic fault injection for the streamed multi-chip pipeline.
+
+The reference delegates fault tolerance to Spark's RDD lineage recompute
+(Zaharia et al.); the TPU build owns its own story (pipelines/checkpoint
+for stage granularity, parallel/device_pool + pipelines/streamed for
+window granularity).  A recovery path that is never executed is a
+recovery path that does not work — this module lets tests and CI drive
+the real pipeline through transient dispatch errors, permanent device
+loss, and hung fetches **deterministically**, with the production build
+paying one predictable-branch check per site when disabled (the same
+discipline as the 163 ns disabled telemetry span).
+
+Named fault points sit at the seams the multi-chip pipeline can
+actually fail at::
+
+    faults.point("device.dispatch", device=dev)   # before a jit dispatch
+    faults.point("device.fetch")                  # before a device->host copy
+    faults.point("parquet.write")                 # before a part write
+    faults.point("pool.prewarm", device=dev)      # before a prewarm compile
+
+A *fault spec* (``ADAM_TPU_FAULTS`` env var or the ``--fault-spec`` CLI
+flag) arms clauses against those points.  Grammar (full reference in
+docs/ROBUSTNESS.md)::
+
+    spec    := clause (';' clause)*
+    clause  := site '=' action (',' option)*
+    action  := 'transient' | 'permanent' | 'delay:<seconds>'
+    option  := 'every=N'    match every Nth arrival at the site
+             | 'after=N'    skip the first N arrivals
+             | 'times=N'    stop matching after N injections
+             | 'device=K'   only arrivals attributed to device id K
+             | 'p=F'        match with probability F (seeded RNG)
+             | 'seed=N'     RNG seed for p= (default 0)
+
+Arrival counters are per clause, so ``every=3`` means "the 3rd, 6th,
+9th ... time any call reaches this site" — reproducible run to run as
+long as the call sequence is (the streamed pipeline dispatches and
+fetches from a single host thread, so it is).  ``transient`` raises
+:class:`TransientFault` (retryable — the retry/backoff wrappers absorb
+it), ``permanent`` raises :class:`PermanentFault` (never retried — the
+device-eviction path owns it), ``delay:S`` sleeps S seconds at the site
+(a hung RPC; the fetch deadline watchdog turns it into a retryable
+timeout).  Every injection counts ``fault.injected`` on the global
+telemetry tracer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+#: The named sites the pipeline exposes.  A spec naming an unknown site
+#: is a hard error at install time — a typo'd site would otherwise arm
+#: a clause that can never fire and silently test nothing.
+KNOWN_POINTS = frozenset({
+    "device.dispatch",
+    "device.fetch",
+    "parquet.write",
+    "parquet.encode",
+    "pool.prewarm",
+})
+
+
+class FaultError(Exception):
+    """Base class of injected faults (never raised itself)."""
+
+
+class TransientFault(FaultError):
+    """Injected retryable failure (a flaky RPC, a dropped dispatch)."""
+
+
+class PermanentFault(FaultError):
+    """Injected non-retryable failure (a dead chip); the retry wrappers
+    re-raise it immediately and the eviction path takes over."""
+
+
+class _Clause:
+    __slots__ = (
+        "site", "action", "delay_s", "every", "after", "times",
+        "device", "p", "_rng", "_arrivals", "_fired",
+    )
+
+    def __init__(self, site: str, action: str, delay_s: float,
+                 every: int | None, after: int, times: int | None,
+                 device: str | None, p: float | None, seed: int):
+        self.site = site
+        self.action = action
+        self.delay_s = delay_s
+        self.every = every
+        self.after = after
+        self.times = times
+        self.device = device
+        self.p = p
+        self._rng = random.Random(seed)
+        self._arrivals = 0
+        self._fired = 0
+
+    def arrive(self, device) -> bool:
+        """Advance this clause's arrival counter and evaluate its
+        predicate (called under the module lock).  Firing — and the
+        ``times=`` accounting — is the caller's decision: every clause
+        on a site sees every arrival, so 'the Nth time any call reaches
+        this site' holds even when an earlier clause fires first."""
+        if self.device is not None and str(device) != self.device:
+            return False
+        self._arrivals += 1
+        if self.times is not None and self._fired >= self.times:
+            return False
+        if self._arrivals <= self.after:
+            return False
+        if self.every is not None:
+            return self._arrivals % self.every == 0
+        if self.p is not None:
+            return self._rng.random() < self.p
+        return True
+
+
+def _parse_clause(text: str) -> _Clause:
+    head, _, opts = text.partition(",")
+    site, sep, action = head.partition("=")
+    site = site.strip()
+    action = action.strip()
+    if not sep or not site or not action:
+        raise ValueError(
+            f"fault clause {text!r}: expected 'site=action[,option...]'"
+        )
+    if site not in KNOWN_POINTS:
+        raise ValueError(
+            f"fault clause {text!r}: unknown fault point {site!r} "
+            f"(known: {sorted(KNOWN_POINTS)})"
+        )
+    delay_s = 0.0
+    if action.startswith("delay:"):
+        try:
+            delay_s = float(action[len("delay:"):])
+        except ValueError:
+            raise ValueError(
+                f"fault clause {text!r}: delay wants a float seconds value"
+            ) from None
+        action = "delay"
+    if action not in ("transient", "permanent", "delay"):
+        raise ValueError(
+            f"fault clause {text!r}: unknown action {action!r} "
+            "(expected transient | permanent | delay:<seconds>)"
+        )
+    every = times = None
+    after = 0
+    device = None
+    p = None
+    seed = 0
+    for opt in filter(None, (o.strip() for o in opts.split(","))):
+        key, sep, val = opt.partition("=")
+        if not sep:
+            raise ValueError(f"fault clause {text!r}: bad option {opt!r}")
+        try:
+            if key == "every":
+                every = int(val)
+                if every < 1:
+                    raise ValueError
+            elif key == "after":
+                after = int(val)
+            elif key == "times":
+                times = int(val)
+            elif key == "device":
+                device = val
+            elif key == "p":
+                p = float(val)
+            elif key == "seed":
+                seed = int(val)
+            else:
+                raise ValueError(
+                    f"fault clause {text!r}: unknown option {key!r}"
+                )
+        except ValueError as e:
+            if e.args and "fault clause" in str(e):
+                raise
+            raise ValueError(
+                f"fault clause {text!r}: bad value for {key!r}: {val!r}"
+            ) from None
+    return _Clause(site, action, delay_s, every, after, times, device, p,
+                   seed)
+
+
+def parse_spec(spec: str) -> list:
+    """Parse a fault-spec string into clauses (validation errors raise
+    ``ValueError`` with the offending clause)."""
+    return [
+        _parse_clause(c)
+        for c in filter(None, (c.strip() for c in spec.split(";")))
+    ]
+
+
+# -------------------------------------------------------------------------
+# Module state: ENABLED is the one branch the disabled fast path pays.
+# -------------------------------------------------------------------------
+ENABLED = False
+_CLAUSES: list = []
+_LOCK = threading.Lock()
+
+
+def install(spec: str | None) -> None:
+    """Arm (or, with None/empty, disarm) a fault spec process-wide."""
+    global ENABLED, _CLAUSES
+    clauses = parse_spec(spec) if spec else []
+    with _LOCK:
+        _CLAUSES = clauses
+        ENABLED = bool(clauses)
+    if clauses:
+        log.warning(
+            "fault injection ARMED: %d clause(s) from %r (this is a "
+            "testing facility; unset ADAM_TPU_FAULTS / --fault-spec for "
+            "production runs)", len(clauses), spec,
+        )
+
+
+def clear() -> None:
+    """Disarm all fault clauses (test teardown hook)."""
+    install(None)
+
+
+def point(site: str, device=None) -> None:
+    """A named fault point.  Disabled cost: one module-global branch.
+
+    ``device``: the jax device (or its id) the call is attributed to,
+    matched against a clause's ``device=K`` filter the same way the
+    telemetry ``device=<k>`` span attribution is keyed.
+    """
+    if not ENABLED:
+        return
+    dev_id = getattr(device, "id", device)
+    fire = None
+    with _LOCK:
+        # every same-site clause counts the arrival (so each clause's
+        # every/after schedule is anchored to REAL arrivals at the
+        # site); the first whose predicate matches fires
+        for clause in _CLAUSES:
+            if clause.site != site:
+                continue
+            if clause.arrive(dev_id) and fire is None:
+                fire = clause
+        if fire is not None:
+            fire._fired += 1
+    if fire is None:
+        return
+    from adam_tpu.utils import telemetry as tele
+
+    tele.TRACE.count(tele.C_FAULT_INJECTED)
+    if fire.action == "delay":
+        log.warning("fault injected at %s (device=%s): delay %.3fs",
+                    site, dev_id, fire.delay_s)
+        time.sleep(fire.delay_s)
+        return
+    log.warning("fault injected at %s (device=%s): %s", site, dev_id,
+                fire.action)
+    if fire.action == "permanent":
+        raise PermanentFault(f"injected permanent fault at {site}"
+                             f" (device={dev_id})")
+    raise TransientFault(f"injected transient fault at {site}"
+                         f" (device={dev_id})")
+
+
+# Arm from the environment at import: subprocess drivers (the CI fault
+# leg, the SIGKILL crash-consistency test) configure via ADAM_TPU_FAULTS
+# without touching the CLI.
+if os.environ.get("ADAM_TPU_FAULTS", "").strip():
+    install(os.environ["ADAM_TPU_FAULTS"])

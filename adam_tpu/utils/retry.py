@@ -1,0 +1,198 @@
+"""Retry-with-backoff and deadline wrappers for device RPC call sites.
+
+The tunneled-TPU dispatch/fetch paths are remote procedure calls: they
+drop, stall, and occasionally die.  Before this module, the streamed
+pipeline had exactly one ``except`` on those paths (the prewarm's
+warn-and-degrade) — any transient dispatch error or hung fetch killed a
+whole multi-window run.  Two primitives fix that:
+
+* :func:`retry_call` — run a callable, retrying **retryable** failures
+  with exponential backoff.  Retryable means: injected
+  :class:`~adam_tpu.utils.faults.TransientFault`, a
+  :class:`DeadlineExceeded` fetch timeout, connection-layer ``OSError``
+  subclasses, and jax's ``XlaRuntimeError`` (the shape every transient
+  tunnel/RPC failure surfaces as).  Injected ``PermanentFault`` and
+  everything else (a real bug would be "everything else") re-raise on
+  first sight — retrying a deterministic error just triples its latency.
+  Every retry counts ``retry.attempts`` on the global tracer.
+* :func:`call_with_deadline` — run a callable on a watchdog thread and
+  raise :class:`DeadlineExceeded` (retryable) if it exceeds a deadline,
+  so a hung fetch RPC becomes a bounded, retryable timeout instead of a
+  wedged run.  The abandoned thread is a daemon: it cannot block
+  process exit, and its late result is discarded.
+
+Policy knobs (all tolerantly parsed — an env typo degrades to the
+default with a warning, the house rule for every ``ADAM_TPU_*`` var):
+
+* ``ADAM_TPU_RETRY_ATTEMPTS`` — total tries per call (default 3).
+* ``ADAM_TPU_RETRY_BACKOFF_S`` — first backoff sleep (default 0.05 s,
+  doubling per retry).
+* ``ADAM_TPU_RETRY_MAX_BACKOFF_S`` — backoff ceiling (default 2 s).
+
+The backoff is deterministic (no jitter): the recovery paths must be
+reproducible under the fault-injection matrix, and the call sites are
+per-window (tens per run), not contended.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from adam_tpu.utils.faults import PermanentFault, TransientFault
+
+log = logging.getLogger(__name__)
+
+
+class DeadlineExceeded(TimeoutError):
+    """A watchdogged call outlived its deadline (retryable)."""
+
+
+def env_float(name: str, default: float) -> float:
+    """Tolerantly parsed float env var (warn + default on a typo — the
+    house rule for every ``ADAM_TPU_*`` tuning var); shared with the
+    transfer layer's fetch-deadline knob."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("%s=%r is not a float; using default %s", name, raw,
+                    default)
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+        return v if v >= 1 else default
+    except ValueError:
+        log.warning("%s=%r is not a positive int; using default %s", name,
+                    raw, default)
+        return default
+
+
+class RetryPolicy:
+    """Attempt/backoff tuning for one family of call sites."""
+
+    __slots__ = ("attempts", "backoff_s", "max_backoff_s")
+
+    def __init__(self, attempts: int = 3, backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0):
+        self.attempts = max(1, attempts)
+        self.backoff_s = max(0.0, backoff_s)
+        self.max_backoff_s = max(0.0, max_backoff_s)
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(
+            attempts=_env_int("ADAM_TPU_RETRY_ATTEMPTS", 3),
+            backoff_s=env_float("ADAM_TPU_RETRY_BACKOFF_S", 0.05),
+            max_backoff_s=env_float("ADAM_TPU_RETRY_MAX_BACKOFF_S", 2.0),
+        )
+
+
+#: XLA status prefixes that mark a *transient* runtime failure (dropped
+#: tunnel, preempted RPC).  Deterministic statuses — RESOURCE_EXHAUSTED
+#: (a window that OOMs on one chip OOMs on every chip), INVALID_ARGUMENT,
+#: NOT_FOUND — must NOT retry: retrying them only multiplies the latency
+#: of the eviction/host-fallback path that actually resolves them.
+_TRANSIENT_XLA_STATUSES = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED", "ABORTED",
+    "UNKNOWN", "INTERNAL",
+)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Default transient/permanent classification (module docstring)."""
+    if isinstance(exc, PermanentFault):
+        return False
+    if isinstance(exc, (TransientFault, DeadlineExceeded, ConnectionError)):
+        return True
+    # jaxlib's XlaRuntimeError covers the tunnel/RPC failure surface
+    # (matched by name so a CPU-only host never imports jaxlib for
+    # this), but only its transient statuses — the status code leads
+    # the message ("UNAVAILABLE: connection reset ...")
+    if type(exc).__name__ == "XlaRuntimeError":
+        msg = str(exc).lstrip()
+        return msg.startswith(_TRANSIENT_XLA_STATUSES)
+    return False
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    site: str,
+    policy: Optional[RetryPolicy] = None,
+    retryable: Callable[[BaseException], bool] = is_retryable,
+):
+    """Call ``fn()``; retry retryable failures with exponential backoff.
+
+    Raises the last failure when the attempt budget is exhausted — the
+    caller (the device-eviction path, usually) decides what a spent
+    budget means.  ``site`` labels the log lines and groups nothing
+    else; the ``retry.attempts`` counter is global.
+    """
+    if policy is None:
+        policy = RetryPolicy.from_env()
+    backoff = policy.backoff_s
+    attempt = 1
+    while True:
+        try:
+            return fn()
+        except BaseException as e:
+            if attempt >= policy.attempts or not retryable(e):
+                raise
+            from adam_tpu.utils import telemetry as tele
+
+            tele.TRACE.count(tele.C_RETRY_ATTEMPTS)
+            log.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.3fs",
+                site, attempt, policy.attempts, e, backoff,
+            )
+            if backoff > 0:
+                time.sleep(backoff)
+            backoff = min(backoff * 2, policy.max_backoff_s)
+            attempt += 1
+
+
+def call_with_deadline(fn: Callable, timeout_s: float, *, site: str):
+    """Run ``fn()`` on a watchdog daemon thread with a deadline.
+
+    Returns ``fn``'s result, re-raises its exception, or raises
+    :class:`DeadlineExceeded` after ``timeout_s`` — in which case the
+    worker thread is abandoned (daemonized, so it can't pin process
+    exit) and whatever it eventually produces is discarded.  A thread
+    per call is deliberate: the deadline wraps per-window device
+    fetches (tens per run), and a shared pool would let one hung RPC
+    starve the watchdog for every later fetch.
+    """
+    if not timeout_s or timeout_s <= 0:
+        return fn()
+    box: list = []
+
+    def run():
+        try:
+            box.append((True, fn()))
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            box.append((False, e))
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"deadline:{site}")
+    t.start()
+    t.join(timeout_s)
+    if not box:
+        raise DeadlineExceeded(
+            f"{site} exceeded its {timeout_s:.1f}s deadline (hung RPC?)"
+        )
+    ok, val = box[0]
+    if ok:
+        return val
+    raise val

@@ -110,6 +110,11 @@ SPAN_MD_COLUMNS = _span("markdup.columns.dispatch")
 SPAN_POOL_PREWARM = _span("device.pool.prewarm")
 SPAN_POOL_PREWARM_C = _span("device.pool.prewarm.pass_c")
 SPAN_POOL_PREWARM_COMPILE = _span("device.pool.prewarm.compile")
+# ---- resilience (utils/faults.py, utils/retry.py, the streamed
+# recovery paths): one ``device.pool.replay`` span per window whose
+# device work was replayed on a survivor (or the host backend) after a
+# failure, with ``device=<k>`` naming the chip that FAILED. ----
+SPAN_POOL_REPLAY = _span("device.pool.replay")
 
 # ---- io/parquet.py part-writer spans ----
 SPAN_PART_ENCODE = _span("parquet.part.encode")
@@ -137,6 +142,12 @@ C_BYTES_WRITTEN = _metric("parquet.bytes.written")
 C_PARTS_WRITTEN = _metric("parquet.parts.written")
 C_CANDIDATE_ROWS = _metric("realign.candidate_rows")
 C_POOL_PREWARM_COMPILES = _metric("device.pool.prewarm.compiles")
+# resilience counters: injected faults (utils/faults.point), retry
+# attempts actually taken (utils/retry.retry_call — 0 on a clean run),
+# and devices evicted from the pool after a spent retry budget
+C_FAULT_INJECTED = _metric("fault.injected")
+C_RETRY_ATTEMPTS = _metric("retry.attempts")
+C_DEVICE_EVICTED = _metric("device.evicted")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")

@@ -5,38 +5,110 @@ On a hosted/tunneled TPU the device link is the pipeline bottleneck
 row slices on a thread pool roughly doubles sustained throughput by
 keeping multiple transfer RPCs in flight. On directly-attached devices
 the chunking is harmless (PCIe/DMA is far faster than any of this).
+
+Every fetch is also a **resilience boundary** (docs/ROBUSTNESS.md):
+
+* a fault point (``device.fetch``) so the injection matrix can drive
+  the recovery paths deterministically;
+* a deadline watchdog (``ADAM_TPU_FETCH_TIMEOUT_S``, default 300 s,
+  ``0`` disables) so a hung transfer RPC surfaces as a retryable
+  :class:`~adam_tpu.utils.retry.DeadlineExceeded` instead of wedging
+  the run;
+* an internal retry-with-backoff for transient failures, so callers
+  only ever see a fetch error after the budget is spent — at which
+  point the device-eviction path (pipelines/streamed.py) takes over.
+
+Host-resident numpy inputs short-circuit all of it: the watchdog and
+retry wrap RPCs, not memcpys.
 """
 
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
+import threading
 
 import numpy as np
 
+from adam_tpu.utils import faults
+from adam_tpu.utils import retry as retry_mod
+
 _MIN_CHUNK_BYTES = 8 * 1024 * 1024
+_DEFAULT_FETCH_TIMEOUT_S = 300.0
 
 
 def _max_threads() -> int:
     """Fetch-pool thread cap: bounded by the cores this process may
-    actually run on.  The hosted environment schedules ONE core
-    (``os.sched_getaffinity(0) == {0}``); the old fixed cap of 8 made
-    every large fetch spin up 8 threads that competed with the
-    PartWriterPool's encode threads for that single core — transfer RPCs
-    release the GIL, but chunk reassembly and executor bookkeeping do
-    not."""
+    actually run on, **floored at 2**.  The hosted environment schedules
+    ONE core (``os.sched_getaffinity(0) == {0}``); the old fixed cap of
+    8 made every large fetch spin up 8 threads that competed with the
+    PartWriterPool's encode threads for that single core.  But the
+    chunked overlap is GIL-released RPC *wait*, not CPU work — capping
+    at the affinity count regressed the 1-core target to a serial fetch
+    and gave back the measured ~2x (ROADMAP "re-measure chunked
+    device_fetch under the affinity cap"), so the floor keeps two RPCs
+    in flight regardless of affinity."""
     try:
         n = len(os.sched_getaffinity(0))
     except (AttributeError, OSError):  # non-Linux fallback
         n = os.cpu_count() or 1
-    return max(1, min(8, n))
+    return max(2, min(8, n))
 
 
 _MAX_THREADS = _max_threads()
 
 
-def device_fetch(x, threads: int = _MAX_THREADS) -> np.ndarray:
-    """Fetch a (possibly device-resident) array to host numpy."""
+def _fetch_timeout_s() -> float:
+    """The fetch deadline (seconds; <= 0 disables the watchdog)."""
+    return retry_mod.env_float(
+        "ADAM_TPU_FETCH_TIMEOUT_S", _DEFAULT_FETCH_TIMEOUT_S
+    )
+
+
+def _map_daemon(fn, items: list) -> list:
+    """``ThreadPoolExecutor.map`` twin on daemon threads.  The chunked
+    fetch runs under the deadline watchdog, which ABANDONS it on
+    timeout — but concurrent.futures joins its (non-daemon) workers at
+    interpreter shutdown, so a genuinely hung RPC would wedge the
+    recovered process at exit.  Daemon threads cannot."""
+    results = [None] * len(items)
+    errs = [None] * len(items)
+
+    def run(k, item):
+        try:
+            results[k] = fn(item)
+        except BaseException as e:  # noqa: BLE001 — relayed below
+            errs[k] = e
+
+    threads = [
+        threading.Thread(target=run, args=(k, item), daemon=True,
+                         name="device-fetch-chunk")
+        for k, item in enumerate(items)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errs:
+        if e is not None:
+            raise e
+    return results
+
+
+def _resident_device(x):
+    """The device an array lives on (None when indeterminable) — the
+    fault point's ``device=K`` filter and eviction logs key on it."""
+    try:
+        d = getattr(x, "device", None)
+        if d is not None and not callable(d):
+            return d
+        return next(iter(x.devices()))
+    except Exception:
+        return None
+
+
+def _fetch_chunked(x, threads: int) -> np.ndarray:
+    """One fetch attempt (the pre-resilience device_fetch body)."""
+    faults.point("device.fetch", device=_resident_device(x))
     nbytes = getattr(x, "nbytes", 0)
     if nbytes < 2 * _MIN_CHUNK_BYTES or x.ndim == 0:
         return np.asarray(x)
@@ -46,6 +118,29 @@ def device_fetch(x, threads: int = _MAX_THREADS) -> np.ndarray:
         return np.asarray(x)
     bounds = [n * i // n_chunks for i in range(n_chunks + 1)]
     slices = [x[bounds[i]: bounds[i + 1]] for i in range(n_chunks)]
-    with ThreadPoolExecutor(n_chunks) as ex:
-        parts = list(ex.map(np.asarray, slices))
+    parts = _map_daemon(np.asarray, slices)
     return np.concatenate(parts, axis=0)
+
+
+def device_fetch(x, threads: int = _MAX_THREADS,
+                 deadline_s: float | None = None) -> np.ndarray:
+    """Fetch a (possibly device-resident) array to host numpy.
+
+    Device-resident inputs get the full resilience stack (deadline
+    watchdog + transient retry, module docstring); host numpy inputs
+    return as-is with none of it.  ``deadline_s`` overrides the
+    ``ADAM_TPU_FETCH_TIMEOUT_S`` default for this call.
+    """
+    if isinstance(x, np.ndarray):
+        return x
+    timeout = _fetch_timeout_s() if deadline_s is None else deadline_s
+
+    def attempt():
+        if timeout and timeout > 0:
+            return retry_mod.call_with_deadline(
+                lambda: _fetch_chunked(x, threads), timeout,
+                site="device.fetch",
+            )
+        return _fetch_chunked(x, threads)
+
+    return retry_mod.retry_call(attempt, site="device.fetch")
