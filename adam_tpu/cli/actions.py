@@ -239,6 +239,13 @@ class Transform(Command):
             "over re-shardable columnar stores)",
         )
         p.add_argument(
+            "--report", dest="report", default=None, metavar="PATH",
+            help="write the analyzer run report (per-device busy/idle "
+            "attribution, barrier decomposition, critical path, latency "
+            "quantiles — the 'adam-tpu analyze' view of this run) to "
+            "PATH on completion; -streaming only",
+        )
+        p.add_argument(
             "-streaming", action="store_true",
             help="run the transform as the streamed, overlapped windowed "
             "pipeline (ingest || device kernels || part-file writes; "
@@ -298,6 +305,24 @@ class Transform(Command):
             )
             return 0
 
+        # the observability sinks only the -streaming pipeline produces:
+        # warn up front (covers -shards AND the plain path) instead of
+        # exiting 0 with a silently missing artifact — main() already
+        # enabled recording for --report, so the mistake costs real time
+        if getattr(args, "report", None) and not args.streaming:
+            print(
+                "transform: --report is only produced by the -streaming "
+                f"pipeline; {args.report} will not be written (use "
+                "--metrics-json/--trace-out + 'adam-tpu analyze' for "
+                "other modes)",
+                file=sys.stderr,
+            )
+        if getattr(args, "progress", None) and not args.streaming:
+            print(
+                "transform: --progress heartbeat is emitted by the "
+                "-streaming pipeline only; no lines will be written",
+                file=sys.stderr,
+            )
         if args.shards and args.shards < 0:
             print(f"transform -shards must be positive (got {args.shards})",
                   file=sys.stderr)
@@ -370,10 +395,41 @@ class Transform(Command):
             else:
                 from adam_tpu.pipelines.streamed import transform_streamed
 
+                if getattr(args, "report", None):
+                    # pre-flight the report path BEFORE the (potentially
+                    # hours-long) run: a typo'd directory must fail in
+                    # milliseconds, not after the pipeline finishes
+                    try:
+                        with open(args.report, "a"):
+                            pass
+                    except OSError as e:
+                        print(f"transform: cannot write --report "
+                              f"{args.report}: {e}", file=sys.stderr)
+                        return 2
                 transform_streamed(
                     args.input, args.output,
-                    devices=getattr(args, "devices", None), **kw,
+                    devices=getattr(args, "devices", None),
+                    progress=getattr(args, "progress", None), **kw,
                 )
+                if getattr(args, "report", None):
+                    # the analyzer view of THIS run: trace-grade (gap
+                    # analysis + critical path) — main() enabled
+                    # recording because --report was passed, so the
+                    # global TRACE holds the absorbed run events
+                    from adam_tpu.utils import analyzer
+                    from adam_tpu.utils import telemetry as tele
+
+                    report = analyzer.analyze(tele.TRACE.to_chrome_trace())
+                    try:
+                        with open(args.report, "w") as fh:
+                            fh.write(analyzer.render_report(report) + "\n")
+                    except OSError as e:
+                        # the dataset is already written and valid: a
+                        # report-write failure (disk filled mid-run)
+                        # must not turn success into a crash
+                        print(f"transform: report write to "
+                              f"{args.report} failed: {e}",
+                              file=sys.stderr)
             return 0
 
         with ins.TIMERS.time(ins.LOAD_ALIGNMENTS):

@@ -53,6 +53,16 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         "show the streamed tokenize/dispatch/encode/write overlap)",
     )
     parser.add_argument(
+        "--progress", dest="progress", nargs="?", const="stderr",
+        default=None, metavar="PATH",
+        help="emit a live NDJSON progress heartbeat every few seconds "
+        "(windows done/total, reads/s, bytes written, per-device "
+        "in-flight depth, retry/fault/evict counters, ETA) to stderr, "
+        "or to PATH when given; also honored from ADAM_TPU_PROGRESS, "
+        "period from ADAM_TPU_PROGRESS_INTERVAL_S (streamed transform "
+        "only; schema in docs/OBSERVABILITY.md)",
+    )
+    parser.add_argument(
         "--devices", dest="devices", type=int, default=None, metavar="N",
         help="fan device work out over N attached chips (the streamed "
         "pipeline round-robins windows across them; default: all "
@@ -151,9 +161,11 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s: %(message)s",
     )
     # any observability sink switches recording on: the timer table, the
-    # JSON snapshot and the Chrome trace all read the same run
+    # JSON snapshot, the Chrome trace and the analyzer report all read
+    # the same run (--progress self-manages via the heartbeat instead)
     want_metrics = bool(
         args.print_metrics or args.metrics_json or args.trace_out
+        or getattr(args, "report", None)
     )
     ins.TIMERS.recording = want_metrics
     tele.TRACE.recording = want_metrics

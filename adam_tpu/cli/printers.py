@@ -300,6 +300,55 @@ class View(Command):
         return 0
 
 
+class Analyze(Command):
+    """Run report from a telemetry artifact (utils/analyzer.py): the
+    post-hoc half of the observability layer — per-device busy/idle
+    attribution, barrier decomposition, the critical path and latency
+    quantiles from a ``--metrics-json`` snapshot or ``--trace-out``
+    Chrome trace, no re-run required."""
+
+    name = "analyze"
+    description = ("Analyze a telemetry snapshot or Chrome trace into a "
+                   "run report (device utilization, barrier stalls, "
+                   "critical path, latency quantiles)")
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument(
+            "input", metavar="ARTIFACT",
+            help="a --metrics-json snapshot or --trace-out Chrome trace "
+            "(auto-detected; a trace additionally yields idle-gap "
+            "analysis and the critical path)",
+        )
+        p.add_argument(
+            "-json", dest="json_out", default=None, metavar="PATH",
+            help="also write the analysis as machine-readable JSON",
+        )
+
+    @classmethod
+    def run(cls, args):
+        import json
+
+        from adam_tpu.utils import analyzer
+
+        try:
+            doc = analyzer.load_document(args.input)
+            report = analyzer.analyze(doc)
+        except (OSError, ValueError) as e:
+            print(f"analyze: {e}", file=sys.stderr)
+            return 2
+        print(analyzer.render_report(report))
+        if args.json_out:
+            try:
+                with open(args.json_out, "w") as fh:
+                    json.dump(report, fh, indent=1, default=str)
+            except OSError as e:
+                print(f"analyze: cannot write {args.json_out}: {e}",
+                      file=sys.stderr)
+                return 2
+        return 0
+
+
 COMMANDS = [
     PrintAdam,
     PrintGenes,
@@ -309,4 +358,5 @@ COMMANDS = [
     AlleleCount,
     BuildInformation,
     View,
+    Analyze,
 ]

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -469,7 +470,18 @@ class PartWriterPool:
             finally:
                 release()
 
-        self._gate.acquire()  # backpressure: bound whole parts in flight
+        # backpressure: bound whole parts in flight.  The time the
+        # producer blocks here IS the writer-pool backpressure signal —
+        # a histogram (not a scalar) because one slow flush stalling a
+        # single submit looks identical to chronic starvation in a
+        # total, but not in the p99
+        rec = tele.TRACE.recording
+        t_gate = time.monotonic() if rec else 0.0
+        self._gate.acquire()
+        if rec:
+            tele.TRACE.observe(
+                tele.H_POOL_SUBMIT_WAIT, time.monotonic() - t_gate
+            )
         self._sample_depth(+1)
         try:
             self._futures.append(self._enc.submit(encode))

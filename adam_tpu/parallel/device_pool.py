@@ -131,14 +131,47 @@ def _attr_id(dev):
     return dev_id if dev_id is not None else str(dev)
 
 
+# Thread-local replay depth: the streamed recovery paths enter a
+# replay_scope() around each replayed window, so every nested dispatch/
+# fetch span — in ANY layer, without threading a flag through the bqsr/
+# markdup APIs — picks up a ``replay=1`` attr from span_attrs and
+# aggregates under the survivor's ``<k>:replay`` device_spans key
+# instead of conflating with its organic work.
+_REPLAY_TLS = threading.local()
+
+
+class replay_scope:
+    """Marks the current thread as replaying an evicted device's window
+    (reentrant; see :func:`span_attrs`)."""
+
+    def __enter__(self):
+        _REPLAY_TLS.depth = getattr(_REPLAY_TLS, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _REPLAY_TLS.depth -= 1
+        return False
+
+
+def in_replay() -> bool:
+    """True while the current thread is inside a :class:`replay_scope`."""
+    return getattr(_REPLAY_TLS, "depth", 0) > 0
+
+
 def span_attrs(device=None) -> dict:
     """Span attrs for a dispatch/fetch call site: ``{}`` on the
     single-device path (no attribution noise), ``{"device": <id>}``
-    otherwise.  The one helper every layer (markdup, bqsr, streamed)
-    shares, so per-chip attribution cannot diverge between passes."""
+    otherwise — plus ``replay=1`` inside a :class:`replay_scope`, so
+    replayed work aggregates under ``<k>:replay`` and never conflates
+    with the survivor's own occupancy.  The one helper every layer
+    (markdup, bqsr, streamed) shares, so per-chip attribution cannot
+    diverge between passes."""
     if device is None:
         return {}
-    return {"device": _attr_id(device)}
+    attrs = {"device": _attr_id(device)}
+    if in_replay():
+        attrs["replay"] = 1
+    return attrs
 
 
 def putter(device=None):

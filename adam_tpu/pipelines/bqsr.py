@@ -684,7 +684,8 @@ def apply_table_kernel(
     return jnp.where(apply_mask, new_q, quals).astype(jnp.uint8)
 
 
-def merge_observations(parts: list[tuple], replays=None) -> tuple:
+def merge_observations(parts: list[tuple], replays=None,
+                       tracer=None, window_ids=None) -> tuple:
     """Sum per-window (total, mism, gl) histograms into one global
     (total, mism, gl) — the host-side analog of the sharded psum.
 
@@ -693,6 +694,12 @@ def merge_observations(parts: list[tuple], replays=None) -> tuple:
     window's table.  Device-resident parts (the lazy ``device`` observe
     backend) are fetched here, at the barrier, via the chunked transfer
     helper — each is a compact [n_rg, 94, 2g+1, 17] table, never [N, L].
+    Each device-resident fetch records one ``device.fetch.observe``
+    span (``device=<k>`` + ``window=<i>`` attributed) on ``tracer``
+    (default: the global TRACE), so whether the n per-window fetches
+    serialize on the host thread at barrier 2 — the ROADMAP
+    "observe-fetch serialization" item — is directly measurable from a
+    trace instead of inferred from the barrier wall.
 
     ``replays``: optional per-part recovery hooks (parallel list; None
     entries = no hook).  When a part's fetch still fails after the
@@ -701,9 +708,16 @@ def merge_observations(parts: list[tuple], replays=None) -> tuple:
     pipeline's hook evicts the failed device and recomputes the window
     on a survivor or the host backend, so a dead chip costs one window
     replay instead of the whole run.
-    """
-    from adam_tpu.utils.transfer import device_fetch
 
+    ``window_ids``: optional parallel list of true window indices for
+    the span attribution — residual windows drop out of ``parts``, so
+    the part position ``k`` is NOT the window index whenever any
+    window had zero valid rows.
+    """
+    from adam_tpu.parallel.device_pool import span_attrs
+    from adam_tpu.utils.transfer import _resident_device, device_fetch
+
+    tr = tracer if tracer is not None else _tele.TRACE
     gl = max(p[2] for p in parts)
     n_cyc = 2 * gl + 1
     s0 = parts[0][0].shape  # .shape is metadata — no transfer
@@ -712,8 +726,18 @@ def merge_observations(parts: list[tuple], replays=None) -> tuple:
     mism = np.zeros(shape, np.int64)
     for k, (t, m, g) in enumerate(parts):
         try:
-            tt = device_fetch(t)
-            mm = device_fetch(m)
+            if isinstance(t, np.ndarray):
+                # host-resident part (host backend or a replayed
+                # window): nothing crosses the device link — no span,
+                # or the "fetch" attribution would count memcpys
+                tt = device_fetch(t)
+                mm = device_fetch(m)
+            else:
+                attrs = span_attrs(_resident_device(t))
+                win = window_ids[k] if window_ids is not None else k
+                with tr.span(_tele.SPAN_OBS_FETCH, window=win, **attrs):
+                    tt = device_fetch(t)
+                    mm = device_fetch(m)
         except Exception as e:
             replay = replays[k] if replays is not None else None
             if replay is None:

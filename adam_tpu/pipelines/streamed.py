@@ -145,6 +145,65 @@ def _write_part(out_dir: str, part_idx: int, ds: AlignmentDataset,
     )
 
 
+def _start_heartbeat(tr: tele.Tracer, progress: Optional[str]):
+    """Build+start the live progress heartbeat, or None (the default —
+    zero construction, the spans' disabled-overhead contract).
+
+    Samples the run tracer AND the global TRACE (parquet's byte/part
+    counters land on the latter); when no other observability sink
+    already enabled global recording, it is flipped on for the
+    heartbeat's lifetime and :func:`_stop_heartbeat` restores the flag
+    AND resets the tracer — a ``--progress``-only run neither exports
+    nor accumulates global telemetry, so back-to-back library runs in
+    one process can't sum each other's counters into the beat."""
+    sink = progress if progress is not None else tele.progress_sink_from_env()
+    if not sink:
+        return None
+    hb = tele.Heartbeat([tr, tele.TRACE], sink)
+    hb._hb_restore_recording = not tele.TRACE.recording
+    if hb._hb_restore_recording:
+        tele.TRACE.recording = True
+    hb.start()
+    return hb
+
+
+def _stop_heartbeat(hb, ok: bool = True) -> None:
+    """Idempotent heartbeat teardown (final ``done`` line + recording
+    restore) — called from the normal finish path *before* the run
+    tracer folds into the global TRACE (a post-absorb sample would
+    double-count every counter) and again from the wrapper's
+    ``finally`` for the exception paths, which pass ``ok=False`` so
+    the final line does not read as a completed run."""
+    if hb is None:
+        return
+    hb.stop(ok=ok)
+    if getattr(hb, "_hb_restore_recording", False):
+        tele.TRACE.recording = False
+        # recording was OFF before this run, so nothing else is reading
+        # the global tracer: drop what the heartbeat window recorded
+        # into it, or a later run in the same process (library use,
+        # tests) would sum this run's parquet counters into its own
+        tele.TRACE.reset()
+        hb._hb_restore_recording = False
+
+
+def _inflight_per_device(queues: list) -> dict:
+    """Heartbeat provider body: per-device in-flight depth sampled from
+    the live dispatch deques (read-only; a concurrent mutation mid-
+    iteration just skips this beat — the next one resamples)."""
+    per: dict = {}
+    for dq, dev_idx in queues:
+        try:
+            items = list(dq)
+        except RuntimeError:
+            continue
+        for item in items:
+            dev = item[dev_idx]
+            key = "default" if dev is None else str(dp_mod._attr_id(dev))
+            per[key] = per.get(key, 0) + 1
+    return per
+
+
 def transform_streamed(
     path: str,
     out_path: str,
@@ -164,6 +223,7 @@ def transform_streamed(
     max_target_size: int | None = None,
     dump_observations: Optional[str] = None,
     devices: Optional[int] = None,
+    progress: Optional[str] = None,
 ) -> dict:
     """Run the flagship transform as a streamed, overlapped pipeline.
 
@@ -174,17 +234,77 @@ def transform_streamed(
     ``devices`` caps the device-pool fan-out (default: every attached
     device, or ``ADAM_TPU_DEVICES``); only the ``device`` backend uses
     it, and ``devices=1`` is exactly the single-chip path.
-    """
-    from adam_tpu.pipelines import bqsr as bqsr_mod
-    from adam_tpu.pipelines import markdup as md_mod
-    from adam_tpu.pipelines import realign as realign_mod
 
+    ``progress`` names a live-heartbeat sink (``"stderr"`` or a file
+    path; default: ``ADAM_TPU_PROGRESS``, off when unset): a daemon
+    thread emits one NDJSON line (schema
+    :data:`~adam_tpu.utils.telemetry.HEARTBEAT_FIELDS`) every
+    ``ADAM_TPU_PROGRESS_INTERVAL_S`` seconds.
+    """
     # Per-run tracer, ALWAYS recording: the returned stats dict is a
     # derived view of its span data (telemetry.streamed_stats_view), so
     # the two can never disagree.  The handful of stage/window spans it
     # records per run is negligible next to the work; it folds into the
     # global TRACE at the end when telemetry is enabled.
     tr = tele.Tracer(recording=True)
+    hb = _start_heartbeat(tr, progress)
+    try:
+        return _transform_streamed_impl(
+            path, out_path, tr, hb,
+            mark_duplicates=mark_duplicates, recalibrate=recalibrate,
+            realign=realign, known_snps=known_snps,
+            known_indels=known_indels, consensus_model=consensus_model,
+            window_reads=window_reads, compression=compression,
+            n_writers=n_writers, max_indel_size=max_indel_size,
+            max_consensus_number=max_consensus_number,
+            lod_threshold=lod_threshold, max_target_size=max_target_size,
+            dump_observations=dump_observations, devices=devices,
+        )
+    except BaseException:
+        # crashed run: the final heartbeat line must carry ok=false —
+        # a tailing consumer reading done=true as "completed" would
+        # otherwise mark a failed run green
+        _stop_heartbeat(hb, ok=False)
+        raise
+    finally:
+        # normal completion already stopped it (inside _finish_trace,
+        # before the absorb); this is a no-op backstop
+        _stop_heartbeat(hb)
+
+
+def _transform_streamed_impl(
+    path: str,
+    out_path: str,
+    tr: tele.Tracer,
+    hb,
+    *,
+    mark_duplicates: bool,
+    recalibrate: bool,
+    realign: bool,
+    known_snps,
+    known_indels,
+    consensus_model: str,
+    window_reads: int,
+    compression: str,
+    n_writers: int,
+    max_indel_size: int | None,
+    max_consensus_number: int | None,
+    lod_threshold: float | None,
+    max_target_size: int | None,
+    dump_observations: Optional[str],
+    devices: Optional[int],
+) -> dict:
+    from adam_tpu.pipelines import bqsr as bqsr_mod
+    from adam_tpu.pipelines import markdup as md_mod
+    from adam_tpu.pipelines import realign as realign_mod
+
+    # live in-flight deques the heartbeat provider samples: (deque,
+    # index of the device element in its items)
+    hb_queues: list = []
+    if hb is not None:
+        hb.set_provider(
+            lambda: {"inflight_per_device": _inflight_per_device(hb_queues)}
+        )
     t_start_ns = time.monotonic_ns()
     stats: dict = {}
     # one backend decision for every per-residue pass in this run: the
@@ -299,6 +419,7 @@ def transform_streamed(
     # and the duplicate resolve is bitwise independent of n
     md_depth = 2 if dpool is None else 2 * dpool.n
     pend_cols: deque = deque()
+    hb_queues.append((pend_cols, 2))  # items: (win, ds, dev, cols)
 
     def _md_dispatch(win, batch):
         """Dispatch one window's [N, L] markdup reductions -> (device,
@@ -323,7 +444,8 @@ def transform_streamed(
                 # evict the chip and replay the window's reductions on
                 # a survivor (the loop re-fetches), host when none left
                 with tr.span(tele.SPAN_POOL_REPLAY, window=win,
-                             **dp_mod.span_attrs(dev)):
+                             **dp_mod.span_attrs(dev)), \
+                        dp_mod.replay_scope():
                     _evict_or_lose(dev, e)
                     nxt = _md_dispatch(win, ds.batch)
                 if nxt is None:
@@ -349,7 +471,12 @@ def transform_streamed(
                 ds = AlignmentDataset(batch, side, header)
                 windows.append(ds)
                 win = len(windows) - 1
-                n_reads += int(batch.valid.sum())
+                # reads counted PER WINDOW (not once at pass-A exit):
+                # the live heartbeat's reads/s derives from this counter
+                # mid-ingest; the end-of-run total is identical
+                n_window_reads = int(batch.valid.sum())
+                n_reads += n_window_reads
+                tr.count(tele.C_READS_INGESTED, n_window_reads)
                 tr.count(tele.C_WINDOWS_INGESTED)
                 if dpool is not None and win == 0:
                     # compile the grid-quantized kernel set once per
@@ -411,13 +538,14 @@ def transform_streamed(
             abort.set()
             raise
         ingest.join()
-    tr.count(tele.C_READS_INGESTED, n_reads)
     stats["n_reads"] = n_reads
+    if hb is not None:
+        hb.set_total(len(windows))
     if header is None or not windows:
         tr.add_span(tele.SPAN_TOTAL, t_start_ns,
                     time.monotonic_ns() - t_start_ns)
         stats.update(tele.streamed_stats_view(tr.snapshot()))
-        _finish_trace(tr, stats)
+        _finish_trace(tr, stats, hb)
         return stats
 
     # ---- barrier 1: resolve duplicates + merge targets ----------------
@@ -450,6 +578,10 @@ def transform_streamed(
         window_valid: list[int] = []
         obs_parts = []
         obs_replays = []
+        # true window index per part, for the barrier-2 fetch spans:
+        # residual windows drop out of obs_parts, so the part position
+        # is not the window index
+        obs_windows = []
         for i, w in enumerate(windows):
             n_valid = w.batch.n_rows
             if targets:
@@ -480,7 +612,7 @@ def transform_streamed(
 
         def replay(exc):
             with tr.span(tele.SPAN_POOL_REPLAY, window=i,
-                         **dp_mod.span_attrs(dev)):
+                         **dp_mod.span_attrs(dev)), dp_mod.replay_scope():
                 _evict_or_lose(dev, exc)
                 return _on_survivors(i, on_device, lambda: _observe_host(w))
 
@@ -523,6 +655,7 @@ def transform_streamed(
                         part, replay = _observe_window(i, w)
                         obs_parts.append(part)
                         obs_replays.append(replay)
+                        obs_windows.append(i)
 
     # ---- tail: realign the gathered candidates (observing remainders
     # under the device wait), then observe the realigned part with its
@@ -547,6 +680,7 @@ def transform_streamed(
             part, replay = _observe_window(len(windows), realigned)
             obs_parts.append(part)
             obs_replays.append(replay)
+            obs_windows.append(len(windows))
         # subtract the observe wall from the tail ONLY when realign
         # reports it genuinely ran under the sweeps' device drain — on
         # the serial paths (Python fallback, no dispatched sweeps) the
@@ -575,7 +709,8 @@ def transform_streamed(
         )
         with tr.span(tele.SPAN_OBS_MERGE):
             total, mism, gl = bqsr_mod.merge_observations(
-                obs_parts, replays=obs_replays
+                obs_parts, replays=obs_replays, tracer=tr,
+                window_ids=obs_windows,
             )
         if n_dev_parts:
             tr.count(tele.C_DEVICE_FETCHED, n_dev_parts)
@@ -612,6 +747,12 @@ def transform_streamed(
     parts.extend(
         (i, w) for i, w in enumerate(windows) if window_valid[i]
     )
+    if hb is not None:
+        # the real part count (residual windows drop out, the realigned
+        # part joins): the heartbeat's ETA extrapolates parts_written
+        # against this — windows_total itself stays the pass-A window
+        # count, so a progress ratio can never exceed 1
+        hb.set_parts_total(len(parts))
     # 3 parts in flight: one writing, one encoding, one being applied/
     # submitted — each stage's resource stays busy without the pool
     # pinning more than 3 decoded windows
@@ -688,6 +829,7 @@ def transform_streamed(
                 # on chip B runs while window j fetches from chip A
                 apply_depth = 2 if dpool is None else 2 * dpool.n
                 pend_q: deque = deque()
+                hb_queues.append((pend_q, 1))  # items: (idx, dev, handle)
 
                 def _host_apply(w):
                     return bqsr_mod.apply_recalibration(
@@ -712,7 +854,8 @@ def transform_streamed(
                         return bqsr_mod.apply_recalibration_finish(h)
 
                     with tr.span(tele.SPAN_POOL_REPLAY, window=p_idx,
-                                 **dp_mod.span_attrs(dev)):
+                                 **dp_mod.span_attrs(dev)), \
+                            dp_mod.replay_scope():
                         _evict_or_lose(dev, exc)
                         return _on_survivors(
                             p_idx, on_device, lambda: _host_apply(w)
@@ -797,17 +940,22 @@ def transform_streamed(
     # Timing keys are a DERIVED VIEW of the run tracer's span data —
     # the span-derived view and the stats dict cannot disagree.
     stats.update(tele.streamed_stats_view(tr.snapshot()))
-    _finish_trace(tr, stats)
+    _finish_trace(tr, stats, hb)
     return stats
 
 
-def _finish_trace(tr: tele.Tracer, stats: dict) -> None:
-    """End-of-run telemetry plumbing: mirror the derived stage walls
-    into the named-timer registry (so ``-print_metrics`` decomposes the
-    streamed flagship the way the reference's Metrics listener
-    decomposes a Spark job) and fold the run tracer's events/metrics
-    into the global TRACE when telemetry is enabled."""
+def _finish_trace(tr: tele.Tracer, stats: dict, hb=None) -> None:
+    """End-of-run telemetry plumbing: stop the heartbeat (BEFORE the
+    absorb below — a post-absorb sample would double-count every
+    counter the run tracer shares with the global TRACE), mirror the
+    derived stage walls into the named-timer registry (so
+    ``-print_metrics`` decomposes the streamed flagship the way the
+    reference's Metrics listener decomposes a Spark job) and fold the
+    run tracer's events/metrics into the global TRACE when telemetry
+    is enabled."""
     from adam_tpu.utils import instrumentation as ins
+
+    _stop_heartbeat(hb)
 
     for key, label in (
         ("prewarm_s", "Streamed Device Prewarm (per-device compiles)"),

@@ -1,0 +1,508 @@
+"""Run analyzer: attribution + critical path over telemetry artifacts.
+
+The flight recorder (utils/telemetry.py) answers "what happened"; this
+module answers the ROADMAP's measurement questions from a finished run
+artifact — no re-run required:
+
+* **Per-device wall-time attribution** — gap analysis over the
+  ``device=<k>`` span tracks of a Chrome-trace export: busy (union of
+  the device's dispatch/fetch/compile intervals, clamped to the run
+  window), idle (wall minus busy — where chips sit between
+  double-buffered windows), fetch (the ``*.fetch*`` subset) and replay
+  (recovery wall: a survivor's re-run windows via the ``replay=1``
+  attribution, an evicted chip's ``device.pool.replay`` umbrellas).
+  Evicted devices stay in the report — their pre-eviction spans keep
+  their original key (telemetry ``device_spans`` contract).
+* **Barrier stall decomposition** — pass A ingest vs barrier-1 resolve
+  vs barrier-2 observe-fetch/solve vs pass C and the write tail, as
+  disjoint stage walls plus their fraction of the run.
+* **Window-level critical path** — the Dapper-style last-finisher
+  chain walked backward from the last event: at each step, the edge to
+  the event that finished latest before the current one started.  The
+  top-N longest edges name the spans (with their ``window=`` attrs)
+  that bound the run wall — shaving anything else cannot shorten it.
+* **Latency histograms** — per-span-name p50/p90/p99 (from the
+  snapshot's ``histograms`` section, or rebuilt from trace events with
+  the same fixed log-spaced buckets), because synchronized multi-device
+  pipelines are governed by tails, not means (Dean & Barroso).
+
+Two input shapes, one report: a ``--metrics-json`` snapshot (aggregate
+mode — exact totals, no gap analysis) or a ``--trace-out`` Chrome trace
+(event mode — true interval unions and the critical path).  Exposed as
+``adam-tpu analyze <artifact.json>``, as ``--report PATH`` on the
+streamed transform, and embedded by ``bench.py`` (the ``utilization``
+key) so every bench artifact lands with attribution built in.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from adam_tpu.utils import telemetry as tele
+
+#: Span-name fragments that classify a device-attributed event as a
+#: device->host fetch (the barrier-2 / pass-C transfer side).
+_FETCH_MARK = ".fetch"
+
+#: The replay umbrella: wall a device's FAILURE caused (recorded
+#: against the failed chip; the survivor's re-run work carries
+#: ``replay=1`` instead).
+_REPLAY_SPAN = tele.SPAN_POOL_REPLAY
+
+#: Stage spans whose union is the whole streamed run — the barrier
+#: decomposition rows, in pipeline order.
+_STAGES = (
+    ("pass_a_ingest", tele.SPAN_PASS_A),
+    ("barrier1_resolve", tele.SPAN_RESOLVE),
+    ("pass_b_split", tele.SPAN_SPLIT),
+    ("observe", tele.SPAN_OBSERVE),
+    ("tail_realign", tele.SPAN_TAIL),
+    ("barrier2_observe_fetch", tele.SPAN_OBS_MERGE),
+    ("barrier2_solve", tele.SPAN_SOLVE),
+    ("pass_c_apply", tele.SPAN_PASS_C),
+    ("write_tail", tele.SPAN_WRITE_WAIT),
+)
+
+
+def load_document(path: str) -> dict:
+    """Read a telemetry artifact (snapshot or Chrome trace) from disk."""
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def document_kind(doc: dict) -> str:
+    """``"trace"`` (Chrome trace-event JSON) or ``"snapshot"``
+    (``--metrics-json`` / ``Tracer.snapshot()`` shape)."""
+    if "traceEvents" in doc:
+        return "trace"
+    if "spans" in doc or "device_spans" in doc:
+        return "snapshot"
+    raise ValueError(
+        "not a telemetry artifact: expected a Chrome trace "
+        "('traceEvents') or a metrics snapshot ('spans')"
+    )
+
+
+# --------------------------------------------------------------------------
+# Trace-event plumbing
+# --------------------------------------------------------------------------
+def _trace_spans(doc: dict) -> list:
+    """Normalized complete events: [{name, start, end, dur, args}] in
+    seconds, de-duplicated of the per-chip mirror copies (to_chrome_trace
+    emits every device-attributed span twice — once on its host-thread
+    track, once on its ``device:<k>`` track; attribution must count each
+    interval ONCE).  Mirrors carry ``cat = CHROME_MIRROR_CAT``; traces
+    from before that marker existed fall back to a timestamp-identity
+    dedup restricted to device-attributed events (the only ones that
+    ever had mirrors)."""
+    evs = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    has_marker = any(e.get("cat") == tele.CHROME_MIRROR_CAT for e in evs)
+    out = []
+    seen = set()
+    for e in evs:
+        if e.get("cat") == tele.CHROME_MIRROR_CAT:
+            continue
+        if (
+            not has_marker
+            and (e.get("args") or {}).get("device") is not None
+        ):
+            key = (e.get("name"), e.get("ts"), e.get("dur"), e.get("pid"))
+            if key in seen:
+                continue
+            seen.add(key)
+        start = e["ts"] / 1e6
+        dur = e.get("dur", 0.0) / 1e6
+        out.append({
+            "name": e["name"],
+            "start": start,
+            "end": start + dur,
+            "dur": dur,
+            "args": e.get("args") or {},
+        })
+    out.sort(key=lambda s: (s["start"], s["end"]))
+    return out
+
+
+def _union_seconds(intervals: list, lo: float, hi: float) -> float:
+    """Total covered wall of [start, end) intervals clamped to
+    [lo, hi] — nested/overlapping spans (a dispatch under its replay
+    umbrella, double-buffered fetch under pass C) must not double
+    count."""
+    clipped = sorted(
+        (max(s, lo), min(e, hi)) for s, e in intervals if min(e, hi) > max(s, lo)
+    )
+    total = 0.0
+    cur_s = cur_e = None
+    for s, e in clipped:
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def _run_window(spans: list) -> tuple:
+    """The run's [start, end] on the trace clock: the ``streamed.total``
+    span when present (the pipeline wall), else the event envelope."""
+    for s in spans:
+        if s["name"] == tele.SPAN_TOTAL:
+            return s["start"], s["end"]
+    if not spans:
+        return 0.0, 0.0
+    return (
+        min(s["start"] for s in spans),
+        max(s["end"] for s in spans),
+    )
+
+
+# --------------------------------------------------------------------------
+# Per-device attribution
+# --------------------------------------------------------------------------
+def _devices_from_trace(spans: list, lo: float, hi: float) -> dict:
+    per: dict = {}
+
+    def slot(key):
+        return per.setdefault(str(key), {
+            "busy": [], "fetch": [], "replay": [], "evicted": False,
+            "n_spans": 0,
+        })
+
+    for s in spans:
+        dev = s["args"].get("device")
+        if dev is None:
+            continue
+        d = slot(dev)
+        d["n_spans"] += 1
+        iv = (s["start"], s["end"])
+        if s["name"] == _REPLAY_SPAN:
+            # the umbrella on the FAILED chip: recovery wall its death
+            # caused, not work it performed
+            d["replay"].append(iv)
+            d["evicted"] = True
+            continue
+        d["busy"].append(iv)
+        if s["args"].get("replay"):
+            d["replay"].append(iv)
+        if _FETCH_MARK in s["name"]:
+            d["fetch"].append(iv)
+
+    wall = max(hi - lo, 0.0)
+    out = {}
+    for dev, d in sorted(per.items()):
+        busy = _union_seconds(d["busy"], lo, hi)
+        out[dev] = {
+            "busy_s": round(busy, 6),
+            "idle_s": round(max(0.0, wall - busy), 6),
+            "fetch_s": round(_union_seconds(d["fetch"], lo, hi), 6),
+            "replay_s": round(_union_seconds(d["replay"], lo, hi), 6),
+            "busy_frac": round(busy / wall, 4) if wall > 0 else None,
+            "evicted": d["evicted"],
+            "n_spans": d["n_spans"],
+        }
+    return out
+
+
+def _devices_from_snapshot(snap: dict, wall: Optional[float]) -> dict:
+    """Aggregate-mode attribution from ``device_spans``: exact totals
+    (no interval union — concurrent spans on one device sum past wall
+    only if the pipeline genuinely overlaps them, which the streamed
+    double buffer does not within one chip).  Survivors' replayed work
+    arrives under the ``<k>:replay`` keys (telemetry ``_record``) and
+    folds into device ``k``'s row as ``replay_s``."""
+    per: dict = {}
+
+    def slot(key):
+        return per.setdefault(str(key), {
+            "busy_s": 0.0, "fetch_s": 0.0, "replay_s": 0.0,
+            "evicted": False, "n_spans": 0,
+        })
+
+    for name, by_dev in (snap.get("device_spans") or {}).items():
+        for dkey, agg in by_dev.items():
+            dkey = str(dkey)
+            total = agg["total_s"]
+            if dkey.endswith(":replay"):
+                d = slot(dkey[: -len(":replay")])
+                d["busy_s"] += total
+                d["replay_s"] += total
+                d["n_spans"] += agg["count"]
+                if _FETCH_MARK in name:
+                    d["fetch_s"] += total
+                continue
+            d = slot(dkey)
+            d["n_spans"] += agg["count"]
+            if name == _REPLAY_SPAN:
+                d["replay_s"] += total
+                d["evicted"] = True
+                continue
+            d["busy_s"] += total
+            if _FETCH_MARK in name:
+                d["fetch_s"] += total
+
+    out = {}
+    for dev, d in sorted(per.items()):
+        busy = d["busy_s"]
+        out[dev] = {
+            "busy_s": round(busy, 6),
+            "idle_s": (
+                round(max(0.0, wall - busy), 6) if wall is not None
+                else None
+            ),
+            "fetch_s": round(d["fetch_s"], 6),
+            "replay_s": round(d["replay_s"], 6),
+            "busy_frac": (
+                round(busy / wall, 4) if wall else None
+            ),
+            "evicted": d["evicted"],
+            "n_spans": d["n_spans"],
+        }
+    return out
+
+
+# --------------------------------------------------------------------------
+# Barrier decomposition
+# --------------------------------------------------------------------------
+def _stage_decomposition(span_totals: dict, wall: Optional[float]) -> dict:
+    out = {}
+    for key, name in _STAGES:
+        t = span_totals.get(name)
+        if t is None:
+            continue
+        row = {"total_s": round(t, 6)}
+        if wall:
+            row["frac"] = round(t / wall, 4)
+        out[key] = row
+    return out
+
+
+# --------------------------------------------------------------------------
+# Critical path
+# --------------------------------------------------------------------------
+def _critical_path(spans: list, top_n: int = 5) -> dict:
+    """Last-finisher chain: from the event that ends last, repeatedly
+    step to the event that finished latest before the current one
+    started — the chain of spans the run's end actually waited on.
+    Edge weight = how much of the wall the step accounts for
+    (``cur.end - pred.end``, i.e. the current span's exposed duration
+    plus any scheduling gap)."""
+    nodes = [s for s in spans if s["name"] != tele.SPAN_TOTAL and s["dur"] > 0]
+    if not nodes:
+        return {"edges": [], "length_s": 0.0, "n_nodes": 0}
+    by_end = sorted(nodes, key=lambda s: s["end"])
+    ends = [s["end"] for s in by_end]
+    import bisect
+
+    def label(s):
+        w = s["args"].get("window")
+        return f"{s['name']}[w{w}]" if w is not None else s["name"]
+
+    cur = by_end[-1]
+    chain = [cur]
+    edges = []
+    # bounded walk: every step moves strictly earlier, so the chain is
+    # at most len(nodes) long
+    for _ in range(len(nodes)):
+        i = bisect.bisect_right(ends, cur["start"]) - 1
+        # skip self-matches at identical timestamps
+        while i >= 0 and by_end[i] is cur:
+            i -= 1
+        if i < 0:
+            break
+        pred = by_end[i]
+        edges.append({
+            "from": label(pred),
+            "to": label(cur),
+            "edge_s": round(cur["end"] - pred["end"], 6),
+            "gap_s": round(max(0.0, cur["start"] - pred["end"]), 6),
+        })
+        cur = pred
+        chain.append(cur)
+    length = chain[0]["end"] - chain[-1]["start"]
+    top = sorted(edges, key=lambda e: -e["edge_s"])[:top_n]
+    return {
+        "edges": top,
+        "length_s": round(length, 6),
+        "n_nodes": len(chain),
+    }
+
+
+# --------------------------------------------------------------------------
+# Entry points
+# --------------------------------------------------------------------------
+def _hist_rows(hists: dict) -> dict:
+    return {
+        name: {
+            "count": h.get("count", 0),
+            "p50": h.get("p50"),
+            "p90": h.get("p90"),
+            "p99": h.get("p99"),
+            "max": h.get("max"),
+        }
+        for name, h in sorted(hists.items())
+        if h.get("count")
+    }
+
+
+def _hists_from_events(spans: list) -> dict:
+    """Rebuild per-span-name duration histograms from trace events with
+    telemetry's fixed buckets — a trace captured before the histogram
+    layer existed still yields quantiles."""
+    hists: dict = {}
+    for s in spans:
+        h = hists.setdefault(s["name"], tele._new_hist())
+        tele._hist_observe(h, s["dur"])
+    return {k: tele.hist_summary(v) for k, v in hists.items()}
+
+
+def analyze(doc: dict) -> dict:
+    """Analyze one telemetry artifact into the run report dict."""
+    kind = document_kind(doc)
+    if kind == "trace":
+        spans = _trace_spans(doc)
+        lo, hi = _run_window(spans)
+        wall = max(hi - lo, 0.0)
+        totals: dict = {}
+        for s in spans:
+            totals[s["name"]] = totals.get(s["name"], 0.0) + s["dur"]
+        devices = _devices_from_trace(spans, lo, hi)
+        cpath = _critical_path(spans)
+        # event-rebuilt duration quantiles as the floor, overridden by
+        # the exact histogram section a telemetry-written trace embeds
+        # (explicit observe() metrics never appear as events, and the
+        # embedded aggregates survive ring eviction)
+        hists = {**_hists_from_events(spans), **(doc.get("histograms") or {})}
+    else:
+        span_sec = {
+            k: v["total_s"] for k, v in (doc.get("spans") or {}).items()
+        }
+        wall = span_sec.get(tele.SPAN_TOTAL)
+        totals = span_sec
+        devices = _devices_from_snapshot(doc, wall)
+        cpath = None  # aggregates carry no timestamps to chain
+        hists = doc.get("histograms") or {}
+    counters = doc.get("counters") or {}
+    report = {
+        "kind": kind,
+        "events_evicted": doc.get("events_evicted", 0) or 0,
+        "wall_s": round(wall, 6) if wall is not None else None,
+        "devices": devices,
+        "stages": _stage_decomposition(totals, wall),
+        "histograms": _hist_rows(hists),
+        "counters": {
+            k: counters[k]
+            for k in (
+                tele.C_READS_INGESTED, tele.C_WINDOWS_INGESTED,
+                tele.C_PARTS_WRITTEN, tele.C_BYTES_WRITTEN,
+                tele.C_RETRY_ATTEMPTS, tele.C_FAULT_INJECTED,
+                tele.C_DEVICE_EVICTED,
+            )
+            if k in counters
+        },
+    }
+    if cpath is not None:
+        report["critical_path"] = cpath
+    return report
+
+
+def utilization_from_snapshot(snap: dict) -> dict:
+    """Just the per-device utilization section from a snapshot — what
+    ``bench.py`` embeds next to each artifact's telemetry key (the CPU
+    baseline's empty ``device_spans`` yields ``{}``, key-stable)."""
+    wall = (snap.get("spans") or {}).get(tele.SPAN_TOTAL, {}).get("total_s")
+    return {
+        "wall_s": round(wall, 6) if wall is not None else None,
+        "devices": _devices_from_snapshot(snap, wall),
+    }
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+
+def render_report(report: dict) -> str:
+    """The human-readable run report (``adam-tpu analyze`` stdout)."""
+    out = []
+    wall = report.get("wall_s")
+    out.append(
+        f"Run report ({report['kind']} mode) — wall {_fmt_s(wall)} s"
+    )
+    out.append("=" * len(out[0]))
+    evicted = report.get("events_evicted")
+    if evicted and report["kind"] == "trace":
+        out += ["", f"WARNING: {evicted} oldest events were evicted from "
+                "the flight-recorder ring before export — busy/idle "
+                "attribution and the critical path undercount the early "
+                "run (raise ADAM_TPU_TRACE_EVENTS or analyze the "
+                "--metrics-json snapshot, whose aggregates are exact)"]
+    devs = report.get("devices") or {}
+    if devs:
+        out += ["", "Per-device attribution"]
+        hdr = (
+            f"{'device':>10}  {'busy_s':>9}  {'idle_s':>9}  {'fetch_s':>9}"
+            f"  {'replay_s':>9}  {'busy%':>6}  {'evicted':>7}"
+        )
+        out += [hdr, "-" * len(hdr)]
+        for dev, d in devs.items():
+            frac = d.get("busy_frac")
+            out.append(
+                f"{dev:>10}  {_fmt_s(d['busy_s']):>9}"
+                f"  {_fmt_s(d['idle_s']):>9}  {_fmt_s(d['fetch_s']):>9}"
+                f"  {_fmt_s(d['replay_s']):>9}"
+                f"  {f'{frac * 100:.1f}' if frac is not None else '-':>6}"
+                f"  {'yes' if d['evicted'] else 'no':>7}"
+            )
+    else:
+        out += ["", "Per-device attribution: (no device-attributed spans "
+                "— single-device or host-backend run)"]
+    stages = report.get("stages") or {}
+    if stages:
+        out += ["", "Stage / barrier decomposition"]
+        w = max(len(k) for k in stages)
+        for key, row in stages.items():
+            frac = row.get("frac")
+            pct = f"  ({frac * 100:5.1f}%)" if frac is not None else ""
+            out.append(
+                f"  {key.ljust(w)}  {_fmt_s(row['total_s']):>9} s{pct}"
+            )
+    cpath = report.get("critical_path")
+    if cpath:
+        out += ["", f"Critical path (top {len(cpath['edges'])} edges of a "
+                f"{cpath['n_nodes']}-node chain, {_fmt_s(cpath['length_s'])}"
+                " s)"]
+        for e in cpath["edges"]:
+            out.append(
+                f"  {e['from']} -> {e['to']}: {_fmt_s(e['edge_s'])} s"
+                f" (gap {_fmt_s(e['gap_s'])} s)"
+            )
+    hists = report.get("histograms") or {}
+    if hists:
+        out += ["", "Latency histograms (seconds)"]
+        w = max(len(k) for k in hists)
+        hdr = (
+            f"  {'name'.ljust(w)}  {'count':>7}  {'p50':>9}  {'p90':>9}"
+            f"  {'p99':>9}  {'max':>9}"
+        )
+        out += [hdr]
+        for name, h in hists.items():
+            out.append(
+                f"  {name.ljust(w)}  {h['count']:>7}"
+                f"  {_fmt_s(h['p50']):>9}  {_fmt_s(h['p90']):>9}"
+                f"  {_fmt_s(h['p99']):>9}  {_fmt_s(h['max']):>9}"
+            )
+    counters = report.get("counters") or {}
+    if counters:
+        out += ["", "Counters"]
+        w = max(len(k) for k in counters)
+        for k, v in sorted(counters.items()):
+            out.append(f"  {k.ljust(w)}  {v}")
+    return "\n".join(out)
+
+
+def analyze_path(path: str) -> dict:
+    """Convenience: load + analyze one artifact file."""
+    return analyze(load_document(path))

@@ -1,4 +1,5 @@
-"""Structured tracing + metrics: spans, counters, gauges, flight recorder.
+"""Structured tracing + metrics: spans, counters, gauges, histograms,
+flight recorder, live heartbeat.
 
 The observability layer the reference gets from bdg-utils ``Metrics`` +
 Spark's listener-decomposed stage/task timings
@@ -20,6 +21,15 @@ single mutex, read-modify-write only under it):
   encoded/written, device windows dispatched/fetched).
 * **gauges** — sampled values with last/min/max/n (writer-pool queue
   depth at submit/drain, device dispatch in-flight).
+* **histograms** — ``Tracer.observe(name, value)`` accumulates into
+  fixed log-spaced buckets (:data:`HIST_BUCKETS_PER_DECADE` per decade
+  — shared global edges, so per-host/per-run merges are associative),
+  and every span name additionally gets an **automatic duration
+  histogram** (seconds) — scalar span totals answer "how much", the
+  quantiles (p50/p90/p99 in ``snapshot()``/``report()``) answer "is
+  the tail why the barrier stalls" (Dean & Barroso, The Tail at
+  Scale: synchronized multi-device pipelines are governed by tail
+  latency, not means).
 
 Exports: :meth:`Tracer.to_json` (the ``--metrics-json`` snapshot, whose
 ``timers`` section is byte-identical to the ``-print_metrics`` table)
@@ -46,7 +56,9 @@ docs/OBSERVABILITY.md and lint-enforced by
 from __future__ import annotations
 
 import json
+import math
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -116,6 +128,14 @@ SPAN_POOL_PREWARM_COMPILE = _span("device.pool.prewarm.compile")
 # failure, with ``device=<k>`` naming the chip that FAILED. ----
 SPAN_POOL_REPLAY = _span("device.pool.replay")
 
+# ---- barrier-2 per-fetch spans (pipelines/bqsr.merge_observations):
+# one per device-resident observe histogram fetched at the merge
+# barrier, ``device=<k>`` + ``window=<i>`` attributed — whether the n
+# fetches serialize on the host thread (the ROADMAP "observe-fetch
+# serialization" item) is directly readable off these spans' start
+# timestamps in a trace. ----
+SPAN_OBS_FETCH = _span("device.fetch.observe")
+
 # ---- io/parquet.py part-writer spans ----
 SPAN_PART_ENCODE = _span("parquet.part.encode")
 SPAN_PART_WRITE = _span("parquet.part.write")
@@ -155,12 +175,18 @@ G_DEVICE_INFLIGHT = _metric("device.dispatch.in_flight")
 G_OBSERVE_HIDDEN = _metric("streamed.observe_overlap_hidden")
 G_POOL_DEVICES = _metric("device.pool.devices")
 
+# ---- histograms (explicit observe() sites; every span name also gets
+# an automatic duration histogram under its own name, in seconds) ----
+H_FETCH_SECONDS = _metric("device.fetch.seconds")
+H_POOL_SUBMIT_WAIT = _metric("parquet.pool.submit_wait")
+
 #: Device-only metrics: the paired-CPU bench baseline zeroes these
 #: instead of omitting them so round-over-round diffs are key-stable.
 DEVICE_ONLY_COUNTERS = frozenset(
     {C_DEVICE_DISPATCHED, C_DEVICE_FETCHED, C_POOL_PREWARM_COMPILES}
 )
 DEVICE_ONLY_GAUGES = frozenset({G_DEVICE_INFLIGHT, G_POOL_DEVICES})
+DEVICE_ONLY_HISTOGRAMS = frozenset({H_FETCH_SECONDS})
 
 
 def registered_spans() -> frozenset:
@@ -176,6 +202,110 @@ def registered_names() -> frozenset:
     ``scripts/check-telemetry-names`` lint enforces against call-site
     string literals."""
     return frozenset(_REGISTERED_SPANS | _REGISTERED_METRICS)
+
+
+# --------------------------------------------------------------------------
+# Histograms: fixed log-spaced buckets, shared by every histogram
+# --------------------------------------------------------------------------
+#: Bucket resolution: 4 buckets per decade — bucket ``i`` spans
+#: ``[10^(i/4), 10^((i+1)/4))``.  The edges are GLOBAL and fixed (never
+#: derived from the data), so merging two histograms is a plain
+#: bucket-count sum: associative and commutative across runs, hosts and
+#: absorb() calls.
+HIST_BUCKETS_PER_DECADE = 4
+
+#: Values at or below this clamp into the lowest bucket (durations are
+#: nonnegative; sub-picosecond observations carry no signal).
+_HIST_MIN_VALUE = 1e-12
+
+
+def hist_bucket_index(value: float) -> int:
+    """The fixed log-spaced bucket a value falls in."""
+    v = max(float(value), _HIST_MIN_VALUE)
+    return math.floor(math.log10(v) * HIST_BUCKETS_PER_DECADE)
+
+
+def hist_bucket_bounds(index: int) -> tuple:
+    """``[lo, hi)`` edges of bucket ``index``."""
+    return (
+        10.0 ** (index / HIST_BUCKETS_PER_DECADE),
+        10.0 ** ((index + 1) / HIST_BUCKETS_PER_DECADE),
+    )
+
+
+def _new_hist() -> dict:
+    return {"count": 0, "sum": 0.0, "min": None, "max": None, "buckets": {}}
+
+
+def _hist_observe(h: dict, value: float) -> None:
+    """Accumulate one observation (caller holds the tracer lock)."""
+    v = float(value)
+    h["count"] += 1
+    h["sum"] += v
+    if h["min"] is None or v < h["min"]:
+        h["min"] = v
+    if h["max"] is None or v > h["max"]:
+        h["max"] = v
+    idx = hist_bucket_index(v)
+    b = h["buckets"]
+    b[idx] = b.get(idx, 0) + 1
+
+
+def _hist_quantile(h: dict, q: float) -> float | None:
+    """Quantile estimate from the bucket counts: walk to the bucket
+    holding rank ``q * count`` and return its geometric midpoint,
+    clamped to the observed [min, max] so single-sample histograms
+    report the sample, not a bucket edge."""
+    if not h["count"]:
+        return None
+    target = q * h["count"]
+    acc = 0
+    # JSON round-trips turn bucket keys into strings; accept both
+    items = sorted((int(k), v) for k, v in h["buckets"].items())
+    for idx, n in items:
+        acc += n
+        if acc >= target:
+            mid = 10.0 ** ((idx + 0.5) / HIST_BUCKETS_PER_DECADE)
+            lo = h["min"] if h["min"] is not None else mid
+            hi = h["max"] if h["max"] is not None else mid
+            return min(max(mid, lo), hi)
+    return h["max"]
+
+
+def hist_summary(h: dict) -> dict:
+    """Snapshot form of one histogram: scalars + p50/p90/p99 + the
+    (string-keyed, JSON-safe) sparse bucket counts that make merges
+    across snapshots possible."""
+    return {
+        "count": h["count"],
+        "sum": h["sum"],
+        "min": h["min"],
+        "max": h["max"],
+        "p50": _hist_quantile(h, 0.50),
+        "p90": _hist_quantile(h, 0.90),
+        "p99": _hist_quantile(h, 0.99),
+        "buckets": {str(k): v for k, v in h["buckets"].items()},
+    }
+
+
+def merge_histograms(a: dict, b: dict) -> dict:
+    """Merge two histograms in snapshot form (fixed global edges make
+    this a plain bucket sum — associative, so per-host merge order
+    cannot change the result)."""
+    out = _new_hist()
+    for h in (a, b):
+        if not h or not h.get("count"):
+            continue
+        out["count"] += h["count"]
+        out["sum"] += h["sum"]
+        for bound, pick in (("min", min), ("max", max)):
+            v = h.get(bound)
+            if v is not None:
+                out[bound] = v if out[bound] is None else pick(out[bound], v)
+        for k, n in h.get("buckets", {}).items():
+            k = int(k)
+            out["buckets"][k] = out["buckets"].get(k, 0) + n
+    return hist_summary(out)
 
 
 # --------------------------------------------------------------------------
@@ -253,6 +383,7 @@ class Tracer:
         self._dev_spans: dict = {} # name -> {device key -> [count, total_ns]}
         self._counters: dict = {}  # name -> int
         self._gauges: dict = {}    # name -> {last, min, max, n}
+        self._hists: dict = {}     # name -> _new_hist() dict
         self._tls = threading.local()
         self._n_recorded = 0
 
@@ -282,6 +413,21 @@ class Tracer:
         if attrs:
             ev["args"] = dict(attrs)
         dev = (attrs or {}).get("device")
+        if (
+            dev is not None and (attrs or {}).get("replay")
+            and name != SPAN_POOL_REPLAY
+        ):
+            # replayed work aggregates under ``<k>:replay``, NOT under
+            # the survivor's own key: after an eviction the survivor's
+            # organic occupancy and the windows it re-ran for the dead
+            # chip must stay separable (the evicted device's
+            # pre-eviction spans keep its original key untouched).  The
+            # replay UMBRELLA is exempt: on a cascading eviction (a
+            # device dies mid-replay) the nested umbrella is recorded
+            # inside the outer replay_scope, but it must stay under the
+            # failed chip's plain key or the analyzer would count the
+            # recovery wall as busy time and miss the eviction.
+            dev = f"{dev}:replay"
         with self._lock:
             self._events.append(ev)
             self._n_recorded += 1
@@ -291,6 +437,13 @@ class Tracer:
             else:
                 agg[0] += 1
                 agg[1] += dur
+            # automatic per-span-name duration histogram (seconds):
+            # the scalar total says how much, the quantiles say whether
+            # the tail is what the barriers wait on
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _new_hist()
+            _hist_observe(h, dur / 1e9)
             if dev is not None:
                 # per-device aggregate: the snapshot's device_spans
                 # section (chip occupancy + skew; time-sliced chips are
@@ -308,6 +461,18 @@ class Tracer:
             return
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe(self, name: str, value) -> None:
+        """Record one value into a fixed-bucket histogram (the counter
+        lock discipline: one branch when disabled, read-modify-write
+        only under the mutex when recording)."""
+        if not self.recording:
+            return
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _new_hist()
+            _hist_observe(h, value)
 
     def gauge(self, name: str, value) -> None:
         if not self.recording:
@@ -327,6 +492,17 @@ class Tracer:
                 g["n"] += 1
 
     # ---- reading ----------------------------------------------------------
+    def counters_and_gauges(self) -> tuple:
+        """(counters, gauges) copies only — the heartbeat's per-beat
+        accessor.  ``snapshot()`` computes histogram quantiles and
+        copies every span/device aggregate; at subsecond beat intervals
+        that is wasted O(names) work done under the recording mutex."""
+        with self._lock:
+            return (
+                dict(self._counters),
+                {k: dict(v) for k, v in self._gauges.items()},
+            )
+
     def span_seconds(self) -> dict:
         """Per-name total span seconds (concurrency-safe copy)."""
         with self._lock:
@@ -356,6 +532,9 @@ class Tracer:
                 },
                 "counters": dict(self._counters),
                 "gauges": {k: dict(v) for k, v in self._gauges.items()},
+                "histograms": {
+                    k: hist_summary(v) for k, v in self._hists.items()
+                },
                 "events_recorded": self._n_recorded,
                 "events_retained": len(self._events),
                 "events_evicted": self._n_recorded - len(self._events),
@@ -369,14 +548,16 @@ class Tracer:
             self._dev_spans.clear()
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
             self._n_recorded = 0
 
     def reset_metrics(self) -> None:
-        """Clear counters + gauges only (TimerRegistry.reset delegates
-        here so one reset clears the whole metrics surface)."""
+        """Clear counters + gauges + histograms only (TimerRegistry.reset
+        delegates here so one reset clears the whole metrics surface)."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
 
     def absorb(self, other: "Tracer") -> None:
         """Merge another tracer's events + aggregates into this one
@@ -390,6 +571,10 @@ class Tracer:
             }
             counters = dict(other._counters)
             gauges = {k: dict(v) for k, v in other._gauges.items()}
+            hists = {
+                k: {**v, "buckets": dict(v["buckets"])}
+                for k, v in other._hists.items()
+            }
             n_rec = other._n_recorded
         with self._lock:
             self._events.extend(events)
@@ -412,6 +597,24 @@ class Tracer:
                         dagg[1] += ns
             for k, v in counters.items():
                 self._counters[k] = self._counters.get(k, 0) + v
+            for k, h in hists.items():
+                mine = self._hists.get(k)
+                if mine is None:
+                    self._hists[k] = h
+                else:
+                    mine["count"] += h["count"]
+                    mine["sum"] += h["sum"]
+                    for bound, pick in (("min", min), ("max", max)):
+                        v = h[bound]
+                        if v is not None:
+                            mine[bound] = (
+                                v if mine[bound] is None
+                                else pick(mine[bound], v)
+                            )
+                    for idx, n in h["buckets"].items():
+                        mine["buckets"][idx] = (
+                            mine["buckets"].get(idx, 0) + n
+                        )
             for k, g in gauges.items():
                 mine = self._gauges.get(k)
                 if mine is None:
@@ -491,8 +694,32 @@ class Tracer:
             if dev is not None:
                 mirror = dict(ev)
                 mirror["tid"] = _tid(f"device:{dev}")
+                # explicit mirror marker: the analyzer must count each
+                # interval once, and two genuinely-concurrent same-name
+                # spans can coincide to the microsecond — only this
+                # marker distinguishes a mirror from a twin
+                mirror["cat"] = CHROME_MIRROR_CAT
                 out.append(mirror)
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        # carry the histogram section alongside the events (viewers
+        # ignore unknown top-level keys): explicit observe() metrics
+        # (device.fetch.seconds, parquet.pool.submit_wait) are not
+        # spans, so a trace alone could never reproduce their
+        # quantiles — and the span-duration histograms here aggregate
+        # PAST the ring's retention, unlike the events.  Ring occupancy
+        # rides along too: a consumer attributing wall time from the
+        # events (utils/analyzer.py) must know when the oldest events
+        # were evicted, or truncation reads as fabricated idle time.
+        with self._lock:
+            hists = {k: hist_summary(v) for k, v in self._hists.items()}
+            n_rec = self._n_recorded
+            n_ret = len(self._events)
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "histograms": hists,
+            "events_recorded": n_rec,
+            "events_evicted": n_rec - n_ret,
+        }
 
     def dump_json(self, path: str, timers=None,
                   include_events: bool = False) -> None:
@@ -530,10 +757,34 @@ class Tracer:
                     f"  {g['max']:>8}  {g['n']:>8}"
                 )
             out.append("")
+        if snap.get("histograms"):
+            w = max(len(k) for k in snap["histograms"])
+            out += ["Histograms (seconds)", "===================="]
+            out.append(
+                f"{'histogram'.ljust(w)}  {'count':>8}  {'p50':>10}"
+                f"  {'p90':>10}  {'p99':>10}  {'max':>10}"
+            )
+
+            def _f(v):
+                return f"{v:.6f}" if v is not None else "-"
+
+            for k in sorted(snap["histograms"]):
+                h = snap["histograms"][k]
+                out.append(
+                    f"{k.ljust(w)}  {h['count']:>8}  {_f(h['p50']):>10}"
+                    f"  {_f(h['p90']):>10}  {_f(h['p99']):>10}"
+                    f"  {_f(h['max']):>10}"
+                )
+            out.append("")
         if not out:
             return "Counters/Gauges\n===============\n(none recorded)\n"
         return "\n".join(out)
 
+
+#: Chrome-trace ``cat`` of the synthetic per-chip mirror copies
+#: ``to_chrome_trace`` emits next to each device-attributed span's
+#: host-thread event (utils/analyzer.py skips these when attributing).
+CHROME_MIRROR_CAT = "adam_tpu.device-mirror"
 
 #: Process-wide tracer — the ``object Timers`` analog for the
 #: structured layer.  Off by default; the CLI flips it on for
@@ -631,6 +882,9 @@ def key_stable_snapshot(tr: Tracer | None = None) -> dict:
             name, {"last": 0, "min": 0, "max": 0, "n": 0}
         )
     snap.setdefault("device_spans", {})
+    snap.setdefault("histograms", {})
+    for name in sorted(DEVICE_ONLY_HISTOGRAMS):
+        snap["histograms"].setdefault(name, hist_summary(_new_hist()))
     return snap
 
 
@@ -638,8 +892,11 @@ def merge_snapshots(snaps: list) -> dict:
     """Combine per-host snapshots (parallel/dist.gather_host_telemetry)
     into one report with per-host skew: for every span name, the
     min/max total wall across hosts — the Spark-listener per-executor
-    skew view."""
+    skew view.  Histograms merge across hosts too (fixed global bucket
+    edges make the merge a plain bucket sum, so host order is
+    irrelevant) into combined p50/p90/p99 under ``histograms``."""
     skew = {}
+    hists: dict = {}
     for snap in snaps:
         for name, e in snap.get("spans", {}).items():
             sk = skew.setdefault(
@@ -647,4 +904,270 @@ def merge_snapshots(snaps: list) -> dict:
             )
             sk["min_s"] = min(sk["min_s"], e["total_s"])
             sk["max_s"] = max(sk["max_s"], e["total_s"])
-    return {"n_hosts": len(snaps), "hosts": snaps, "span_skew": skew}
+        for name, h in snap.get("histograms", {}).items():
+            hists[name] = merge_histograms(hists.get(name, {}), h)
+    return {
+        "n_hosts": len(snaps),
+        "hosts": snaps,
+        "span_skew": skew,
+        "histograms": hists,
+    }
+
+
+# --------------------------------------------------------------------------
+# Live progress heartbeat
+# --------------------------------------------------------------------------
+#: NDJSON schema tag every heartbeat line carries.
+HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/1"
+
+#: THE heartbeat line field set — a stable contract (documented in
+#: docs/OBSERVABILITY.md, lint-enforced by scripts/check-telemetry-names):
+#: every line carries exactly these keys, in this order, so a consumer
+#: tailing the stream never needs per-line schema discovery.
+HEARTBEAT_FIELDS = (
+    "schema",
+    "seq",
+    "elapsed_s",
+    "windows_ingested",
+    "windows_total",
+    "parts_written",
+    "reads_ingested",
+    "reads_per_s",
+    "bytes_written",
+    "inflight",
+    "inflight_per_device",
+    "retries",
+    "faults",
+    "devices_evicted",
+    "eta_s",
+    "done",
+    "ok",
+)
+
+_DEFAULT_HEARTBEAT_INTERVAL_S = 2.0
+
+
+def progress_sink_from_env() -> str | None:
+    """Resolve ``ADAM_TPU_PROGRESS`` into a heartbeat sink: ``None``
+    (unset/``0`` — the default, zero-overhead path), ``"stderr"``
+    (``1``/``stderr``/``-``), or a file path to append NDJSON lines to."""
+    raw = os.environ.get("ADAM_TPU_PROGRESS", "").strip()
+    if not raw or raw == "0":
+        return None
+    if raw in ("1", "stderr", "-"):
+        return "stderr"
+    return raw
+
+
+def progress_interval_s() -> float:
+    """Heartbeat sample period (``ADAM_TPU_PROGRESS_INTERVAL_S``,
+    default 2 s; malformed or nonpositive values degrade to the default
+    with a warning — a tuning-var typo must not kill a pipeline)."""
+    raw = os.environ.get("ADAM_TPU_PROGRESS_INTERVAL_S", "").strip()
+    if not raw:
+        return _DEFAULT_HEARTBEAT_INTERVAL_S
+    try:
+        v = float(raw)
+    except ValueError:
+        v = -1.0
+    if v <= 0:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ADAM_TPU_PROGRESS_INTERVAL_S=%r is not a positive number; "
+            "using default %.1fs", raw, _DEFAULT_HEARTBEAT_INTERVAL_S,
+        )
+        return _DEFAULT_HEARTBEAT_INTERVAL_S
+    return v
+
+
+class Heartbeat:
+    """Daemon-thread progress heartbeat: one NDJSON line per sample.
+
+    Samples the given tracers (the streamed run tracer plus the global
+    :data:`TRACE` — counters are summed across them, gauges read from
+    the first tracer that carries each) every ``interval_s`` seconds
+    and writes one :data:`HEARTBEAT_FIELDS`-shaped JSON line to the
+    sink (``"stderr"`` or a file path).  Emits immediately on
+    :meth:`start` (short runs still get a line) and a final
+    ``done=true`` line on :meth:`stop` (idempotent, exception-safe).
+
+    Off is the default everywhere: when no sink is configured the
+    streamed pipeline constructs no Heartbeat at all — the disabled
+    cost is one ``if`` per run, the same ~zero-overhead contract the
+    spans keep.  A heartbeat failure (closed sink, provider bug) is
+    swallowed: progress reporting must never kill the run it reports.
+    """
+
+    def __init__(self, tracers, sink: str = "stderr",
+                 interval_s: float | None = None):
+        self._tracers = list(tracers)
+        self._sink = sink
+        self._interval = (
+            progress_interval_s() if interval_s is None else interval_s
+        )
+        self._fh = None
+        self._owns_fh = False
+        self._t0 = None
+        self._seq = 0
+        self._total = None
+        self._parts_total = None
+        self._provider = None
+        self._stop_ev = threading.Event()
+        self._state_lock = threading.Lock()
+        self._emit_lock = threading.Lock()
+        self._closed = False
+        self._ok = True
+        self._started = False
+        self._stopped = False
+        self._thread = None
+
+    # ---- producer-side knobs ------------------------------------------
+    def set_total(self, n: int) -> None:
+        """The ingested-window count (known at pass A's end).  Set
+        once and never overwritten — ``windows_ingested / windows_total``
+        must stay <= 1 for a progress consumer."""
+        self._total = int(n)
+
+    def set_parts_total(self, n: int) -> None:
+        """The exact output-part count (known at pass C — residual
+        windows drop, the realigned part joins): the ETA extrapolates
+        ``parts_written`` against this, falling back to the window
+        count until it is known."""
+        self._parts_total = int(n)
+
+    def set_provider(self, fn) -> None:
+        """Register a callable returning extra field values (only keys
+        in :data:`HEARTBEAT_FIELDS` are honored; the streamed pipeline
+        supplies per-device in-flight depth this way)."""
+        self._provider = fn
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        with self._state_lock:
+            if self._started:
+                return
+            self._started = True
+        self._t0 = time.monotonic()
+        if self._sink != "stderr":
+            try:
+                # append, as documented: back-to-back runs pointed at
+                # one log keep their history (runs delimit themselves —
+                # seq restarts at 0 and the last line carries done=true)
+                self._fh = open(self._sink, "a")
+                self._owns_fh = True
+            except OSError:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "cannot open progress sink %s; falling back to "
+                    "stderr", self._sink, exc_info=True,
+                )
+                self._fh = None
+        self._emit(done=False)
+        self._thread = threading.Thread(
+            target=self._loop, name="adam-tpu-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, ok: bool = True) -> None:
+        """Final ``done=true`` line + teardown.  ``ok=False`` marks the
+        run as crashed on that line — without it a consumer tailing the
+        stream would read an exception-path exit as a completed run."""
+        if not ok:
+            self._ok = False
+        with self._state_lock:
+            if not self._started or self._stopped:
+                return
+            self._stopped = True
+        self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._emit(done=True)
+        if self._owns_fh and self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    def _loop(self) -> None:
+        while not self._stop_ev.wait(self._interval):
+            self._emit(done=False)
+
+    # ---- sampling ------------------------------------------------------
+    def sample(self, done: bool = False) -> dict:
+        """One heartbeat line as a dict (exactly HEARTBEAT_FIELDS)."""
+        counters: dict = {}
+        gauges: dict = {}
+        for tr in self._tracers:
+            trc, trg = tr.counters_and_gauges()
+            for k, v in trc.items():
+                counters[k] = counters.get(k, 0) + v
+            for k, v in trg.items():
+                gauges.setdefault(k, v)
+        elapsed = time.monotonic() - (self._t0 or time.monotonic())
+        reads = counters.get(C_READS_INGESTED, 0)
+        parts = counters.get(C_PARTS_WRITTEN, 0)
+        total = self._total
+        parts_total = (
+            self._parts_total if self._parts_total is not None else total
+        )
+        eta = None
+        if parts_total and parts:
+            eta = round(elapsed * max(0, parts_total - parts) / parts, 1)
+        line = {
+            "schema": HEARTBEAT_SCHEMA,
+            "seq": self._seq,
+            "elapsed_s": round(elapsed, 3),
+            "windows_ingested": counters.get(C_WINDOWS_INGESTED, 0),
+            "windows_total": total,
+            "parts_written": parts,
+            "reads_ingested": reads,
+            "reads_per_s": (
+                round(reads / elapsed, 1) if elapsed > 0 else 0.0
+            ),
+            "bytes_written": counters.get(C_BYTES_WRITTEN, 0),
+            "inflight": gauges.get(G_DEVICE_INFLIGHT, {}).get("last", 0),
+            "inflight_per_device": {},
+            "retries": counters.get(C_RETRY_ATTEMPTS, 0),
+            "faults": counters.get(C_FAULT_INJECTED, 0),
+            "devices_evicted": counters.get(C_DEVICE_EVICTED, 0),
+            "eta_s": eta,
+            "done": done,
+            "ok": self._ok,
+        }
+        if self._provider is not None:
+            try:
+                for k, v in (self._provider() or {}).items():
+                    if k in HEARTBEAT_FIELDS:
+                        line[k] = v
+            except Exception:  # provider bugs must not kill the beat
+                pass
+        return line
+
+    def _emit(self, done: bool) -> None:
+        # one writer at a time: without the lock, a daemon thread
+        # stalled inside fh.write past stop()'s join timeout could race
+        # the final done=true line — duplicate seq values, a periodic
+        # line AFTER the final one, or a write to the closed handle.
+        # Bounded acquire so a wedged sink makes stop() drop its final
+        # line instead of hanging the pipeline on exit.
+        if not self._emit_lock.acquire(timeout=5.0):
+            return
+        try:
+            if self._closed:
+                return
+            if done:
+                self._closed = True
+            line = self.sample(done)
+            self._seq += 1
+            fh = self._fh if self._fh is not None else sys.stderr
+            fh.write(json.dumps(line, default=str) + "\n")
+            fh.flush()
+        except Exception:
+            # a torn sink (closed stderr under pytest, full disk) must
+            # never take the pipeline down with it
+            pass
+        finally:
+            self._emit_lock.release()

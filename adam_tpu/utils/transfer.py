@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -143,4 +144,16 @@ def device_fetch(x, threads: int = _MAX_THREADS,
             )
         return _fetch_chunked(x, threads)
 
-    return retry_mod.retry_call(attempt, site="device.fetch")
+    from adam_tpu.utils import telemetry as tele
+
+    if not tele.TRACE.recording:
+        return retry_mod.retry_call(attempt, site="device.fetch")
+    # latency histogram over every device->host fetch (seconds,
+    # retries included — the caller-visible latency): on a tunneled
+    # link the barrier-2 and pass-C walls are governed by the fetch
+    # TAIL, which the scalar span totals cannot show
+    t0 = time.monotonic()
+    try:
+        return retry_mod.retry_call(attempt, site="device.fetch")
+    finally:
+        tele.TRACE.observe(tele.H_FETCH_SECONDS, time.monotonic() - t0)

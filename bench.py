@@ -339,6 +339,15 @@ def _run_streamed(known, trials: int = 1, probe: bool = True) -> dict:
     totals = sorted(w["total_s"] for w in windows)
     best = dict(best)
     best["telemetry"] = best_snap
+    # the analyzer's per-device utilization section (busy/idle/fetch/
+    # replay attribution from the best trial's device_spans): every
+    # bench artifact carries attribution built in, so the next real-
+    # hardware multi-chip round starts from per-chip occupancy data
+    # instead of a scalar wall.  Key-stable: the CPU baseline's empty
+    # device_spans yields {"wall_s": ..., "devices": {}}.
+    from adam_tpu.utils import analyzer
+
+    best["utilization"] = analyzer.utilization_from_snapshot(best_snap)
     best["windows"] = windows
     best["spread"] = {
         "min_s": totals[0],
@@ -712,6 +721,13 @@ def main() -> None:
                 "telemetry": {
                     "chip": stages.get("telemetry"),
                     "cpu_baseline": cpu_stats.get("telemetry"),
+                },
+                # per-device busy/idle/fetch/replay attribution of the
+                # best trial (utils/analyzer.py) — the multi-chip
+                # artifact's occupancy evidence, {} on the CPU leg
+                "utilization": {
+                    "chip": stages.get("utilization"),
+                    "cpu_baseline": cpu_stats.get("utilization"),
                 },
             })
         )

@@ -15,7 +15,9 @@ def run_cli(*argv):
 
 
 def test_registry_matches_reference():
-    """Same command names as ADAMMain.scala:30-72."""
+    """Same command names as ADAMMain.scala:30-72, plus this repo's
+    observability extension (``analyze`` — the run-report half of the
+    telemetry layer has no reference analog)."""
     names = {c.name for _, cmds in command_groups() for c in cmds}
     assert names == {
         "depth", "count_kmers", "count_contig_kmers", "transform",
@@ -24,6 +26,7 @@ def test_registry_matches_reference():
         "features2adam", "wigfix2bed",
         "print", "print_genes", "flagstat", "print_tags", "listdict",
         "allelecount", "buildinfo", "view",
+        "analyze",
     }
 
 

@@ -296,3 +296,63 @@ def test_chrome_trace_mirrors_device_tracks():
     per = snap["device_spans"][tele.SPAN_APPLY_DISPATCH]
     assert set(per) == {"2", "5"}
     assert per["2"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Eviction attribution: replayed work vs organic occupancy
+# ---------------------------------------------------------------------------
+def test_span_attrs_mark_replay_scope():
+    """Inside a replay_scope every device-attributed span picks up
+    ``replay=1`` — in any layer, with no API plumbing — so the
+    ``device_spans`` aggregation can keep a survivor's replay burden
+    apart from its organic work."""
+    import jax
+
+    dev = jax.devices()[0]
+    base = dp.span_attrs(dev)
+    assert "replay" not in base
+    with dp.replay_scope():
+        marked = dp.span_attrs(dev)
+        assert marked["device"] == base["device"]
+        assert marked["replay"] == 1
+        with dp.replay_scope():  # reentrant
+            assert dp.span_attrs(dev)["replay"] == 1
+        assert dp.in_replay()
+    assert not dp.in_replay()
+    # the single-device path stays attribution-free even mid-replay
+    with dp.replay_scope():
+        assert dp.span_attrs(None) == {}
+
+
+def test_device_spans_after_evict_keep_original_and_split_replay():
+    """After DevicePool.evict, the dead chip's pre-eviction spans stay
+    under its original key and the survivor's replayed windows land
+    under ``<survivor>:replay`` — the snapshot can no longer conflate
+    replayed work with the survivor's own."""
+    pool = dp.make_pool(2)
+    tr = tele.Tracer(recording=True)
+    d0, d1 = pool.devices
+    k0, k1 = dp._attr_id(d0), dp._attr_id(d1)
+    # organic work on both chips
+    with tr.span(tele.SPAN_APPLY_DISPATCH, window=0, **dp.span_attrs(d0)):
+        pass
+    with tr.span(tele.SPAN_APPLY_DISPATCH, window=1, **dp.span_attrs(d1)):
+        pass
+    # chip 1 dies; window 1 replays on chip 0 the way streamed.py does:
+    # umbrella span attributed to the FAILED chip, nested dispatch
+    # inside a replay_scope on the survivor
+    assert pool.evict(d1, reason="test", tracer=tr)
+    with tr.span(tele.SPAN_POOL_REPLAY, window=1, **dp.span_attrs(d1)), \
+            dp.replay_scope():
+        with tr.span(tele.SPAN_APPLY_DISPATCH, window=1,
+                     **dp.span_attrs(d0)):
+            pass
+    snap = tr.snapshot()
+    disp = snap["device_spans"][tele.SPAN_APPLY_DISPATCH]
+    assert disp[str(k0)]["count"] == 1       # organic only
+    assert disp[str(k1)]["count"] == 1       # pre-eviction, original key
+    assert disp[f"{k0}:replay"]["count"] == 1  # the replayed window
+    # the umbrella names the failed chip, eviction counted
+    assert snap["device_spans"][tele.SPAN_POOL_REPLAY][str(k1)]["count"] == 1
+    assert snap["counters"][tele.C_DEVICE_EVICTED] == 1
+    assert pool.alive_devices() == [d0]

@@ -395,3 +395,259 @@ def test_cli_metrics_json_and_trace_out(tmp_path, capsys):
     tok_tids = {e["tid"] for e in spans if e["name"] == tele.SPAN_TOKENIZE}
     ing_tids = {e["tid"] for e in spans if e["name"] == tele.SPAN_PASS_A}
     assert tok_tids and ing_tids and not (tok_tids & ing_tids)
+
+
+# --------------------------------------------------------------------------
+# histograms: fixed log-spaced buckets, quantiles, merges
+# --------------------------------------------------------------------------
+def test_histogram_bucket_edges_are_fixed_and_log_spaced():
+    """Bucket i spans [10^(i/4), 10^((i+1)/4)) — global, data-independent
+    edges, and every observed value lands in exactly its bucket."""
+    for v in (1e-6, 0.001, 0.5, 1.0, 3.7, 42.0, 1e4):
+        idx = tele.hist_bucket_index(v)
+        lo, hi = tele.hist_bucket_bounds(idx)
+        assert lo <= v < hi, (v, idx, lo, hi)
+    # adjacent buckets tile the line with ratio 10^(1/4)
+    lo0, hi0 = tele.hist_bucket_bounds(0)
+    lo1, hi1 = tele.hist_bucket_bounds(1)
+    assert hi0 == pytest.approx(lo1)
+    assert hi0 / lo0 == pytest.approx(10 ** 0.25)
+    # nonpositive values clamp into the lowest bucket instead of NaN-ing
+    assert tele.hist_bucket_index(0.0) == tele.hist_bucket_index(-5.0)
+
+
+def test_histogram_observe_and_quantiles():
+    tr = tele.Tracer(recording=True)
+    for v in [0.001] * 90 + [1.0] * 9 + [10.0]:
+        tr.observe(tele.H_FETCH_SECONDS, v)
+    h = tr.snapshot()["histograms"][tele.H_FETCH_SECONDS]
+    assert h["count"] == 100
+    assert h["min"] == 0.001 and h["max"] == 10.0
+    assert h["sum"] == pytest.approx(0.09 + 9.0 + 10.0)
+    # p50 sits in the 1ms bucket, p99 in the 1s bucket (bucket-midpoint
+    # estimates: within one bucket ratio of the true value)
+    assert h["p50"] == pytest.approx(0.001, rel=1.0)
+    assert 0.5 <= h["p99"] <= 2.0
+    # the max observation is only reachable at the very top quantile
+    assert h["p99"] < h["max"]
+
+
+def test_histogram_merge_is_associative():
+    def hist(values):
+        tr = tele.Tracer(recording=True)
+        for v in values:
+            tr.observe(tele.H_FETCH_SECONDS, v)
+        return tr.snapshot()["histograms"][tele.H_FETCH_SECONDS]
+
+    a = hist([0.001, 0.002, 0.004])
+    b = hist([1.0, 2.0])
+    c = hist([50.0, 0.0005])
+    left = tele.merge_histograms(tele.merge_histograms(a, b), c)
+    right = tele.merge_histograms(a, tele.merge_histograms(b, c))
+    assert left == right
+    assert left["count"] == 7
+    assert left["min"] == 0.0005 and left["max"] == 50.0
+    # merging with an empty histogram is the identity
+    assert tele.merge_histograms(a, {}) == tele.merge_histograms({}, a)
+
+
+def test_observe_concurrent_is_lossless():
+    """≥8 threads hammering observe(): nothing lost, bounds exact."""
+    tr = tele.Tracer(recording=True)
+    n_threads, per_thread = 8, 400
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            tr.observe(tele.H_POOL_SUBMIT_WAIT, 0.001 * (tid + 1))
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for _ in range(50):  # concurrent readers must not race the writers
+        tr.snapshot()
+    for t in threads:
+        t.join()
+    h = tr.snapshot()["histograms"][tele.H_POOL_SUBMIT_WAIT]
+    assert h["count"] == n_threads * per_thread
+    assert h["min"] == pytest.approx(0.001)
+    assert h["max"] == pytest.approx(0.008)
+    assert sum(h["buckets"].values()) == h["count"]
+
+
+def test_spans_get_automatic_duration_histograms():
+    tr = tele.Tracer(recording=True)
+    tr.add_span(tele.SPAN_SOLVE, 0, int(0.25 * 1e9))
+    tr.add_span(tele.SPAN_SOLVE, 0, int(0.5 * 1e9))
+    snap = tr.snapshot()
+    h = snap["histograms"][tele.SPAN_SOLVE]
+    assert h["count"] == snap["spans"][tele.SPAN_SOLVE]["count"] == 2
+    assert h["min"] == pytest.approx(0.25)
+    assert h["max"] == pytest.approx(0.5)
+    # disabled tracers record no histograms at all
+    off = tele.Tracer(recording=False)
+    off.observe(tele.H_FETCH_SECONDS, 1.0)
+    assert off.snapshot()["histograms"] == {}
+
+
+def test_absorb_and_merge_snapshots_carry_histograms():
+    a = tele.Tracer(recording=True)
+    b = tele.Tracer(recording=True)
+    a.observe(tele.H_FETCH_SECONDS, 0.1)
+    b.observe(tele.H_FETCH_SECONDS, 10.0)
+    sa, sb = a.snapshot(), b.snapshot()
+    a.absorb(b)
+    h = a.snapshot()["histograms"][tele.H_FETCH_SECONDS]
+    assert h["count"] == 2 and h["min"] == 0.1 and h["max"] == 10.0
+    merged = tele.merge_snapshots([sa, sb])
+    mh = merged["histograms"][tele.H_FETCH_SECONDS]
+    assert mh["count"] == 2 and mh["min"] == 0.1 and mh["max"] == 10.0
+
+
+def test_key_stable_snapshot_zero_fills_histograms():
+    tr = tele.Tracer(recording=True)
+    snap = tele.key_stable_snapshot(tr)
+    for name in tele.DEVICE_ONLY_HISTOGRAMS:
+        h = snap["histograms"][name]
+        assert h["count"] == 0 and h["p50"] is None
+
+
+def test_report_prints_histogram_quantiles():
+    tr = tele.Tracer(recording=True)
+    tr.observe(tele.H_FETCH_SECONDS, 0.5)
+    text = tr.report()
+    assert "Histograms" in text
+    assert tele.H_FETCH_SECONDS in text
+
+
+# --------------------------------------------------------------------------
+# device_spans: replayed work never conflates with organic occupancy
+# --------------------------------------------------------------------------
+def test_device_spans_separate_replayed_from_organic_work():
+    """The eviction-attribution fix: an evicted device's pre-eviction
+    spans stay under its original key, and the windows a survivor
+    re-runs for it aggregate under `<survivor>:replay` — never summed
+    into the survivor's own row."""
+    tr = tele.Tracer(recording=True)
+    # pre-eviction: devices 0 and 1 each do organic work
+    tr.add_span(tele.SPAN_APPLY_DISPATCH, 0, int(1e9), device=0)
+    tr.add_span(tele.SPAN_APPLY_DISPATCH, 0, int(2e9), device=1)
+    # device 1 dies; its window replays on device 0 with the replay attr
+    tr.add_span(tele.SPAN_POOL_REPLAY, 0, int(3e9), device=1)
+    tr.add_span(tele.SPAN_APPLY_DISPATCH, 0, int(4e9), device=0, replay=1)
+    dev = tr.snapshot()["device_spans"]
+    disp = dev[tele.SPAN_APPLY_DISPATCH]
+    # organic rows untouched by the replay
+    assert disp["0"] == {"count": 1, "total_s": pytest.approx(1.0)}
+    assert disp["1"] == {"count": 1, "total_s": pytest.approx(2.0)}
+    # replayed work lands under the survivor's :replay key
+    assert disp["0:replay"] == {"count": 1, "total_s": pytest.approx(4.0)}
+    # the umbrella stays attributed to the FAILED chip
+    assert dev[tele.SPAN_POOL_REPLAY]["1"]["total_s"] == pytest.approx(3.0)
+    # cascading eviction: a device dying MID-replay records its own
+    # umbrella inside the outer replay scope (replay=1 attr), which is
+    # exempt from the :replay rewrite — recovery wall must stay under
+    # the failed chip's plain key or the analyzer counts it as busy
+    # and misses the eviction
+    tr.add_span(tele.SPAN_POOL_REPLAY, 0, int(1e9), device=0, replay=1)
+    dev2 = tr.snapshot()["device_spans"][tele.SPAN_POOL_REPLAY]
+    assert "0:replay" not in dev2
+    assert dev2["0"]["total_s"] == pytest.approx(1.0)
+
+
+# --------------------------------------------------------------------------
+# heartbeat
+# --------------------------------------------------------------------------
+def test_progress_sink_from_env(monkeypatch):
+    monkeypatch.delenv("ADAM_TPU_PROGRESS", raising=False)
+    assert tele.progress_sink_from_env() is None
+    monkeypatch.setenv("ADAM_TPU_PROGRESS", "0")
+    assert tele.progress_sink_from_env() is None
+    for raw in ("1", "stderr", "-"):
+        monkeypatch.setenv("ADAM_TPU_PROGRESS", raw)
+        assert tele.progress_sink_from_env() == "stderr"
+    monkeypatch.setenv("ADAM_TPU_PROGRESS", "/tmp/hb.ndjson")
+    assert tele.progress_sink_from_env() == "/tmp/hb.ndjson"
+    monkeypatch.setenv("ADAM_TPU_PROGRESS_INTERVAL_S", "bogus")
+    assert tele.progress_interval_s() == pytest.approx(2.0)
+    monkeypatch.setenv("ADAM_TPU_PROGRESS_INTERVAL_S", "0.25")
+    assert tele.progress_interval_s() == pytest.approx(0.25)
+
+
+def test_heartbeat_ndjson_schema_is_stable(tmp_path):
+    """Every emitted line carries exactly HEARTBEAT_FIELDS, in order;
+    the final line is done=true; counters sum across the sampled
+    tracers (run tracer + global TRACE, as the streamed wiring does)."""
+    tr = tele.Tracer(recording=True)
+    other = tele.Tracer(recording=True)
+    tr.count(tele.C_WINDOWS_INGESTED, 3)
+    tr.count(tele.C_READS_INGESTED, 3000)
+    other.count(tele.C_PARTS_WRITTEN, 2)
+    other.count(tele.C_BYTES_WRITTEN, 12345)
+    p = str(tmp_path / "hb.ndjson")
+    hb = tele.Heartbeat([tr, other], sink=p, interval_s=0.05)
+    hb.set_total(4)
+    hb.set_provider(lambda: {"inflight_per_device": {"0": 2, "1": 1}})
+    hb.start()
+    import time as _time
+
+    _time.sleep(0.2)
+    hb.stop()
+    hb.stop()  # idempotent
+    lines = [json.loads(l) for l in open(p)]
+    assert len(lines) >= 3  # start line + >=1 periodic + final
+    for l in lines:
+        assert tuple(l.keys()) == tele.HEARTBEAT_FIELDS
+        assert l["schema"] == tele.HEARTBEAT_SCHEMA
+    last = lines[-1]
+    assert last["done"] is True
+    assert last["windows_ingested"] == 3
+    assert last["reads_ingested"] == 3000
+    assert last["parts_written"] == 2
+    assert last["bytes_written"] == 12345
+    assert last["windows_total"] == 4
+    assert last["inflight_per_device"] == {"0": 2, "1": 1}
+    assert last["eta_s"] is not None  # 2 of 4 parts -> extrapolable
+    assert [l["seq"] for l in lines] == list(range(len(lines)))
+    # a broken provider must not kill the beat
+    hb2 = tele.Heartbeat([tr], sink=str(tmp_path / "hb2.ndjson"),
+                         interval_s=5.0)
+    hb2.set_provider(lambda: 1 / 0)
+    hb2.start()
+    hb2.stop()
+    assert len(open(str(tmp_path / "hb2.ndjson")).readlines()) == 2
+    # a crashed run's final line carries ok=false (the streamed
+    # wrapper's exception path calls stop(ok=False)): done alone must
+    # never read as success
+    hb3 = tele.Heartbeat([tr], sink=str(tmp_path / "hb3.ndjson"),
+                         interval_s=5.0)
+    hb3.start()
+    hb3.stop(ok=False)
+    crash_lines = [json.loads(l) for l in open(str(tmp_path / "hb3.ndjson"))]
+    assert crash_lines[0]["ok"] is True
+    assert crash_lines[-1] == {**crash_lines[-1], "done": True, "ok": False}
+
+
+def test_heartbeat_disabled_is_a_noop(tmp_path, monkeypatch):
+    """No sink configured => the streamed pipeline constructs no
+    heartbeat, flips no global state, and emits nothing."""
+    from adam_tpu.pipelines import streamed as st
+
+    monkeypatch.delenv("ADAM_TPU_PROGRESS", raising=False)
+    tele.TRACE.recording = False  # fixture restores the entry value
+    tr = tele.Tracer(recording=True)
+    assert st._start_heartbeat(tr, None) is None
+    assert tele.TRACE.recording is False
+    st._stop_heartbeat(None)  # no-op on the disabled path
+    # with a sink, global recording flips on for the heartbeat's
+    # lifetime and is restored on stop — along with the recorded state,
+    # so back-to-back runs cannot sum counters into each other's beats
+    hb = st._start_heartbeat(tr, str(tmp_path / "hb.ndjson"))
+    assert hb is not None and tele.TRACE.recording is True
+    tele.TRACE.count(tele.C_PARTS_WRITTEN, 3)  # a mid-run parquet count
+    st._stop_heartbeat(hb)
+    assert tele.TRACE.recording is False
+    assert tele.TRACE.snapshot()["counters"] == {}
