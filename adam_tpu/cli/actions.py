@@ -253,6 +253,27 @@ class Transform(Command):
             "directory; requires a markdup/BQSR/realign stage set",
         )
         p.add_argument(
+            "-window_reads", type=int, default=262_144,
+            help="ingest window size in reads for -streaming — the unit "
+            "of overlap, device round-robin and durable resume",
+        )
+        p.add_argument(
+            "--run-dir", dest="run_dir", default=None, metavar="DIR",
+            help="durable window-granular resume journal for the "
+            "-streaming pipeline (docs/ROBUSTNESS.md): records each "
+            "output window as complete after its part's atomic+fsync'd "
+            "publish and persists observe-histogram/recalibration-table "
+            "sidecars, so a killed run can resume instead of restarting",
+        )
+        p.add_argument(
+            "--resume", dest="resume", action="store_true",
+            help="resume a killed -streaming run from --run-dir's "
+            "journal: completed windows are skipped, output stays "
+            "bit-identical to an uninterrupted run; a journal recorded "
+            "for different input bytes, flags or window plan is refused "
+            "with a clean restart (never mixed output)",
+        )
+        p.add_argument(
             "-shards", type=int, default=0,
             help="run as the composed out-of-core sharded pipeline over N "
             "genome-bin shards (parallel/sharded.py): windowed ingest "
@@ -323,9 +344,32 @@ class Transform(Command):
                 "-streaming pipeline only; no lines will be written",
                 file=sys.stderr,
             )
+        if getattr(args, "resume", None) and not getattr(args, "run_dir",
+                                                         None):
+            print(
+                "transform: --resume needs the journal directory; pass "
+                "--run-dir DIR (the same DIR the killed run journaled "
+                "into)",
+                file=sys.stderr,
+            )
+            return 2
+        if getattr(args, "run_dir", None) and not args.streaming:
+            print(
+                "transform: --run-dir/--resume journal the -streaming "
+                "pipeline only; use -checkpoint_dir for the composed "
+                "stage pipeline",
+                file=sys.stderr,
+            )
+            return 2
         if args.shards and args.shards < 0:
             print(f"transform -shards must be positive (got {args.shards})",
                   file=sys.stderr)
+            return 2
+        if args.window_reads < 1:
+            print(
+                f"transform -window_reads must be positive (got "
+                f"{args.window_reads})", file=sys.stderr,
+            )
             return 2
         if args.shards and args.streaming:
             print(
@@ -408,8 +452,11 @@ class Transform(Command):
                         return 2
                 transform_streamed(
                     args.input, args.output,
+                    window_reads=args.window_reads,
                     devices=getattr(args, "devices", None),
-                    progress=getattr(args, "progress", None), **kw,
+                    progress=getattr(args, "progress", None),
+                    run_dir=getattr(args, "run_dir", None),
+                    resume=bool(getattr(args, "resume", False)), **kw,
                 )
                 if getattr(args, "report", None):
                     # the analyzer view of THIS run: trace-grade (gap
@@ -537,9 +584,41 @@ class Transform(Command):
                     return ds.sort_by_reference_position()
             stages.append(("sort", _sort))
 
-        from adam_tpu.pipelines.checkpoint import run_stages
+        from adam_tpu.pipelines.checkpoint import (
+            compose_fingerprint,
+            input_fingerprint,
+            run_stages,
+        )
 
-        ds = run_stages(ds, stages, checkpoint_dir=args.checkpoint_dir)
+        fp = None
+        if args.checkpoint_dir:
+            # input content identity + every stage-affecting flag value:
+            # a rerun over different bytes (or retuned knobs) must
+            # invalidate the stage stores instead of silently reloading
+            # them (the stage list alone only catches REORDERED flags)
+            fp = compose_fingerprint({
+                "input": input_fingerprint(args.input),
+                "trimFromStart": args.trimFromStart,
+                "trimFromEnd": args.trimFromEnd,
+                "trimReadGroup": args.trimReadGroup,
+                "qualityThreshold": args.qualityThreshold,
+                # known-sites files fingerprint by CONTENT, not path:
+                # editing sites in place must invalidate the stores
+                "known_snps": (
+                    input_fingerprint(args.known_snps)
+                    if args.known_snps else None
+                ),
+                "known_indels": (
+                    input_fingerprint(args.known_indels)
+                    if args.known_indels else None
+                ),
+                "max_indel_size": args.max_indel_size,
+                "max_consensus_number": args.max_consensus_number,
+                "log_odds_threshold": args.log_odds_threshold,
+                "max_target_size": args.max_target_size,
+            })
+        ds = run_stages(ds, stages, checkpoint_dir=args.checkpoint_dir,
+                        fingerprint=fp)
 
         with ins.TIMERS.time(ins.SAVE_OUTPUT):
             if args.sort_fastq_output and str(args.output).endswith(

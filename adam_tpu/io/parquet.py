@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from typing import Callable, Optional, Sequence
 
@@ -49,6 +50,32 @@ from adam_tpu.models.dictionaries import (
 #: staging files are invisible to every loader; the streamed pipeline
 #: purges the stale dir on its next run.
 TMP_DIR_NAME = "_temporary"
+
+#: Part-file naming contract (the Spark executor ``part-r-NNNNN`` layout,
+#: shared by every windowed pipeline and the streamed run journal): the
+#: numeric index IS the pipeline's window index — window ``i``'s rows
+#: land in ``part-r-<i:05d>.parquet``, and the streamed realigned tail
+#: part takes index ``n_windows``.  The index is therefore recoverable
+#: from the file name alone (:func:`part_index`), which is what lets a
+#: resumed run map journaled parts back onto its window plan.
+PART_NAME_FORMAT = "part-r-{:05d}.parquet"
+_PART_NAME_RE = re.compile(r"^part-r-(\d{5,})\.parquet$")
+
+
+def part_name(idx: int) -> str:
+    """Canonical part file name for window/part index ``idx``."""
+    return PART_NAME_FORMAT.format(idx)
+
+
+def part_path(out_dir: str, idx: int) -> str:
+    return os.path.join(out_dir, part_name(idx))
+
+
+def part_index(path: str) -> Optional[int]:
+    """Window/part index recovered from a part path (None when the name
+    is not a canonical part file — e.g. staging or sidecar files)."""
+    m = _PART_NAME_RE.match(os.path.basename(path))
+    return int(m.group(1)) if m else None
 
 
 def purge_stale_staging(out_dir: str) -> None:
@@ -315,14 +342,26 @@ def _write_encoded(table: "pa.Table", path: str, compression: str) -> None:
                 tmp = _staging_path(path)
         try:
             write_to(tmp)
-            # publish: readers either see the complete part or nothing
-            os.replace(tmp, path)
+            # publish: readers either see the complete part or nothing.
+            # Durable, not just atomic (docs/ROBUSTNESS.md): the bytes
+            # are fsync'd before the rename and the directory entry
+            # after it, so a power loss after publish cannot surface a
+            # torn part under the final name — the guarantee the
+            # streamed run journal's "window complete" records lean on.
+            from adam_tpu.utils.durability import publish_file
+
+            publish_file(tmp, path)
         except BaseException:
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
             raise
+        # chaos-harness kill point: the part is durably published but
+        # the caller (journal append, pool bookkeeping) has not run —
+        # a resume must tolerate a published part the journal does not
+        # know about (it rewrites the same bytes)
+        faults.point("proc.kill", device="write")
     try:
         # opportunistic: drop the staging dir once it empties (fails
         # with ENOTEMPTY while sibling parts are still in flight)
@@ -371,7 +410,7 @@ class PartWriterPool:
     """
 
     def __init__(self, n_encoders: int = 2, inflight_parts: int = 3,
-                 compression: str = "zstd"):
+                 compression: str = "zstd", on_published=None):
         import threading
         from concurrent.futures import ThreadPoolExecutor
 
@@ -379,6 +418,13 @@ class PartWriterPool:
         self._io = ThreadPoolExecutor(1)
         self._gate = threading.BoundedSemaphore(max(1, inflight_parts))
         self._compression = compression
+        # durable-completion hook, called as on_published(path) on the
+        # write thread AFTER a part's atomic+fsync'd publish (the
+        # streamed run journal records "window complete" here — by
+        # contract never before the bytes are durably on disk).  A hook
+        # failure is a worker failure: losing the completion record
+        # would silently disable resume for that window.
+        self._on_published = on_published
         self._futures: list = []
         # submit-gate depth (parts alive inside the pool), sampled into
         # the telemetry gauge at submit and at drain; the int itself is
@@ -464,6 +510,8 @@ class PartWriterPool:
         def write(table):
             try:
                 _write_encoded(table, path, self._compression)
+                if self._on_published is not None:
+                    self._on_published(path)
             except BaseException as e:
                 self._record_failure(e)
                 raise

@@ -197,6 +197,17 @@ class DevicePool:
     jit call dispatches there.  Per-device occupancy/skew reporting
     comes from the ``device=<id>`` span attribution (the snapshot's
     ``device_spans`` section), not from pool-side counters.
+
+    Placement is a pure function of the caller's index — the pool keeps
+    no dispatch history.  That statelessness is what both recovery
+    layers lean on: eviction replay re-asks for a window's device and
+    simply receives the next survivor, and a durable RESUME
+    (``pipelines/checkpoint.RunJournal``) that skips journaled windows
+    never perturbs where the remaining windows land, because nothing
+    here depends on which windows were actually dispatched.  The
+    bit-identity invariant never rests on placement anyway (the barrier
+    merges are window-ordered and the backends are parity twins), so
+    skipped windows, evictions and resumes compose freely.
     """
 
     def __init__(self, devices: Optional[Sequence] = None,

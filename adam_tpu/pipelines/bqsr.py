@@ -685,7 +685,8 @@ def apply_table_kernel(
 
 
 def merge_observations(parts: list[tuple], replays=None,
-                       tracer=None, window_ids=None) -> tuple:
+                       tracer=None, window_ids=None,
+                       on_part=None) -> tuple:
     """Sum per-window (total, mism, gl) histograms into one global
     (total, mism, gl) — the host-side analog of the sharded psum.
 
@@ -713,6 +714,12 @@ def merge_observations(parts: list[tuple], replays=None,
     the span attribution — residual windows drop out of ``parts``, so
     the part position ``k`` is NOT the window index whenever any
     window had zero valid rows.
+
+    ``on_part``: optional ``on_part(window, total, mism, g)`` callback
+    invoked with each part's HOST-resident histogram as it merges
+    (after any replay) — the streamed run journal persists its durable
+    observe sidecars here, at the barrier, the one point where every
+    histogram crosses to the host anyway.
     """
     from adam_tpu.parallel.device_pool import span_attrs
     from adam_tpu.utils.transfer import _resident_device, device_fetch
@@ -745,6 +752,9 @@ def merge_observations(parts: list[tuple], replays=None,
             tt, mm, g = replay(e)
             tt = np.asarray(tt)
             mm = np.asarray(mm)
+        if on_part is not None:
+            on_part(window_ids[k] if window_ids is not None else k,
+                    tt, mm, g)
         off = gl - g
         total[:, :, off : off + 2 * g + 1, :] += tt
         mism[:, :, off : off + 2 * g + 1, :] += mm

@@ -1,39 +1,153 @@
-"""Stage checkpoint / restart for composed pipelines.
+"""Checkpoint / restart: stage materialization + the streamed run journal.
 
 The reference delegates fault tolerance to Spark lineage recompute;
-SURVEY §5 told the TPU build to decide its own story. The decision:
-**stage materialization** — each completed pipeline stage can persist
-its full dataset to Parquet under a checkpoint directory with a manifest
-recording stage order and completion, and a rerun of the same pipeline
-resumes from the last completed stage instead of recomputing (the moral
-equivalent of the reference chaining `transform` runs through files,
-made automatic). Inputs stay re-shardable because the checkpoint is the
-columnar Parquet store any mesh shape can reload.
+SURVEY §5 told the TPU build to decide its own story. Two layers:
+
+* **Stage materialization** (:class:`StageCheckpointer` /
+  :func:`run_stages`) — each completed pipeline stage can persist its
+  full dataset to Parquet under a checkpoint directory with a manifest
+  recording stage order and completion, and a rerun of the same
+  pipeline resumes from the last completed stage instead of recomputing
+  (the moral equivalent of the reference chaining `transform` runs
+  through files, made automatic). Inputs stay re-shardable because the
+  checkpoint is the columnar Parquet store any mesh shape can reload.
+
+* **Window-granular durable resume** (:class:`RunJournal`) — the
+  streamed pipeline's journal (``--run-dir`` / ``--resume``,
+  docs/ROBUSTNESS.md "Durable window-granular resume"): a fingerprinted
+  per-run record of which output windows are durably published, plus
+  atomic sidecars for each window's pass-B observe histogram and the
+  solved recalibration table, so an arbitrary host-process death
+  (SIGKILL, OOM, preemption) costs only the incomplete windows — and a
+  resume against changed inputs or a changed flag composition is
+  REFUSED with a clean restart, never silently mixed output.
+
+Both layers share one fingerprint discipline
+(:func:`input_fingerprint` / :func:`compose_fingerprint`): resume
+validity is decided by input content identity + flag composition, not
+by trusting whatever happens to be on disk.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
+import threading
 from typing import Callable, Optional, Sequence
+
+from adam_tpu.utils.durability import atomic_write_bytes, atomic_write_json
 
 logger = logging.getLogger(__name__)
 
 _MANIFEST = "MANIFEST.json"
 
+# ---------------------------------------------------------------------------
+# Fingerprints: input content identity + flag composition
+# ---------------------------------------------------------------------------
+
+#: Inputs at or under this size hash fully; larger ones hash
+#: size + head + tail windows of this size (a WGS-scale BAM must not
+#: cost a full re-read just to *start* a resume).
+_FULL_HASH_LIMIT = 64 << 20
+_EDGE_HASH_BYTES = 8 << 20
+
+
+def input_fingerprint(path: str) -> str:
+    """Content-identity digest of an input file (or columnar store dir).
+
+    Files up to 64 MiB digest in full; larger files digest
+    ``size + first 8 MiB + last 8 MiB`` — cheap to recompute at resume
+    time, and any append, truncation or edit near either end (how SAM/
+    BAM files actually change) flips it.  Directories (a ``.adam``
+    store) digest the sorted non-underscore entry list with sizes.
+    The path itself is NOT part of the identity: the same bytes moved
+    elsewhere still resume.
+    """
+    h = hashlib.sha256()
+    p = os.path.abspath(path)
+    if os.path.isdir(p):
+        h.update(b"dir:")
+        for name in sorted(os.listdir(p)):
+            if name.startswith(("_", ".")):
+                continue
+            try:
+                size = os.path.getsize(os.path.join(p, name))
+            except OSError:
+                size = -1
+            h.update(f"{name}={size};".encode())
+        return h.hexdigest()
+    size = os.path.getsize(p)
+    h.update(f"file:{size};".encode())
+    with open(p, "rb") as fh:
+        if size <= _FULL_HASH_LIMIT:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        else:
+            remaining = _EDGE_HASH_BYTES
+            while remaining:
+                chunk = fh.read(min(remaining, 1 << 20))
+                if not chunk:
+                    break
+                h.update(chunk)
+                remaining -= len(chunk)
+            fh.seek(size - _EDGE_HASH_BYTES)
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()
+
+
+def _canon(v):
+    """JSON-able canonical form of one fingerprint field (numpy arrays
+    and array tuples — the known-SNP/indel tables — digest by content)."""
+    import numpy as np
+
+    if isinstance(v, np.ndarray):
+        a = np.ascontiguousarray(v)
+        return {
+            "ndarray": hashlib.sha256(a.tobytes()).hexdigest(),
+            "dtype": str(a.dtype),
+            "shape": list(a.shape),
+        }
+    if isinstance(v, (list, tuple)):
+        return [_canon(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _canon(v[k]) for k in sorted(v)}
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, np.generic):
+        return v.item()
+    # objects exposing array fields (SnpTable-style): digest their dict
+    d = getattr(v, "__dict__", None)
+    if d:
+        return _canon(d)
+    return repr(v)
+
+
+def compose_fingerprint(fields: dict) -> str:
+    """Stable digest of a flag-composition dict (include the
+    :func:`input_fingerprint` as one of the fields)."""
+    doc = json.dumps(_canon(fields), sort_keys=True)
+    return hashlib.sha256(doc.encode()).hexdigest()
+
 
 class StageCheckpointer:
     """Tracks stage completion under ``directory``.
 
-    The manifest stores the ordered stage list; a stage is resumable only
-    if the recorded order matches the current pipeline's prefix (a
-    changed flag composition invalidates downstream checkpoints).
+    The manifest stores the ordered stage list plus an optional
+    input/flag ``fingerprint`` (:func:`compose_fingerprint`); a stage is
+    resumable only if the recorded order matches the current pipeline's
+    prefix AND the fingerprints agree — a changed flag composition *or a
+    changed input* invalidates the stage stores instead of silently
+    reloading data derived from different bytes.
     """
 
-    def __init__(self, directory: str, stages: Sequence[str]):
+    def __init__(self, directory: str, stages: Sequence[str],
+                 fingerprint: Optional[str] = None):
         self.dir = directory
         self.stages = list(stages)
+        self.fingerprint = fingerprint
         os.makedirs(directory, exist_ok=True)
         self._completed: list[str] = []
         mpath = os.path.join(directory, _MANIFEST)
@@ -54,17 +168,28 @@ class StageCheckpointer:
                 )
                 m = None
         if m is not None:
-            if m.get("stages") == self.stages:
-                self._completed = [
-                    s for s in m.get("completed", [])
-                    if os.path.exists(self.path(s))
-                ]
-            else:
+            if m.get("stages") != self.stages:
                 logger.warning(
                     "checkpoint dir %s was built for stages %s (now %s); "
                     "ignoring old checkpoints", directory,
                     m.get("stages"), self.stages,
                 )
+            elif (fingerprint is not None
+                  and m.get("fingerprint") != fingerprint):
+                # a legacy manifest (no fingerprint) is indistinguishable
+                # from a changed input: recompute — never resume stage
+                # stores that may derive from different bytes/flags
+                logger.warning(
+                    "checkpoint dir %s was built for a different input/"
+                    "flag fingerprint (%s, now %s); ignoring old "
+                    "checkpoints", directory, m.get("fingerprint"),
+                    fingerprint,
+                )
+            else:
+                self._completed = [
+                    s for s in m.get("completed", [])
+                    if os.path.exists(self.path(s))
+                ]
 
     def path(self, stage: str) -> str:
         return os.path.join(self.dir, f"{stage}.adam")
@@ -80,38 +205,37 @@ class StageCheckpointer:
         return last
 
     def mark(self, stage: str) -> None:
-        self._completed.append(stage)
+        # idempotent: a rerun that re-executes an already-recorded stage
+        # (or a caller double-marking) must not grow duplicate
+        # `completed` entries — last_completed() walks a prefix, and a
+        # duplicated list would also re-duplicate on every rewrite
+        if stage not in self._completed:
+            self._completed.append(stage)
         mpath = os.path.join(self.dir, _MANIFEST)
-        tmp = mpath + ".tmp"
-        # temp + atomic rename: a crash mid-write leaves either the old
-        # complete manifest or the new one, never a torn file (and the
-        # init path above tolerates even that)
-        try:
-            with open(tmp, "w") as fh:
-                json.dump(
-                    {"stages": self.stages, "completed": self._completed},
-                    fh,
-                )
-            os.replace(tmp, mpath)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        # temp + fsync + atomic rename (utils/durability): a crash
+        # mid-write leaves either the old complete manifest or the new
+        # one, never a torn file (the init path tolerates even that),
+        # and a power loss after the rename cannot lose the bytes
+        doc = {"stages": self.stages, "completed": self._completed}
+        if self.fingerprint is not None:
+            doc["fingerprint"] = self.fingerprint
+        atomic_write_json(mpath, doc)
 
 
 def run_stages(
     ds,
     stages: Sequence[tuple[str, Callable]],
     checkpoint_dir: Optional[str] = None,
+    fingerprint: Optional[str] = None,
 ):
     """Run ``(name, fn)`` stages over a dataset with optional
     checkpoint-restart.
 
     With a checkpoint dir, each stage's output is materialized to
     Parquet and recorded; a rerun resumes after the deepest completed
-    stage (loading its store) instead of recomputing.
+    stage (loading its store) instead of recomputing.  ``fingerprint``
+    (:func:`compose_fingerprint` over the input identity + flag values)
+    invalidates stale stores from a different input or composition.
     """
     if not checkpoint_dir:
         for _, fn in stages:
@@ -120,7 +244,8 @@ def run_stages(
 
     from adam_tpu.api.datasets import AlignmentDataset
 
-    ck = StageCheckpointer(checkpoint_dir, [n for n, _ in stages])
+    ck = StageCheckpointer(checkpoint_dir, [n for n, _ in stages],
+                           fingerprint=fingerprint)
     resume_after = ck.last_completed()
     skipping = resume_after is not None
     if skipping:
@@ -135,3 +260,291 @@ def run_stages(
         ds.save(ck.path(name))
         ck.mark(name)
     return ds
+
+
+# ---------------------------------------------------------------------------
+# Window-granular durable resume: the streamed run journal
+# ---------------------------------------------------------------------------
+class RunJournal:
+    """Durable resume state for one streamed run (``--run-dir``).
+
+    Layout under ``run_dir`` (docs/ROBUSTNESS.md "Durable
+    window-granular resume")::
+
+        JOURNAL.json           fingerprint, window plan, completed
+                               window -> part-name map (rewritten
+                               whole via temp + fsync + os.replace on
+                               every append — the PR 4 writer contract)
+        obs/window-NNNNN.npz   one atomic sidecar per window's pass-B
+                               observe histogram (total, mism, gl),
+                               written at the merge barrier
+        table.npz              the solved recalibration table + gl,
+                               written once after barrier 2
+
+    A window is recorded complete ONLY after its Parquet part is
+    durably published (fsync + atomic rename, the
+    ``PartWriterPool.on_published`` hook), so every journal entry is
+    backed by readable bytes.  On resume, the journal re-validates the
+    fingerprint (input content identity + flag composition + window
+    plan): any mismatch — including a torn/corrupt journal file — is
+    REFUSED with a clean restart (journal, sidecars AND previously
+    published parts are discarded), never silently mixed output.
+    """
+
+    SCHEMA = "adam_tpu.run_journal/1"
+    JOURNAL_NAME = "JOURNAL.json"
+    OBS_DIR_NAME = "obs"
+    TABLE_NAME = "table.npz"
+
+    def __init__(self, run_dir: str, fingerprint: str, out_dir: str,
+                 resume: bool = False, tracer=None):
+        self.dir = run_dir
+        self.out_dir = out_dir
+        self.fingerprint = fingerprint
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._windows: dict[int, str] = {}
+        self._n_windows: Optional[int] = None
+        self.resumed = False
+        os.makedirs(run_dir, exist_ok=True)
+        os.makedirs(self._obs_dir, exist_ok=True)
+        if resume:
+            self.resumed = self._load()
+            if not self.resumed:
+                self._count_refused()
+        if not self.resumed:
+            self._start_fresh()
+
+    # ---- paths ---------------------------------------------------------
+    @property
+    def _journal_path(self) -> str:
+        return os.path.join(self.dir, self.JOURNAL_NAME)
+
+    @property
+    def _obs_dir(self) -> str:
+        return os.path.join(self.dir, self.OBS_DIR_NAME)
+
+    @property
+    def _table_path(self) -> str:
+        return os.path.join(self.dir, self.TABLE_NAME)
+
+    def observation_path(self, win: int) -> str:
+        return os.path.join(self._obs_dir, f"window-{win:05d}.npz")
+
+    # ---- lifecycle -----------------------------------------------------
+    def _count_refused(self) -> None:
+        from adam_tpu.utils import telemetry as tele
+
+        (self._tracer or tele.TRACE).count(tele.C_RESUME_REFUSED)
+
+    def _load(self) -> bool:
+        """Validate + load an existing journal; False = refuse (the
+        caller restarts clean)."""
+        path = self._journal_path
+        if not os.path.exists(path):
+            logger.warning(
+                "--resume requested but %s has no journal; starting a "
+                "fresh run", self.dir,
+            )
+            return False
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+            if not isinstance(doc, dict):
+                raise ValueError(f"journal is {type(doc).__name__}, "
+                                 "not an object")
+        except (OSError, ValueError) as e:
+            # torn/corrupt journal: a clean restart, never a guess at
+            # which windows might be complete
+            logger.warning(
+                "run journal %s is unreadable (%s); refusing resume and "
+                "restarting clean", path, e,
+            )
+            return False
+        if doc.get("schema") != self.SCHEMA:
+            logger.warning(
+                "run journal %s has schema %r (want %r); refusing resume "
+                "and restarting clean", path, doc.get("schema"),
+                self.SCHEMA,
+            )
+            return False
+        if doc.get("fingerprint") != self.fingerprint:
+            logger.warning(
+                "run journal %s was recorded for a different input/flag "
+                "fingerprint (%s, now %s); refusing resume and restarting "
+                "clean — a resume against changed inputs would silently "
+                "mix stale and fresh windows", path,
+                doc.get("fingerprint"), self.fingerprint,
+            )
+            return False
+        try:
+            windows = {
+                int(k): str(v) for k, v in (doc.get("windows") or {}).items()
+            }
+            n_windows = doc.get("n_windows")
+            if n_windows is not None:
+                n_windows = int(n_windows)
+        except (TypeError, ValueError) as e:
+            logger.warning(
+                "run journal %s has malformed window records (%s); "
+                "refusing resume and restarting clean", path, e,
+            )
+            return False
+        # every journaled part must still be readable bytes on disk —
+        # an externally deleted part silently degrades that window to
+        # "incomplete" (it re-executes), never to a hole in the output
+        kept = {}
+        for win, name in windows.items():
+            part = os.path.join(self.out_dir, name)
+            if os.path.isfile(part) and os.path.getsize(part) > 0:
+                kept[win] = name
+            else:
+                logger.warning(
+                    "journaled part %s for window %d is missing; that "
+                    "window will re-execute", part, win,
+                )
+        self._windows = kept
+        self._n_windows = n_windows
+        return True
+
+    def _start_fresh(self) -> None:
+        """Discard every prior artifact — journal, sidecars, and the
+        previously published parts (stale output from a different run
+        must never mix with this one's)."""
+        self._windows = {}
+        self._n_windows = None
+        for p in (self._journal_path, self._table_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        try:
+            for name in os.listdir(self._obs_dir):
+                try:
+                    os.unlink(os.path.join(self._obs_dir, name))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        from adam_tpu.io.parquet import part_index
+
+        if os.path.isdir(self.out_dir):
+            for name in os.listdir(self.out_dir):
+                if part_index(name) is not None:
+                    try:
+                        os.unlink(os.path.join(self.out_dir, name))
+                    except OSError:
+                        pass
+        self._flush_locked()
+
+    def confirm_plan(self, n_windows: int) -> None:
+        """Pin (or re-validate) the window plan once pass A fixes it.
+        The fingerprint already covers input identity + window sizing,
+        so a mismatch here means the journal lies (manual edits, a
+        collision): degrade to a clean restart rather than trust it."""
+        with self._lock:
+            if self.resumed and self._n_windows is not None \
+                    and self._n_windows != n_windows:
+                logger.warning(
+                    "run journal %s recorded %d windows but this input "
+                    "tokenizes to %d; discarding the journal and "
+                    "restarting clean", self._journal_path,
+                    self._n_windows, n_windows,
+                )
+                self.resumed = False
+                self._count_refused()
+                self._start_fresh()
+            self._n_windows = n_windows
+            self._flush_locked()
+
+    # ---- window completion ---------------------------------------------
+    def completed_windows(self) -> frozenset:
+        """Window/part indices durably complete from a prior run."""
+        with self._lock:
+            return frozenset(self._windows) if self.resumed else frozenset()
+
+    def record_window(self, win: int, part: str) -> None:
+        """Durably record window ``win`` as complete (its part file
+        ``part`` — a name under ``out_dir`` — is already published).
+        Idempotent; safe from the writer pool's publish thread."""
+        with self._lock:
+            if self._windows.get(win) == part:
+                return
+            self._windows[win] = part
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        atomic_write_json(self._journal_path, {
+            "schema": self.SCHEMA,
+            "fingerprint": self.fingerprint,
+            "n_windows": self._n_windows,
+            "windows": {str(k): v for k, v in sorted(self._windows.items())},
+        })
+
+    # ---- observe-histogram / table sidecars ----------------------------
+    def has_observation(self, win: int) -> bool:
+        return os.path.isfile(self.observation_path(win))
+
+    @staticmethod
+    def _npz_bytes(**arrays) -> bytes:
+        import io
+
+        import numpy as np
+
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **arrays)
+        return buf.getvalue()
+
+    def save_observation(self, win, total, mism, gl) -> None:
+        """Persist one window's observe histogram (atomic, idempotent)."""
+        import numpy as np
+
+        path = self.observation_path(win)
+        if os.path.exists(path):
+            return
+        atomic_write_bytes(path, self._npz_bytes(
+            total=np.asarray(total), mism=np.asarray(mism),
+            gl=np.int64(gl),
+        ))
+
+    def load_observation(self, win: int):
+        """-> (total, mism, gl) host arrays, or None (absent/unreadable
+        — the window simply re-observes)."""
+        import numpy as np
+
+        path = self.observation_path(win)
+        if not os.path.isfile(path):
+            return None
+        try:
+            with np.load(path) as z:
+                return z["total"], z["mism"], int(z["gl"])
+        except Exception as e:
+            logger.warning(
+                "observe sidecar %s is unreadable (%s); window %d will "
+                "re-observe", path, e, win,
+            )
+            return None
+
+    def save_table(self, table, gl) -> None:
+        """Persist the solved recalibration table (once, after barrier 2)."""
+        import numpy as np
+
+        atomic_write_bytes(self._table_path, self._npz_bytes(
+            table=np.asarray(table), gl=np.int64(gl),
+        ))
+
+    def load_table(self):
+        """-> (table, gl), or None when absent/unreadable."""
+        import numpy as np
+
+        if not (self.resumed and os.path.isfile(self._table_path)):
+            return None
+        try:
+            with np.load(self._table_path) as z:
+                return z["table"], int(z["gl"])
+        except Exception as e:
+            logger.warning(
+                "recalibration-table sidecar %s is unreadable (%s); "
+                "re-solving from observations", self._table_path, e,
+            )
+            return None

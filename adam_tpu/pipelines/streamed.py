@@ -74,6 +74,7 @@ import numpy as np
 
 from adam_tpu.api.datasets import AlignmentDataset
 from adam_tpu.parallel import device_pool as dp_mod
+from adam_tpu.utils import faults
 from adam_tpu.utils import telemetry as tele
 from adam_tpu.utils.transfer import device_fetch
 
@@ -123,6 +124,8 @@ def _ingest_windows(path: str, window_reads: int, out_q: queue.Queue,
                 break
             if not put(item):
                 return
+            # chaos-harness kill point: one arrival per tokenized window
+            faults.point("proc.kill", device="ingest")
             i += 1
         put(_SENTINEL)
     except BaseException as e:  # surface in the consumer
@@ -130,7 +133,12 @@ def _ingest_windows(path: str, window_reads: int, out_q: queue.Queue,
 
 
 def _part_path(out_dir: str, part_idx: int) -> str:
-    return os.path.join(out_dir, f"part-r-{part_idx:05d}.parquet")
+    # the io/parquet part-naming contract: the numeric index IS the
+    # window index (realigned tail part = n_windows), so the streamed
+    # run journal can map published parts back onto the window plan
+    from adam_tpu.io.parquet import part_path
+
+    return part_path(out_dir, part_idx)
 
 
 def _write_part(out_dir: str, part_idx: int, ds: AlignmentDataset,
@@ -224,6 +232,8 @@ def transform_streamed(
     dump_observations: Optional[str] = None,
     devices: Optional[int] = None,
     progress: Optional[str] = None,
+    run_dir: Optional[str] = None,
+    resume: bool = False,
 ) -> dict:
     """Run the flagship transform as a streamed, overlapped pipeline.
 
@@ -240,6 +250,16 @@ def transform_streamed(
     thread emits one NDJSON line (schema
     :data:`~adam_tpu.utils.telemetry.HEARTBEAT_FIELDS`) every
     ``ADAM_TPU_PROGRESS_INTERVAL_S`` seconds.
+
+    ``run_dir`` enables the durable window-granular resume journal
+    (docs/ROBUSTNESS.md): each output window is recorded complete after
+    its part's atomic+fsync'd publish, observe histograms and the
+    solved recalibration table persist as atomic sidecars, and with
+    ``resume=True`` a rerun after an arbitrary process kill skips the
+    completed windows — bit-identical to an uninterrupted run.  A
+    resume whose input content, flag composition or window plan differs
+    from the journal's fingerprint is refused with a clean restart
+    (stale parts discarded), never mixed output.
     """
     # Per-run tracer, ALWAYS recording: the returned stats dict is a
     # derived view of its span data (telemetry.streamed_stats_view), so
@@ -259,6 +279,7 @@ def transform_streamed(
             max_consensus_number=max_consensus_number,
             lod_threshold=lod_threshold, max_target_size=max_target_size,
             dump_observations=dump_observations, devices=devices,
+            run_dir=run_dir, resume=resume,
         )
     except BaseException:
         # crashed run: the final heartbeat line must carry ok=false —
@@ -293,6 +314,8 @@ def _transform_streamed_impl(
     max_target_size: int | None,
     dump_observations: Optional[str],
     devices: Optional[int],
+    run_dir: Optional[str],
+    resume: bool,
 ) -> dict:
     from adam_tpu.pipelines import bqsr as bqsr_mod
     from adam_tpu.pipelines import markdup as md_mod
@@ -398,6 +421,37 @@ def _transform_streamed_impl(
         max_indel_size, max_consensus_number, lod_threshold, max_target_size
     )
 
+    # ---- durable window-granular resume (docs/ROBUSTNESS.md) -----------
+    # The journal fingerprints input content identity + the full
+    # output-bit-affecting flag composition (the backend/device count is
+    # deliberately EXCLUDED: the kernels are bit-parity twins, so a
+    # resume on different hardware is still bit-identical).  Window
+    # completion is recorded only after a part's durable publish, via
+    # the writer pool's on_published hook below.
+    journal = None
+    if run_dir:
+        from adam_tpu.pipelines import checkpoint as ck_mod
+
+        fp = ck_mod.compose_fingerprint({
+            "schema": "adam_tpu.streamed/1",
+            "input": ck_mod.input_fingerprint(path),
+            "mark_duplicates": mark_duplicates,
+            "recalibrate": recalibrate,
+            "realign": realign,
+            "consensus_model": consensus_model,
+            "window_reads": window_reads,
+            "compression": compression,
+            "max_indel_size": mis,
+            "max_consensus_number": mcn,
+            "lod_threshold": lod,
+            "max_target_size": mts,
+            "known_snps": known_snps,
+            "known_indels": known_indels,
+        })
+        journal = ck_mod.RunJournal(
+            run_dir, fp, out_path, resume=resume, tracer=tr
+        )
+
     # ---- pass A: ingest || summaries + events --------------------------
     in_q: queue.Queue = queue.Queue(maxsize=3)
     abort = threading.Event()
@@ -478,6 +532,8 @@ def _transform_streamed_impl(
                 n_reads += n_window_reads
                 tr.count(tele.C_READS_INGESTED, n_window_reads)
                 tr.count(tele.C_WINDOWS_INGESTED)
+                # chaos-harness kill point: one arrival per pass-A window
+                faults.point("proc.kill", device="pass_a")
                 if dpool is not None and win == 0:
                     # compile the grid-quantized kernel set once per
                     # device, concurrently, BEFORE any window's device
@@ -539,6 +595,24 @@ def _transform_streamed_impl(
             raise
         ingest.join()
     stats["n_reads"] = n_reads
+    # pin/validate the window plan and fix the resumable set: a window
+    # (or the realigned tail part, index n_windows) whose part the
+    # journal records as durably published is skipped in pass C
+    if journal is not None:
+        journal.confirm_plan(len(windows))
+    done_parts = (
+        journal.completed_windows() if journal is not None else frozenset()
+    )
+    n_resumed = len(done_parts)
+    stats["windows_resumed"] = n_resumed
+    if n_resumed:
+        tr.count(tele.C_RESUME_WINDOWS_SKIPPED, n_resumed)
+        # no total in the message: whether a realigned tail part exists
+        # (the +1) is not known until the candidate split
+        log.info(
+            "resume: %d output window(s) already durably published; "
+            "re-executing only the remainder", n_resumed,
+        )
     if hb is not None:
         hb.set_total(len(windows))
     if header is None or not windows:
@@ -593,6 +667,16 @@ def _transform_streamed_impl(
                 windows[i] = w
             window_valid.append(n_valid)
 
+    # post-barrier-2 resume: the solved recalibration table persisted by
+    # a previous run short-circuits the whole observe pass — a crash
+    # after barrier 2 resumes straight into pass C without re-observing
+    # anything.  -dump_observations forces a full re-merge (the CSV is
+    # derived from the merged histograms, which the table alone cannot
+    # reproduce); per-window sidecars still spare the device work.
+    resume_table = None
+    if journal is not None and recalibrate and not dump_observations:
+        resume_table = journal.load_table()
+
     def _observe_host(w):
         total, mism, _rg, g = bqsr_mod._observe_device(
             w, known_snps, _host_backend() if use_device else backend
@@ -621,7 +705,16 @@ def _transform_streamed_impl(
     def _observe_window(i, w):
         """Observe one window -> ((total, mism, g), replay hook or
         None), walking dispatch failures to the next survivor and to
-        the host backend when the pool is gone."""
+        the host backend when the pool is gone.  A histogram persisted
+        by a previous run (the barrier sidecars) loads instead of
+        recomputing — identical int64 sums, so the window-ordered merge
+        stays bit-identical."""
+        if journal is not None and journal.resumed:
+            got = journal.load_observation(i)
+            if got is not None:
+                tr.count(tele.C_RESUME_HISTOGRAMS_LOADED)
+                return (np.asarray(got[0]), np.asarray(got[1]),
+                        got[2]), None
         if not use_device:
             return _observe_host(w), None
 
@@ -643,6 +736,10 @@ def _transform_streamed_impl(
         # On the device backend the histograms come back LAZY: every
         # window's scatter-add queues on the chip and the compact
         # tables are fetched together at the merge barrier.
+        if resume_table is not None:
+            # the solved table is already persisted: no observation can
+            # change it, so the pass is pure waste on a resume
+            return
         with tr.span(tele.SPAN_OBSERVE):
             if recalibrate:
                 for i, w in enumerate(windows):
@@ -663,7 +760,26 @@ def _transform_streamed_impl(
     # reference's Transform composition) ---------------------------------
     t_tail_ns = time.monotonic_ns()
     realigned: Optional[AlignmentDataset] = None
-    if candidates:
+    # resume fast path for the realign tail: when the realigned part
+    # (index n_windows) is already durably published AND its
+    # contribution to the recalibration table is recoverable (the
+    # solved table itself, or its persisted observe histogram), the
+    # whole candidate realign — the GEMM sweeps — is skippable.  The
+    # sidecar is LOADED here, not just probed: an unreadable sidecar
+    # must force the re-realign, or the merged table would silently
+    # miss the realigned part's observations.
+    skip_realign = False
+    r_obs = None
+    if (
+        candidates and journal is not None and journal.resumed
+        and len(windows) in done_parts
+    ):
+        if not recalibrate or resume_table is not None:
+            skip_realign = True
+        else:
+            r_obs = journal.load_observation(len(windows))
+            skip_realign = r_obs is not None
+    if candidates and not skip_realign:
         cand = AlignmentDataset.concat(candidates)
         tr.count(tele.C_CANDIDATE_ROWS, int(cand.batch.n_rows))
         realigned = realign_mod.realign_indels(
@@ -676,7 +792,7 @@ def _transform_streamed_impl(
             max_target_size=mts,
             overlap_work=_observe_remainders,
         )
-        if recalibrate and realigned.batch.n_rows:
+        if recalibrate and realigned.batch.n_rows and resume_table is None:
             part, replay = _observe_window(len(windows), realigned)
             obs_parts.append(part)
             obs_replays.append(replay)
@@ -689,6 +805,21 @@ def _transform_streamed_impl(
         hidden = bool(
             getattr(_observe_remainders, "overlap_ran_in_dispatch", False)
         )
+    elif skip_realign:
+        # journaled realigned part + recoverable table contribution:
+        # observe the remaining windows (persisted histograms load, the
+        # rest recompute) and splice the realigned part's persisted
+        # histogram in at its window-plan position — the same part
+        # order the uninterrupted run merges
+        _observe_remainders()
+        if r_obs is not None:
+            tr.count(tele.C_RESUME_HISTOGRAMS_LOADED)
+            obs_parts.append(
+                (np.asarray(r_obs[0]), np.asarray(r_obs[1]), r_obs[2])
+            )
+            obs_replays.append(None)
+            obs_windows.append(len(windows))
+        hidden = False
     else:
         _observe_remainders()
         # no realignment ran: the tail wall IS the observe pass
@@ -700,7 +831,34 @@ def _transform_streamed_impl(
     # ---- barrier 2: merge histograms, solve the table ------------------
     table = None
     gl = 0
-    if recalibrate and obs_parts:
+    if resume_table is not None:
+        # post-barrier-2 resume: the persisted table IS the barrier's
+        # output (solved from the identical window histograms), so the
+        # merge and solve are skipped wholesale
+        table = np.ascontiguousarray(resume_table[0], np.uint8)
+        gl = int(resume_table[1])
+        tr.add_span(tele.SPAN_SOLVE, time.monotonic_ns(), 0)
+    elif recalibrate and obs_parts:
+        # chaos-harness kill point: barrier-2 entry (nothing persisted
+        # yet — a resume replays every un-persisted observation)
+        faults.point("proc.kill", device="barrier2")
+
+        def _persist_obs(win, tt, mm, g):
+            # one atomic sidecar per window, written at the barrier as
+            # each histogram becomes host-resident (idempotent: windows
+            # whose sidecar loaded above rewrite nothing).  Best-effort
+            # — the sidecars only ACCELERATE a resume; a full disk on
+            # the run dir must not kill an otherwise healthy run.
+            if journal is None:
+                return
+            try:
+                journal.save_observation(win, tt, mm, g)
+            except OSError as e:
+                log.warning(
+                    "observe sidecar persist failed for window %d: %s",
+                    win, e,
+                )
+
         # count only the parts that are genuinely device-resident at
         # the barrier — after a mid-run degradation some (or all) parts
         # are host-computed and the merge fetches nothing for them
@@ -710,7 +868,7 @@ def _transform_streamed_impl(
         with tr.span(tele.SPAN_OBS_MERGE):
             total, mism, gl = bqsr_mod.merge_observations(
                 obs_parts, replays=obs_replays, tracer=tr,
-                window_ids=obs_windows,
+                window_ids=obs_windows, on_part=_persist_obs,
             )
         if n_dev_parts:
             tr.count(tele.C_DEVICE_FETCHED, n_dev_parts)
@@ -728,6 +886,14 @@ def _transform_streamed_impl(
                     dump_observations,
                 )
             table = bqsr_mod.solve_recalibration_table(total, mism)
+        if journal is not None:
+            try:
+                journal.save_table(table, gl)
+            except OSError as e:
+                log.warning("recalibration-table persist failed: %s", e)
+        # chaos-harness kill point: barrier-2 exit (table persisted — a
+        # resume goes straight into pass C)
+        faults.point("proc.kill", device="barrier2")
     else:
         tr.add_span(tele.SPAN_SOLVE, time.monotonic_ns(), 0)
 
@@ -740,28 +906,49 @@ def _transform_streamed_impl(
 
     # the realigned part applies and submits FIRST: it is the largest
     # part, so its encode+write should overlap the window applies
-    # instead of draining serially after them
+    # instead of draining serially after them.  Windows whose part the
+    # journal records as durably published are skipped outright — the
+    # resume's whole point — and their decoded batches freed now.
     parts: list = []
-    if realigned is not None:
+    if realigned is not None and len(windows) not in done_parts:
         parts.append((len(windows), realigned))
     parts.extend(
-        (i, w) for i, w in enumerate(windows) if window_valid[i]
+        (i, w) for i, w in enumerate(windows)
+        if window_valid[i] and i not in done_parts
     )
+    for i in done_parts:
+        if i < len(windows):
+            windows[i] = None
+    stats["windows_fresh"] = len(parts)
     if hb is not None:
-        # the real part count (residual windows drop out, the realigned
-        # part joins): the heartbeat's ETA extrapolates parts_written
-        # against this — windows_total itself stays the pass-A window
-        # count, so a progress ratio can never exceed 1
+        # the part count THIS process will write (residual windows drop
+        # out, the realigned part joins, resumed windows are skipped):
+        # the heartbeat's ETA extrapolates parts_written against this —
+        # windows_total itself stays the pass-A window count, so a
+        # progress ratio can never exceed 1
         hb.set_parts_total(len(parts))
+
+    from adam_tpu.io.parquet import part_index as parquet_part_index
+
+    def _on_published(p):
+        # writer-pool publish hook (write thread): the part's bytes are
+        # durably on disk — record its window complete in the journal
+        idx = parquet_part_index(p)
+        if idx is not None:
+            journal.record_window(idx, os.path.basename(p))
+
     # 3 parts in flight: one writing, one encoding, one being applied/
     # submitted — each stage's resource stays busy without the pool
     # pinning more than 3 decoded windows
     pool = PartWriterPool(
         n_encoders=max(1, n_writers - 1), inflight_parts=3,
         compression=compression,
+        on_published=_on_published if journal is not None else None,
     )
 
     def _submit(idx, ds):
+        # chaos-harness kill point: one arrival per fresh part submit
+        faults.point("proc.kill", device="pass_c")
         pool.submit(_part_path(out_path, idx), ds.batch, ds.sidecar,
                     ds.header)
 

@@ -400,6 +400,10 @@ def analyze(doc: dict) -> dict:
                 tele.C_PARTS_WRITTEN, tele.C_BYTES_WRITTEN,
                 tele.C_RETRY_ATTEMPTS, tele.C_FAULT_INJECTED,
                 tele.C_DEVICE_EVICTED,
+                # resumed-vs-fresh window accounting (a resumed run's
+                # report must say how much work the journal spared)
+                tele.C_RESUME_WINDOWS_SKIPPED,
+                tele.C_RESUME_HISTOGRAMS_LOADED, tele.C_RESUME_REFUSED,
             )
             if k in counters
         },

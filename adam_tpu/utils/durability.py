@@ -1,0 +1,82 @@
+"""Durable-write primitives shared by every crash-consistency seam.
+
+The atomic-rename protocol (PR 4's writer contract: write to a temp
+name, publish with ``os.replace``) guarantees readers never observe a
+torn file — but rename alone is only *crash-consistent*, not *durable*:
+on a power loss (or a dirtied-page-cache host death) some filesystems
+may persist the rename before the file's data blocks, publishing a torn
+part under the final name.  The fix is the classic three-step::
+
+    fsync(tmp)          # the bytes are on disk before the name moves
+    os.replace(tmp, dst)
+    fsync(dir(dst))     # the directory entry (the rename) is on disk
+
+Everything that publishes a durability-bearing artifact — Parquet parts
+(``io/parquet.py``), checkpoint manifests and the streamed run journal
+(``pipelines/checkpoint.py``) — routes through these helpers, so the
+guarantee lives in one place (documented in docs/ROBUSTNESS.md).
+
+``fsync_dir`` is best-effort: some filesystems (and all of Windows)
+refuse ``open(dir)``/``fsync`` — degrading to plain atomic-rename
+semantics there is correct, losing only the power-loss window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def fsync_file(path: str) -> None:
+    """fsync an already-written file by path."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort directory fsync (persists renames/creates within)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def publish_file(tmp: str, dst: str) -> None:
+    """Durably publish ``tmp`` as ``dst``: fsync the data, atomically
+    rename, fsync the destination directory.  After this returns the
+    complete file survives a power loss; a crash at any earlier point
+    leaves ``dst`` untouched (either absent or its previous version)."""
+    fsync_file(tmp)
+    os.replace(tmp, dst)
+    fsync_dir(os.path.dirname(os.path.abspath(dst)))
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Durable whole-file write via temp + :func:`publish_file`.  The
+    temp name is deterministic (``<path>.tmp``) — callers own the
+    directory and serialize their own writes, so a stale temp from a
+    crashed predecessor is simply overwritten."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        publish_file(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, obj) -> None:
+    atomic_write_bytes(path, json.dumps(obj).encode())

@@ -24,7 +24,7 @@ docs/ROBUSTNESS.md)::
 
     spec    := clause (';' clause)*
     clause  := site '=' action (',' option)*
-    action  := 'transient' | 'permanent' | 'delay:<seconds>'
+    action  := 'transient' | 'permanent' | 'delay:<seconds>' | 'kill'
     option  := 'every=N'    match every Nth arrival at the site
              | 'after=N'    skip the first N arrivals
              | 'times=N'    stop matching after N injections
@@ -40,8 +40,10 @@ fetches from a single host thread, so it is).  ``transient`` raises
 it), ``permanent`` raises :class:`PermanentFault` (never retried — the
 device-eviction path owns it), ``delay:S`` sleeps S seconds at the site
 (a hung RPC; the fetch deadline watchdog turns it into a retryable
-timeout).  Every injection counts ``fault.injected`` on the global
-telemetry tracer.
+timeout), ``kill`` SIGKILLs the process itself (a host death — the
+kill-and-resume chaos harness's weapon; see the ``proc.kill`` site).
+Every injection counts ``fault.injected`` on the global telemetry
+tracer.
 """
 
 from __future__ import annotations
@@ -63,6 +65,17 @@ KNOWN_POINTS = frozenset({
     "parquet.write",
     "parquet.encode",
     "pool.prewarm",
+    # host-process death (the kill-and-resume chaos harness,
+    # scripts/chaos-kill-resume): the streamed pipeline arrives at this
+    # site once per phase step, with the PHASE name in the ``device``
+    # attribution slot — ``ingest`` (per tokenized window), ``pass_a``
+    # (per window summary), ``barrier2`` (before the observe merge and
+    # again after the solve), ``pass_c`` (per part submit) and ``write``
+    # (after each part's atomic publish) — so a clause like
+    # ``proc.kill=kill,device=pass_c,after=3,times=1`` SIGKILLs the
+    # process at a chosen (or ``p=F,seed=N`` randomized-but-seeded)
+    # point without any cooperation from the code under test.
+    "proc.kill",
 })
 
 
@@ -143,10 +156,10 @@ def _parse_clause(text: str) -> _Clause:
                 f"fault clause {text!r}: delay wants a float seconds value"
             ) from None
         action = "delay"
-    if action not in ("transient", "permanent", "delay"):
+    if action not in ("transient", "permanent", "delay", "kill"):
         raise ValueError(
             f"fault clause {text!r}: unknown action {action!r} "
-            "(expected transient | permanent | delay:<seconds>)"
+            "(expected transient | permanent | delay:<seconds> | kill)"
         )
     every = times = None
     after = 0
@@ -255,6 +268,17 @@ def point(site: str, device=None) -> None:
                     site, dev_id, fire.delay_s)
         time.sleep(fire.delay_s)
         return
+    if fire.action == "kill":
+        # a real host-process death: SIGKILL to self, no cleanup, no
+        # atexit — exactly what an OOM kill or a preemption delivers.
+        # The durable-resume machinery (docs/ROBUSTNESS.md) is what
+        # must survive this; nothing in-process is supposed to.
+        import signal
+
+        log.warning("fault injected at %s (device=%s): SIGKILL self",
+                    site, dev_id)
+        os.kill(os.getpid(), signal.SIGKILL)
+        return  # pragma: no cover - unreachable after SIGKILL
     log.warning("fault injected at %s (device=%s): %s", site, dev_id,
                 fire.action)
     if fire.action == "permanent":

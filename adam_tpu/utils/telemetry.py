@@ -168,6 +168,16 @@ C_POOL_PREWARM_COMPILES = _metric("device.pool.prewarm.compiles")
 C_FAULT_INJECTED = _metric("fault.injected")
 C_RETRY_ATTEMPTS = _metric("retry.attempts")
 C_DEVICE_EVICTED = _metric("device.evicted")
+# durable-resume counters (pipelines/checkpoint.RunJournal +
+# pipelines/streamed.py --run-dir/--resume; docs/ROBUSTNESS.md "Durable
+# window-granular resume"): output windows skipped because the journal
+# records their part as durably published, persisted pass-B observe
+# histograms reloaded instead of recomputed, and resumes REFUSED
+# (fingerprint mismatch / torn journal → clean restart, never mixed
+# output).  All zero on a fresh run.
+C_RESUME_WINDOWS_SKIPPED = _metric("resume.windows_skipped")
+C_RESUME_HISTOGRAMS_LOADED = _metric("resume.histograms_loaded")
+C_RESUME_REFUSED = _metric("resume.refused")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
@@ -930,6 +940,7 @@ HEARTBEAT_FIELDS = (
     "elapsed_s",
     "windows_ingested",
     "windows_total",
+    "windows_resumed",
     "parts_written",
     "reads_ingested",
     "reads_per_s",
@@ -1122,6 +1133,12 @@ class Heartbeat:
             "elapsed_s": round(elapsed, 3),
             "windows_ingested": counters.get(C_WINDOWS_INGESTED, 0),
             "windows_total": total,
+            # resumed-vs-fresh visibility: parts_written / eta_s already
+            # count only THIS process's work (the skipped windows never
+            # reach the writer pool), so this is the one field a
+            # consumer needs to tell a resumed completion from a fresh
+            # one
+            "windows_resumed": counters.get(C_RESUME_WINDOWS_SKIPPED, 0),
             "parts_written": parts,
             "reads_ingested": reads,
             "reads_per_s": (

@@ -74,6 +74,27 @@ def test_fault_spec_parse_and_validation():
             faults.parse_spec(bad)
 
 
+def test_kill_action_parses_and_sigkills_self(monkeypatch):
+    """The ``kill`` action (the chaos harness's weapon at the
+    ``proc.kill`` site) parses and delivers SIGKILL to the process
+    itself — captured here instead of actually dying."""
+    (c,) = faults.parse_spec("proc.kill=kill,device=pass_c,after=1,times=1")
+    assert c.site == "proc.kill" and c.action == "kill"
+    assert c.device == "pass_c" and c.after == 1 and c.times == 1
+    sent = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: sent.append((pid, sig)))
+    faults.install("proc.kill=kill,device=pass_a,after=1,times=1")
+    faults.point("proc.kill", device="ingest")   # wrong phase: no count
+    faults.point("proc.kill", device="pass_a")   # arrival 1: after=1 skips
+    assert sent == []
+    faults.point("proc.kill", device="pass_a")   # arrival 2: fires
+    assert sent == [(os.getpid(), signal.SIGKILL)]
+    faults.point("proc.kill", device="pass_a")   # times=1 spent
+    assert len(sent) == 1
+    with pytest.raises(ValueError):
+        faults.parse_spec("device.dispatch=kill:9")  # kill takes no arg
+
+
 def test_point_disabled_is_noop_and_deterministic_when_armed():
     faults.clear()
     faults.point("device.dispatch")  # disarmed: must do nothing
@@ -343,6 +364,59 @@ def test_checkpoint_manifest_atomic_and_tolerant(tmp_path):
     ck2.mark("a")  # and the next mark heals it atomically
     with open(os.path.join(d, "MANIFEST.json")) as fh:
         assert json.load(fh)["completed"] == ["a"]
+
+
+def test_checkpoint_mark_idempotent_and_fingerprint_invalidates(tmp_path):
+    """`mark()` never grows duplicate completed entries, and a manifest
+    recorded under a different input/flag fingerprint is ignored (a
+    recompute) instead of silently reloading stale stage stores."""
+    from adam_tpu.pipelines.checkpoint import StageCheckpointer
+
+    d = str(tmp_path / "ck")
+    ck = StageCheckpointer(d, ["a", "b"], fingerprint="fp1")
+    ck.mark("a")
+    ck.mark("a")  # rerun double-mark: no duplicate
+    with open(os.path.join(d, "MANIFEST.json")) as fh:
+        m = json.load(fh)
+    assert m["completed"] == ["a"] and m["fingerprint"] == "fp1"
+    # the stage store must exist for resume (last_completed filters)
+    open(os.path.join(d, "a.adam"), "w").write("x")
+    assert StageCheckpointer(d, ["a", "b"],
+                             fingerprint="fp1").last_completed() == "a"
+    # changed input/flags -> different fingerprint -> no resume
+    ck2 = StageCheckpointer(d, ["a", "b"], fingerprint="fp2")
+    assert ck2.last_completed() is None
+    # a legacy manifest without a fingerprint is equally untrusted
+    with open(os.path.join(d, "MANIFEST.json"), "w") as fh:
+        json.dump({"stages": ["a", "b"], "completed": ["a"]}, fh)
+    assert StageCheckpointer(d, ["a", "b"],
+                             fingerprint="fp1").last_completed() is None
+    # ... but a fingerprint-less caller (the legacy API) still resumes
+    assert StageCheckpointer(d, ["a", "b"]).last_completed() == "a"
+
+
+def test_compose_and_input_fingerprints(tmp_path):
+    from adam_tpu.pipelines import checkpoint as ck
+
+    p = str(tmp_path / "in.sam")
+    open(p, "w").write("@HD\tVN:1.5\nr1\t0\tc\t1\t60\t4M\t*\t0\t0\tACGT\tIIII\n")
+    f1 = ck.input_fingerprint(p)
+    assert f1 == ck.input_fingerprint(p)  # stable
+    # content identity, not path identity
+    p2 = str(tmp_path / "moved.sam")
+    os.rename(p, p2)
+    assert ck.input_fingerprint(p2) == f1
+    open(p2, "a").write("r2\t4\t*\t0\t0\t*\t*\t0\t0\tACGT\tIIII\n")
+    assert ck.input_fingerprint(p2) != f1
+    # flag composition: value changes and array-content changes flip it
+    base = {"input": f1, "window_reads": 256,
+            "known": np.arange(4, dtype=np.int64)}
+    fp = ck.compose_fingerprint(base)
+    assert fp == ck.compose_fingerprint(dict(base))
+    assert fp != ck.compose_fingerprint({**base, "window_reads": 512})
+    assert fp != ck.compose_fingerprint(
+        {**base, "known": np.arange(1, 5, dtype=np.int64)}
+    )
 
 
 # ---------------------------------------------------------------------------

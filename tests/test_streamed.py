@@ -158,6 +158,182 @@ def test_streamed_stage_toggles(tmp_path):
     _assert_equal(mono, back)
 
 
+# ---------------------------------------------------------------------------
+# Durable window-granular resume (docs/ROBUSTNESS.md; --run-dir/--resume)
+# ---------------------------------------------------------------------------
+def _parts_hash(out_dir):
+    import hashlib
+
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(out_dir, f), "rb").read()
+        ).hexdigest()
+        for f in os.listdir(out_dir) if f.startswith("part-")
+    }
+
+
+def test_streamed_journal_resume_skips_completed_windows(tmp_path):
+    """A journaled run resumes: a full resume skips every window, a
+    resume after two parts vanish rewrites exactly those two —
+    byte-identical to the journal-free run either way."""
+    from make_synth_sam import make_sam
+
+    path = str(tmp_path / "in.sam")
+    make_sam(path, 2048, 100)
+    clean = str(tmp_path / "clean.adam")
+    transform_streamed(path, clean, window_reads=256)
+    baseline = _parts_hash(clean)
+
+    out, rd = str(tmp_path / "j.adam"), str(tmp_path / "run")
+    s1 = transform_streamed(path, out, window_reads=256, run_dir=rd)
+    assert s1["windows_resumed"] == 0
+    assert _parts_hash(out) == baseline
+    # the journal artifacts exist: window map + obs sidecars + table
+    assert os.path.isfile(os.path.join(rd, "JOURNAL.json"))
+    assert os.path.isfile(os.path.join(rd, "table.npz"))
+    assert os.listdir(os.path.join(rd, "obs"))
+
+    s2 = transform_streamed(path, out, window_reads=256, run_dir=rd,
+                            resume=True)
+    assert s2["windows_fresh"] == 0 and s2["windows_resumed"] > 0
+    assert _parts_hash(out) == baseline
+
+    # journaled-but-deleted parts degrade to "incomplete", never a hole
+    os.unlink(os.path.join(out, "part-r-00001.parquet"))
+    os.unlink(os.path.join(out, "part-r-00004.parquet"))
+    s3 = transform_streamed(path, out, window_reads=256, run_dir=rd,
+                            resume=True)
+    assert s3["windows_fresh"] == 2
+    assert _parts_hash(out) == baseline
+
+
+_KILL_DRIVER = (
+    "import sys\n"
+    "try:\n"
+    "    import jax, jax._src.xla_bridge as xb\n"
+    "    xb._backend_factories.pop('axon', None)\n"
+    "    jax.config.update('jax_platforms', 'cpu')\n"
+    "except Exception: pass\n"
+    "from adam_tpu.pipelines.streamed import transform_streamed\n"
+    "transform_streamed(sys.argv[1], sys.argv[2], window_reads=256,\n"
+    "                   run_dir=sys.argv[3], resume=sys.argv[4] == '1')\n"
+)
+
+#: (phase, arrival offset) — one SIGKILL at each phase boundary the
+#: proc.kill fault point exposes (docs/ROBUSTNESS.md)
+_KILL_MATRIX = [
+    ("ingest", 3), ("pass_a", 4), ("barrier2", 0), ("pass_c", 2),
+    ("write", 1),
+]
+
+
+@pytest.mark.parametrize("phase,after", _KILL_MATRIX,
+                         ids=[p for p, _ in _KILL_MATRIX])
+def test_streamed_sigkill_then_resume_bit_identical(
+    tmp_path_factory, kill_resume_input, phase, after
+):
+    """SIGKILL (a real host death via the proc.kill fault point) at
+    each phase boundary, then --resume: the completed output must be
+    byte-identical to the uninterrupted run."""
+    import signal
+    import subprocess
+
+    path, baseline = kill_resume_input
+    d = tmp_path_factory.mktemp(f"kill_{phase}")
+    out, rd = str(d / "out.adam"), str(d / "run")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # host backend: the machinery under test is the journal, and a
+        # subprocess chip probe would only slow both runs down
+        "ADAM_TPU_BQSR_BACKEND": "numpy",
+        "ADAM_TPU_FAULTS":
+            f"proc.kill=kill,device={phase},after={after},times=1",
+    })
+    cwd = os.path.join(os.path.dirname(__file__), "..")
+    rc = subprocess.run(
+        [sys.executable, "-c", _KILL_DRIVER, path, out, rd, "0"],
+        env=env, cwd=cwd,
+    ).returncode
+    assert rc == -signal.SIGKILL, f"{phase}: expected SIGKILL, got {rc}"
+    env.pop("ADAM_TPU_FAULTS")
+    rc = subprocess.run(
+        [sys.executable, "-c", _KILL_DRIVER, path, out, rd, "1"],
+        env=env, cwd=cwd,
+    ).returncode
+    assert rc == 0, f"{phase}: resume exited {rc}"
+    assert _parts_hash(out) == baseline, f"{phase}: resumed output differs"
+    # crash consistency held throughout: no staging residue
+    assert not [f for f in os.listdir(out) if f.endswith(".tmp")]
+    assert not os.path.isdir(os.path.join(out, "_temporary"))
+
+
+@pytest.fixture(scope="module")
+def kill_resume_input(tmp_path_factory):
+    """Shared input + uninterrupted-run baseline for the SIGKILL matrix
+    (one numpy-backend run, matching the subprocess drivers)."""
+    from make_synth_sam import make_sam
+
+    d = tmp_path_factory.mktemp("kill_resume")
+    path = str(d / "in.sam")
+    make_sam(path, 2048, 100)
+    clean = str(d / "clean.adam")
+    os.environ["ADAM_TPU_BQSR_BACKEND"] = "numpy"
+    try:
+        transform_streamed(path, clean, window_reads=256)
+    finally:
+        os.environ.pop("ADAM_TPU_BQSR_BACKEND", None)
+    return path, _parts_hash(clean)
+
+
+def test_streamed_resume_refuses_changed_input_and_flags(tmp_path):
+    """A resume whose input bytes or flag composition differ from the
+    journal's fingerprint restarts clean — the output must equal a
+    fresh run of the NEW configuration, with no stale parts mixed in."""
+    from make_synth_sam import make_sam
+
+    pA, pB = str(tmp_path / "a.sam"), str(tmp_path / "b.sam")
+    make_sam(pA, 1024, 100)
+    make_sam(pB, 1536, 100)
+    out, rd = str(tmp_path / "out.adam"), str(tmp_path / "run")
+    transform_streamed(pA, out, window_reads=256, run_dir=rd)
+
+    # changed input content: refused, restarted, equals clean run of B
+    s = transform_streamed(pB, out, window_reads=256, run_dir=rd,
+                           resume=True)
+    assert s["windows_resumed"] == 0
+    clean_b = str(tmp_path / "cleanB.adam")
+    transform_streamed(pB, clean_b, window_reads=256)
+    assert _parts_hash(out) == _parts_hash(clean_b)
+
+    # changed window plan: refused again (the part layout would differ)
+    s = transform_streamed(pB, out, window_reads=512, run_dir=rd,
+                           resume=True)
+    assert s["windows_resumed"] == 0
+    # changed stage composition: ditto
+    s = transform_streamed(pB, out, window_reads=512, run_dir=rd,
+                           resume=True, realign=False)
+    assert s["windows_resumed"] == 0
+
+
+def test_streamed_resume_tolerates_torn_journal(tmp_path):
+    """A corrupt/torn journal (crashed writer, disk hiccup) costs a
+    clean restart, not a crash and never trust."""
+    from make_synth_sam import make_sam
+
+    path = str(tmp_path / "in.sam")
+    make_sam(path, 1024, 100)
+    out, rd = str(tmp_path / "out.adam"), str(tmp_path / "run")
+    transform_streamed(path, out, window_reads=256, run_dir=rd)
+    baseline = _parts_hash(out)
+    with open(os.path.join(rd, "JOURNAL.json"), "w") as fh:
+        fh.write('{"schema": "adam_tpu.run_journal/1", "windows": TORN')
+    s = transform_streamed(path, out, window_reads=256, run_dir=rd,
+                           resume=True)
+    assert s["windows_resumed"] == 0 and s["windows_fresh"] > 0
+    assert _parts_hash(out) == baseline
+
+
 def test_streamed_tuning_flags_and_dump_observations(tmp_path):
     """The realign tuning knobs thread through (a prohibitive LOD
     threshold suppresses all realignment) and -dump_observations writes

@@ -585,6 +585,7 @@ def test_heartbeat_ndjson_schema_is_stable(tmp_path):
     other = tele.Tracer(recording=True)
     tr.count(tele.C_WINDOWS_INGESTED, 3)
     tr.count(tele.C_READS_INGESTED, 3000)
+    tr.count(tele.C_RESUME_WINDOWS_SKIPPED, 2)
     other.count(tele.C_PARTS_WRITTEN, 2)
     other.count(tele.C_BYTES_WRITTEN, 12345)
     p = str(tmp_path / "hb.ndjson")
@@ -609,6 +610,9 @@ def test_heartbeat_ndjson_schema_is_stable(tmp_path):
     assert last["parts_written"] == 2
     assert last["bytes_written"] == 12345
     assert last["windows_total"] == 4
+    # resumed-vs-fresh visibility: the resume.windows_skipped counter
+    # surfaces as the windows_resumed field (0 on fresh runs)
+    assert last["windows_resumed"] == 2
     assert last["inflight_per_device"] == {"0": 2, "1": 1}
     assert last["eta_s"] is not None  # 2 of 4 parts -> extrapolable
     assert [l["seq"] for l in lines] == list(range(len(lines)))
