@@ -349,6 +349,52 @@ class Analyze(Command):
         return 0
 
 
+class Top(Command):
+    """Live dashboard over a streamed run's ``--progress`` heartbeat
+    file (utils/top.py): the interactive half of the observability
+    layer — tails the NDJSON stream, renders a refreshing one-screen
+    view (progress bar, reads/s, tunnel bytes, HBM, per-device
+    in-flight depth, retry/evict counters, ETA), and exits cleanly on
+    the final ``done=true`` line.  Read-only: attach/detach freely
+    while the run is live."""
+
+    name = "top"
+    description = ("Live terminal dashboard tailing a streamed run's "
+                   "--progress heartbeat file (exits on done=true)")
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument(
+            "heartbeat", metavar="HEARTBEAT.ndjson",
+            help="the NDJSON file a streamed transform is writing via "
+            "--progress PATH (or ADAM_TPU_PROGRESS=PATH); may not "
+            "exist yet — top waits for the first line",
+        )
+        p.add_argument(
+            "-interval", type=float, default=0.5,
+            help="refresh period in seconds (default 0.5)",
+        )
+        p.add_argument(
+            "-once", action="store_true",
+            help="render a single frame from the newest line and exit "
+            "(scripting/CI mode; exit 2 when the file has no lines)",
+        )
+        p.add_argument(
+            "-max_wait", type=float, default=None, metavar="S",
+            help="give up (exit 2) when no done=true arrives within S "
+            "seconds (default: follow forever)",
+        )
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.utils import top as top_mod
+
+        return top_mod.follow(
+            args.heartbeat, interval=max(0.05, args.interval),
+            once=args.once, max_wait_s=args.max_wait,
+        )
+
+
 COMMANDS = [
     PrintAdam,
     PrintGenes,
@@ -359,4 +405,5 @@ COMMANDS = [
     BuildInformation,
     View,
     Analyze,
+    Top,
 ]

@@ -44,6 +44,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Sequence
 
@@ -179,14 +180,39 @@ def putter(device=None):
     ``jnp.asarray`` (default device, uncommitted — the single-chip
     behavior) when ``device`` is None, else a committed
     ``jax.device_put`` onto the given chip so the following jit call
-    dispatches there."""
+    dispatches there.
+
+    Every placement is also the h2d half of the transfer ledger: bytes
+    counted from the host array, wall from the put call (submit-side —
+    device_put may complete the copy asynchronously, so the throughput
+    histogram is a lower bound on transfer time, not an upper; the
+    byte totals are exact either way), attributed to the target device
+    and the active :func:`~adam_tpu.utils.telemetry.pass_scope`."""
     if device is None:
         import jax.numpy as jnp
 
-        return jnp.asarray
-    import jax
+        base = jnp.asarray
+        dev_id = None
+    else:
+        import jax
 
-    return lambda x: jax.device_put(x, device)
+        def base(x, _dev=device):
+            return jax.device_put(x, _dev)
+
+        dev_id = _attr_id(device)
+
+    def put(x):
+        if not tele.TRACE.recording:
+            return base(x)
+        t0 = time.monotonic()
+        out = base(x)
+        tele.TRACE.record_transfer(
+            "h2d", getattr(x, "nbytes", 0), time.monotonic() - t0,
+            device=dev_id,
+        )
+        return out
+
+    return put
 
 
 class DevicePool:
@@ -290,12 +316,11 @@ class DevicePool:
         return _attr_id(self.device(window))
 
     def put(self, tree, window: int):
-        """Commit a pytree of host arrays onto window's device."""
+        """Commit a pytree of host arrays onto window's device
+        (through :func:`putter`, so the h2d ledger sees every leaf)."""
         import jax
 
-        return jax.tree.map(
-            lambda x: jax.device_put(x, self.device(window)), tree
-        )
+        return jax.tree.map(putter(self.device(window)), tree)
 
     # ---- compile prewarm ----------------------------------------------
     def prewarm(self, entries: Sequence[tuple], tracer=None) -> int:
@@ -341,14 +366,23 @@ class DevicePool:
                 faults.point("pool.prewarm", device=dev)
                 fn(dev)
 
+            from adam_tpu.utils import compile_ledger
+
             try:
                 with tr.span(
                     tele.SPAN_POOL_PREWARM_COMPILE,
                     device=_attr_id(dev), kernel=str(key[0]),
-                ):
+                ), compile_ledger.prewarm_scope(), \
+                        tele.pass_scope("prewarm"), \
+                        compile_ledger.track(key, dev):
                     # transient compile/RPC failures retry in place
                     # (exponential backoff) before degrading to the
-                    # warn-and-compile-in-window fallback below
+                    # warn-and-compile-in-window fallback below.  The
+                    # compile-ledger claim inside the prewarm scope is
+                    # what lets the first REAL dispatch of this triple
+                    # record a cache hit — and an in-window miss at a
+                    # dispatch site is, by elimination, a shape the
+                    # prewarm never covered (the coverage boundary).
                     retry_mod.retry_call(
                         compile_once, site="device.pool.prewarm"
                     )

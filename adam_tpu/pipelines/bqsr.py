@@ -464,9 +464,16 @@ def _observe_impl(
                     n_rg, gl,
                 )
 
-            total, mism = _retry.retry_call(
-                dispatch, site="bqsr.observe.dispatch"
-            )
+            from adam_tpu.utils import compile_ledger
+
+            # ledger key == the prewarm entry key ("bqsr.observe"):
+            # an in-window miss here is a prewarm coverage gap
+            with compile_ledger.track(
+                ("bqsr.observe", g, gl, n_rg), device
+            ):
+                total, mism = _retry.retry_call(
+                    dispatch, site="bqsr.observe.dispatch"
+                )
     rg_names = ds.read_groups.names + ["null"]
     # visit accounting (BaseQualityRecalibration.scala:99-123's logging)
     # — host-resident histograms only: summing a device-backend result
@@ -860,7 +867,20 @@ def _apply_dispatch_impl(
                 glc,
             )[:n, :L]  # device-side slice: fetch only real rows/lanes
 
-        new_dev = _retry.retry_call(dispatch, site="bqsr.apply.dispatch")
+        from adam_tpu.utils import compile_ledger
+
+        n_rg = phred_table.shape[0]
+        n_cyc = phred_table.shape[2]
+        # ledger key == the prewarm/apply_prewarm_entry key: the pass-C
+        # re-warm compiles against the SOLVED table's width, and an
+        # in-window miss here is exactly the "wider merged table"
+        # coverage gap PERF.md describes
+        with compile_ledger.track(
+            ("bqsr.apply", g, glc, n_rg, n_cyc), device
+        ):
+            new_dev = _retry.retry_call(
+                dispatch, site="bqsr.apply.dispatch"
+            )
         return ds, b, new_dev
     from adam_tpu import native
 

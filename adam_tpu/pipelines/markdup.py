@@ -129,7 +129,15 @@ def markdup_columns_dispatch(batch, device=None):
                 _put(pad_rows_np(b.lengths, g, 0)),
             )
 
-        five, score = _retry.retry_call(dispatch, site="markdup.dispatch")
+        from adam_tpu.utils import compile_ledger
+
+        # compile-ledger key == the prewarm entry key for this kernel:
+        # a miss here is a shape the prewarm never covered, cold-
+        # compiling inside pass A's ingest loop
+        with compile_ledger.track(("markdup.columns", g, gc, gl), device):
+            five, score = _retry.retry_call(
+                dispatch, site="markdup.dispatch"
+            )
         return five[:n], score[:n]
 
 

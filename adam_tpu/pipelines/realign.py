@@ -976,10 +976,15 @@ def _realign_indels_py(
             rl[k] = len(r.codes)
             cidx[k] = cs
         # padded task rows gather consensus slot 0 and are never read back
-        _pending.append((tasks, sweep_kernel_gather(
-            jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
-            jnp.asarray(ct), jnp.asarray(cl), jnp.asarray(cidx), lr, lc,
-        )))
+        from adam_tpu.parallel.device_pool import putter as _putter
+        from adam_tpu.utils import compile_ledger
+
+        _put = _putter()  # default device + h2d transfer accounting
+        with compile_ledger.track(("realign.sweep", ch, lr, nc, lc)):
+            _pending.append((tasks, sweep_kernel_gather(
+                _put(rc), _put(rq), _put(rl),
+                _put(ct), _put(cl), _put(cidx), lr, lc,
+            )))
 
     def _enqueue_sweep(task) -> None:
         t, ri, ci, r, cons_codes = task
@@ -1224,8 +1229,13 @@ def _realign_indels_py(
                 ), new_end
         _write_back(new_batch, side, new_md, new_attrs, to_clean, realigned)
 
+    from adam_tpu.utils.transfer import device_fetch as _dfetch
+
     for chunk, out in _pending:
-        best_q, best_o = jax.tree.map(np.asarray, out)
+        # drain through the transfer helper so the d2h ledger sees the
+        # sweep results (tiny [CH] i32 pairs, but the tunnel rounds
+        # them up — per-pass byte attribution must not have dark spots)
+        best_q, best_o = _dfetch(out[0]), _dfetch(out[1])
         for k, (t, ri, ci, _, _) in enumerate(chunk):
             res_q[t][ri, ci] = best_q[k]
             res_o[t][ri, ci] = best_o[k]

@@ -347,6 +347,10 @@ def _transform_streamed_impl(
     )
     if use_device:
         tr.gauge(tele.G_POOL_DEVICES, stats["n_devices"])
+    if hb is not None and dpool is not None:
+        # HBM sampling keys must match the device=<k> span attribution,
+        # so the heartbeat polls exactly the pool's device set
+        hb.set_devices(dpool.devices)
     os.makedirs(out_path, exist_ok=True)
     # purge a crashed run's staging dir: io/parquet publishes each part
     # by atomic rename out of out_path/_temporary, so a SIGKILL'd run
@@ -513,7 +517,10 @@ def _transform_streamed_impl(
             return
         summaries.append(md_mod.row_summary(ds))
 
-    with tr.span(tele.SPAN_PASS_A):
+    # transfer-ledger pass attribution: every h2d put / d2h fetch on
+    # this thread inside the scope lands under the pass's bucket in the
+    # snapshot's ``transfers`` section (prewarm shadows with its own)
+    with tr.span(tele.SPAN_PASS_A), tele.pass_scope("a"):
         try:
             while True:
                 item = in_q.get()
@@ -725,9 +732,10 @@ def _transform_streamed_impl(
             tr.count(tele.C_DEVICE_DISPATCHED)
             return (total, mism, g), _obs_replay(i, w, dev)
 
-        return _on_survivors(
-            i, on_device, lambda: (_observe_host(w), None)
-        )
+        with tele.pass_scope("observe"):
+            return _on_survivors(
+                i, on_device, lambda: (_observe_host(w), None)
+            )
 
     def _observe_remainders():
         # non-candidate rows are untouched by realignment, so their
@@ -782,16 +790,19 @@ def _transform_streamed_impl(
     if candidates and not skip_realign:
         cand = AlignmentDataset.concat(candidates)
         tr.count(tele.C_CANDIDATE_ROWS, int(cand.batch.n_rows))
-        realigned = realign_mod.realign_indels(
-            cand,
-            consensus_model=consensus_model,
-            known_indels=known_indels,
-            max_indel_size=mis,
-            max_consensus_number=mcn,
-            lod_threshold=lod,
-            max_target_size=mts,
-            overlap_work=_observe_remainders,
-        )
+        with tele.pass_scope("sweep"):
+            # the sweep scope covers the realign GEMM dispatch+drain;
+            # the overlapped observe pass shadows it with its own scope
+            realigned = realign_mod.realign_indels(
+                cand,
+                consensus_model=consensus_model,
+                known_indels=known_indels,
+                max_indel_size=mis,
+                max_consensus_number=mcn,
+                lod_threshold=lod,
+                max_target_size=mts,
+                overlap_work=_observe_remainders,
+            )
         if recalibrate and realigned.batch.n_rows and resume_table is None:
             part, replay = _observe_window(len(windows), realigned)
             obs_parts.append(part)
@@ -865,7 +876,7 @@ def _transform_streamed_impl(
         n_dev_parts = sum(
             1 for t, _m, _g in obs_parts if not isinstance(t, np.ndarray)
         )
-        with tr.span(tele.SPAN_OBS_MERGE):
+        with tr.span(tele.SPAN_OBS_MERGE), tele.pass_scope("observe"):
             total, mism, gl = bqsr_mod.merge_observations(
                 obs_parts, replays=obs_replays, tracer=tr,
                 window_ids=obs_windows, on_part=_persist_obs,
@@ -957,21 +968,21 @@ def _transform_streamed_impl(
         # walls inside it are their own DISJOINT child spans, so the
         # derived apply_split_s (pass C minus dispatch minus fetch) sums
         # with them to the pass wall instead of double-counting it
-        with tr.span(tele.SPAN_PASS_C):
+        with tr.span(tele.SPAN_PASS_C), tele.pass_scope("apply"):
             if table is not None and use_device and not res["device_lost"]:
                 # replicate the solved u8 table once per pool device
                 # (~4 MB each) instead of re-shipping it per window
                 dev_tables = None
                 if dpool is not None:
-                    import jax
-
                     tbl_c = np.ascontiguousarray(table, np.uint8)
                     # replicas keyed by ORIGINAL pool index (stable
                     # under eviction); dead devices get no replica —
-                    # _pick_device never hands them out
+                    # _pick_device never hands them out.  Placed via
+                    # putter so the per-device table replication shows
+                    # up in the h2d transfer ledger.
                     alive_now = dpool.alive_devices()
                     dev_tables = [
-                        jax.device_put(tbl_c, d) if d in alive_now
+                        dp_mod.putter(d)(tbl_c) if d in alive_now
                         else None
                         for d in dpool.devices
                     ]

@@ -332,6 +332,92 @@ def _critical_path(spans: list, top_n: int = 5) -> dict:
 
 
 # --------------------------------------------------------------------------
+# Device ledger sections (transfers / compile cache / HBM)
+# --------------------------------------------------------------------------
+def _transfer_report(doc: dict, counters: dict) -> dict:
+    """Per-device tunnel accounting from the snapshot/trace ``transfers``
+    section: byte totals and mean throughput per direction, the
+    per-pass byte split, and bytes-per-read (the tunnel cost of one
+    read crossing the pipeline) — the ROADMAP's "chunked device_fetch
+    throughput" and "barrier-2 observe-fetch share" measurements read
+    straight off this."""
+    xfer = doc.get("transfers") or {}
+    devices: dict = {}
+    totals = {"h2d": 0, "d2h": 0}
+    for direction in ("h2d", "d2h"):
+        for dev, per in (xfer.get(direction) or {}).items():
+            d = devices.setdefault(str(dev), {})
+            nbytes = sum(v["bytes"] for v in per.values())
+            secs = sum(v["seconds"] for v in per.values())
+            d[direction] = {
+                "bytes": nbytes,
+                "count": sum(v["count"] for v in per.values()),
+                "seconds": round(secs, 6),
+                "bytes_per_s": (
+                    round(nbytes / secs) if secs > 1e-9 else None
+                ),
+                "by_pass": {
+                    p: v["bytes"]
+                    for p, v in sorted(per.items())
+                },
+            }
+            totals[direction] += nbytes
+    if not devices:
+        return {}
+    reads = counters.get(tele.C_READS_INGESTED) or 0
+    return {
+        "devices": devices,
+        "h2d_bytes": totals["h2d"],
+        "d2h_bytes": totals["d2h"],
+        "bytes_per_read": (
+            round((totals["h2d"] + totals["d2h"]) / reads, 1)
+            if reads else None
+        ),
+    }
+
+
+def _compile_report(doc: dict, counters: dict) -> dict:
+    """Compile-cache section: hit/miss counts plus the cold-compile
+    entry list, with the ``in_window`` subset split out — every entry
+    there is a shape the prewarm failed to cover, serialized inside a
+    timed window (the analyzer's warning section renders them)."""
+    comp = doc.get("compiles") or {}
+    entries = comp.get("entries") or []
+    in_window = [e for e in entries if e.get("in_window")]
+    hits = counters.get(tele.C_COMPILE_HITS, 0)
+    misses = counters.get(tele.C_COMPILE_MISSES, 0)
+    if not entries and not hits and not misses:
+        return {}
+    return {
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "prewarmed": len(entries) - len(in_window),
+        "in_window": in_window,
+        "entries_dropped": comp.get("dropped", 0),
+    }
+
+
+def _hbm_report(doc: dict, devices: dict) -> dict:
+    """HBM section: per-device last/peak bytes from the heartbeat's
+    ``memory_stats()`` samples, or an explicit ``unsupported`` marker
+    when a device-attributed run produced no samples (backend without
+    memory stats, or no heartbeat ran) — never fabricated zeros."""
+    hbm = doc.get("hbm") or {}
+    if hbm:
+        return {
+            dev: {
+                "bytes_in_use": v.get("last"),
+                "peak_bytes": v.get("peak"),
+                "samples": v.get("n", 0),
+            }
+            for dev, v in sorted(hbm.items())
+        }
+    if devices:
+        return {"unsupported": True}
+    return {}
+
+
+# --------------------------------------------------------------------------
 # Entry points
 # --------------------------------------------------------------------------
 def _hist_rows(hists: dict) -> dict:
@@ -393,11 +479,20 @@ def analyze(doc: dict) -> dict:
         "devices": devices,
         "stages": _stage_decomposition(totals, wall),
         "histograms": _hist_rows(hists),
+        # the device ledger (both artifact kinds embed the sections):
+        # tunnel byte accounting, compile-cache hit/miss + in-window
+        # cold-compile warnings, HBM footprint
+        "transfers": _transfer_report(doc, counters),
+        "compiles": _compile_report(doc, counters),
+        "hbm": _hbm_report(doc, devices),
         "counters": {
             k: counters[k]
             for k in (
                 tele.C_READS_INGESTED, tele.C_WINDOWS_INGESTED,
                 tele.C_PARTS_WRITTEN, tele.C_BYTES_WRITTEN,
+                tele.C_H2D_BYTES, tele.C_D2H_BYTES,
+                tele.C_COMPILE_HITS, tele.C_COMPILE_MISSES,
+                tele.C_COMPILE_IN_WINDOW,
                 tele.C_RETRY_ATTEMPTS, tele.C_FAULT_INJECTED,
                 tele.C_DEVICE_EVICTED,
                 # resumed-vs-fresh window accounting (a resumed run's
@@ -416,16 +511,25 @@ def analyze(doc: dict) -> dict:
 def utilization_from_snapshot(snap: dict) -> dict:
     """Just the per-device utilization section from a snapshot — what
     ``bench.py`` embeds next to each artifact's telemetry key (the CPU
-    baseline's empty ``device_spans`` yields ``{}``, key-stable)."""
+    baseline's empty ``device_spans``/``transfers`` yield ``{}``,
+    key-stable).  ``transfers``/``compiles`` make the bench artifact
+    carry tunnel utilization and prewarm-coverage evidence round over
+    round, not just chip occupancy."""
     wall = (snap.get("spans") or {}).get(tele.SPAN_TOTAL, {}).get("total_s")
+    counters = snap.get("counters") or {}
     return {
         "wall_s": round(wall, 6) if wall is not None else None,
         "devices": _devices_from_snapshot(snap, wall),
+        "transfers": _transfer_report(snap, counters),
+        "compiles": _compile_report(snap, counters),
     }
 
 
 def _fmt_s(v) -> str:
     return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+
+_fmt_bytes = tele.format_bytes
 
 
 def render_report(report: dict) -> str:
@@ -463,6 +567,74 @@ def render_report(report: dict) -> str:
     else:
         out += ["", "Per-device attribution: (no device-attributed spans "
                 "— single-device or host-backend run)"]
+    xfer = report.get("transfers") or {}
+    if xfer:
+        out += ["", "Tunnel transfers (host<->device)"]
+        hdr = (
+            f"{'device':>10}  {'dir':>4}  {'bytes':>10}  {'calls':>6}"
+            f"  {'wall_s':>8}  {'mean B/s':>10}  per-pass bytes"
+        )
+        out += [hdr, "-" * len(hdr)]
+        for dev, dirs in sorted(xfer["devices"].items()):
+            for direction in ("h2d", "d2h"):
+                d = dirs.get(direction)
+                if d is None:
+                    continue
+                by_pass = ", ".join(
+                    f"{p}={_fmt_bytes(b)}"
+                    for p, b in d["by_pass"].items()
+                )
+                out.append(
+                    f"{dev:>10}  {direction:>4}  {_fmt_bytes(d['bytes']):>10}"
+                    f"  {d['count']:>6}  {_fmt_s(d['seconds']):>8}"
+                    f"  {_fmt_bytes(d['bytes_per_s']):>10}  {by_pass}"
+                )
+        bpr = xfer.get("bytes_per_read")
+        out.append(
+            f"  totals: h2d {_fmt_bytes(xfer['h2d_bytes'])}, d2h "
+            f"{_fmt_bytes(xfer['d2h_bytes'])}"
+            + (f", {_fmt_bytes(bpr)}/read" if bpr is not None else "")
+        )
+    comp = report.get("compiles") or {}
+    if comp:
+        out += ["", "Compile cache"]
+        out.append(
+            f"  hits {comp['cache_hits']}, misses {comp['cache_misses']}"
+            f" ({comp['prewarmed']} under prewarm,"
+            f" {len(comp['in_window'])} inside timed windows)"
+        )
+        if comp.get("entries_dropped"):
+            out.append(
+                f"  ({comp['entries_dropped']} ledger entries dropped past "
+                "the retention bound)"
+            )
+        if comp["in_window"]:
+            out.append(
+                "  WARNING: shapes cold-compiled INSIDE a timed window "
+                "(prewarm coverage gaps — their compile wall serialized "
+                "into the pipeline):"
+            )
+            for e in comp["in_window"]:
+                shape = "x".join(str(s) for s in (e.get("shape") or []))
+                out.append(
+                    f"    {e['kernel']}[{shape}] on device {e['device']}"
+                    f": {_fmt_s(e['seconds'])} s"
+                )
+    hbm = report.get("hbm") or {}
+    if hbm:
+        out += ["", "HBM footprint"]
+        if hbm.get("unsupported"):
+            out.append(
+                "  (unsupported backend: device.memory_stats() returned "
+                "nothing — no HBM samples)"
+            )
+        else:
+            for dev, d in hbm.items():
+                out.append(
+                    f"  device {dev}: in use {_fmt_bytes(d['bytes_in_use'])}"
+                    f", peak {_fmt_bytes(d['peak_bytes'])}"
+                    f" ({d['samples']} samples)"
+                )
     stages = report.get("stages") or {}
     if stages:
         out += ["", "Stage / barrier decomposition"]

@@ -185,6 +185,36 @@ G_DEVICE_INFLIGHT = _metric("device.dispatch.in_flight")
 G_OBSERVE_HIDDEN = _metric("streamed.observe_overlap_hidden")
 G_POOL_DEVICES = _metric("device.pool.devices")
 
+# ---- device ledger: tunnel byte accounting (utils/transfer.py +
+# parallel/device_pool.py).  Counters carry the run totals; the
+# per-direction throughput histograms (bytes/second, the shared fixed
+# log-spaced buckets) answer whether the link itself — not the host —
+# is the wall; the snapshot's ``transfers`` section attributes
+# count/bytes/seconds per device AND per pipeline pass (a/observe/
+# apply/sweep/prewarm via :func:`pass_scope`). ----
+C_H2D_BYTES = _metric("device.h2d.bytes")
+C_D2H_BYTES = _metric("device.d2h.bytes")
+H_H2D_BPS = _metric("device.h2d.bps")
+H_D2H_BPS = _metric("device.d2h.bps")
+
+# ---- compile ledger (utils/compile_ledger.py wraps every streamed jit
+# dispatch site): per-dispatch executable-cache hit/miss accounting
+# keyed by (kernel, grid shape, device).  A miss's duration is the
+# dispatch WALL of the call that compiled (trace+compile dominate it);
+# misses recorded outside a prewarm scope are cold compiles that landed
+# INSIDE a timed window — the direct measurement of the PERF.md
+# "prewarm coverage boundary".  Entries land in the snapshot's
+# ``compiles`` section; the analyzer flags the in-window subset. ----
+C_COMPILE_HITS = _metric("device.compile.cache_hits")
+C_COMPILE_MISSES = _metric("device.compile.cache_misses")
+C_COMPILE_IN_WINDOW = _metric("device.compile.in_window")
+H_COMPILE_SECONDS = _metric("device.compile.seconds")
+
+# ---- HBM footprint (device.memory_stats(), sampled per heartbeat
+# tick; per-device last/peak live in the snapshot's ``hbm`` section —
+# this gauge is the cross-device total for the printed table) ----
+G_HBM_IN_USE = _metric("device.hbm.bytes_in_use")
+
 # ---- histograms (explicit observe() sites; every span name also gets
 # an automatic duration histogram under its own name, in seconds) ----
 H_FETCH_SECONDS = _metric("device.fetch.seconds")
@@ -192,11 +222,15 @@ H_POOL_SUBMIT_WAIT = _metric("parquet.pool.submit_wait")
 
 #: Device-only metrics: the paired-CPU bench baseline zeroes these
 #: instead of omitting them so round-over-round diffs are key-stable.
-DEVICE_ONLY_COUNTERS = frozenset(
-    {C_DEVICE_DISPATCHED, C_DEVICE_FETCHED, C_POOL_PREWARM_COMPILES}
-)
+DEVICE_ONLY_COUNTERS = frozenset({
+    C_DEVICE_DISPATCHED, C_DEVICE_FETCHED, C_POOL_PREWARM_COMPILES,
+    C_H2D_BYTES, C_D2H_BYTES,
+    C_COMPILE_HITS, C_COMPILE_MISSES, C_COMPILE_IN_WINDOW,
+})
 DEVICE_ONLY_GAUGES = frozenset({G_DEVICE_INFLIGHT, G_POOL_DEVICES})
-DEVICE_ONLY_HISTOGRAMS = frozenset({H_FETCH_SECONDS})
+DEVICE_ONLY_HISTOGRAMS = frozenset(
+    {H_FETCH_SECONDS, H_H2D_BPS, H_D2H_BPS, H_COMPILE_SECONDS}
+)
 
 
 def registered_spans() -> frozenset:
@@ -227,6 +261,17 @@ HIST_BUCKETS_PER_DECADE = 4
 #: Values at or below this clamp into the lowest bucket (durations are
 #: nonnegative; sub-picosecond observations carry no signal).
 _HIST_MIN_VALUE = 1e-12
+
+
+def format_bytes(v) -> str:
+    """Human-readable byte count (shared by the analyzer report and
+    the ``adam-tpu top`` dashboard); ``"-"`` for non-numbers."""
+    if not isinstance(v, (int, float)):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024.0
 
 
 def hist_bucket_index(value: float) -> int:
@@ -319,6 +364,53 @@ def merge_histograms(a: dict, b: dict) -> dict:
 
 
 # --------------------------------------------------------------------------
+# Transfer pass attribution
+# --------------------------------------------------------------------------
+# Thread-local pipeline-pass scope: the streamed pipeline enters
+# pass_scope("a"/"observe"/"apply"/"sweep") around each pass's dispatch/
+# fetch sites, so the transfer ledger can attribute tunnel bytes per
+# pass without threading a label through the bqsr/markdup/transfer
+# APIs (the same shape as device_pool's replay_scope).
+_PASS_TLS = threading.local()
+
+#: The bucket transfers land in when no pass scope is active (library
+#: calls, the monolithic pipeline, tests).
+PASS_OTHER = "other"
+
+
+class pass_scope:
+    """Marks the current thread as inside one streamed pipeline pass
+    for transfer attribution (reentrant; inner scopes shadow outer)."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __enter__(self):
+        stack = getattr(_PASS_TLS, "stack", None)
+        if stack is None:
+            stack = _PASS_TLS.stack = []
+        stack.append(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        _PASS_TLS.stack.pop()
+        return False
+
+
+def current_pass() -> str | None:
+    """The innermost active :class:`pass_scope` name, or None."""
+    stack = getattr(_PASS_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+#: Ring bound on retained compile-ledger entries: every entry is one
+#: real XLA compile (seconds each), so a run can't plausibly exceed
+#: this — it exists so a pathological shape explosion degrades to
+#: truncation (counted) instead of unbounded growth.
+_MAX_COMPILE_ENTRIES = 512
+
+
+# --------------------------------------------------------------------------
 # Span context managers
 # --------------------------------------------------------------------------
 class _NullSpan:
@@ -394,6 +486,12 @@ class Tracer:
         self._counters: dict = {}  # name -> int
         self._gauges: dict = {}    # name -> {last, min, max, n}
         self._hists: dict = {}     # name -> _new_hist() dict
+        # device ledger: host<->device transfer accounting per
+        # direction/device/pass, compile-cache entries, HBM samples
+        self._xfer: dict = {}      # dir -> dev -> pass -> [n, bytes, s]
+        self._compiles: list = []  # {kernel, shape, device, seconds, ...}
+        self._compiles_dropped = 0
+        self._hbm: dict = {}       # dev -> {last, peak, n}
         self._tls = threading.local()
         self._n_recorded = 0
 
@@ -484,6 +582,96 @@ class Tracer:
                 h = self._hists[name] = _new_hist()
             _hist_observe(h, value)
 
+    def record_transfer(self, direction: str, nbytes: int, seconds: float,
+                        device=None, pass_name: str | None = None) -> None:
+        """Account one host<->device transfer (``direction`` is ``h2d``
+        or ``d2h``): the run-total byte counter, the per-direction
+        throughput histogram (bytes/second — only when the transfer
+        took measurable wall, so instant memcpys don't pollute the link
+        quantiles), and the per-(device, pass) attribution the
+        snapshot's ``transfers`` section reports.  ``pass_name``
+        defaults to the thread's active :class:`pass_scope`."""
+        if not self.recording:
+            return
+        nbytes = int(nbytes)
+        counter = C_H2D_BYTES if direction == "h2d" else C_D2H_BYTES
+        hname = H_H2D_BPS if direction == "h2d" else H_D2H_BPS
+        if pass_name is None:
+            pass_name = current_pass() or PASS_OTHER
+        dev = "default" if device is None else str(device)
+        with self._lock:
+            self._counters[counter] = self._counters.get(counter, 0) + nbytes
+            if seconds > 1e-9 and nbytes:
+                h = self._hists.get(hname)
+                if h is None:
+                    h = self._hists[hname] = _new_hist()
+                _hist_observe(h, nbytes / seconds)
+            per = self._xfer.setdefault(direction, {}).setdefault(dev, {})
+            agg = per.get(pass_name)
+            if agg is None:
+                per[pass_name] = [1, nbytes, float(seconds)]
+            else:
+                agg[0] += 1
+                agg[1] += nbytes
+                agg[2] += float(seconds)
+
+    def record_compile(self, kernel: str, shape, device, seconds: float,
+                       in_window: bool) -> None:
+        """Record one executable-cache MISS (a real trace+compile) in
+        the compile ledger: the miss counter, the compile-duration
+        histogram, and a (kernel, shape, device) entry — flagged
+        ``in_window`` when it happened at a live dispatch site rather
+        than under a prewarm scope (the cold compile then landed inside
+        a timed window, the exact event the prewarm exists to prevent)."""
+        if not self.recording:
+            return
+        entry = {
+            "kernel": str(kernel),
+            "shape": list(shape) if shape is not None else None,
+            "device": "default" if device is None else str(device),
+            "seconds": round(float(seconds), 6),
+            "in_window": bool(in_window),
+        }
+        with self._lock:
+            self._counters[C_COMPILE_MISSES] = (
+                self._counters.get(C_COMPILE_MISSES, 0) + 1
+            )
+            if in_window:
+                self._counters[C_COMPILE_IN_WINDOW] = (
+                    self._counters.get(C_COMPILE_IN_WINDOW, 0) + 1
+                )
+            h = self._hists.get(H_COMPILE_SECONDS)
+            if h is None:
+                h = self._hists[H_COMPILE_SECONDS] = _new_hist()
+            _hist_observe(h, seconds)
+            if len(self._compiles) < _MAX_COMPILE_ENTRIES:
+                self._compiles.append(entry)
+            else:
+                self._compiles_dropped += 1
+
+    def record_hbm(self, device_key: str, bytes_in_use: int,
+                   peak_bytes=None) -> None:
+        """One HBM footprint sample for one device (the heartbeat tick
+        feeds this from ``device.memory_stats()``).  ``peak`` keeps the
+        max ever seen — the backend-reported peak when available, else
+        the max sampled ``bytes_in_use``."""
+        if not self.recording:
+            return
+        bytes_in_use = int(bytes_in_use)
+        hi = int(peak_bytes) if peak_bytes is not None else bytes_in_use
+        hi = max(hi, bytes_in_use)
+        with self._lock:
+            g = self._hbm.get(str(device_key))
+            if g is None:
+                self._hbm[str(device_key)] = {
+                    "last": bytes_in_use, "peak": hi, "n": 1,
+                }
+            else:
+                g["last"] = bytes_in_use
+                if hi > g["peak"]:
+                    g["peak"] = hi
+                g["n"] += 1
+
     def gauge(self, name: str, value) -> None:
         if not self.recording:
             return
@@ -545,6 +733,25 @@ class Tracer:
                 "histograms": {
                     k: hist_summary(v) for k, v in self._hists.items()
                 },
+                "transfers": {
+                    direction: {
+                        dev: {
+                            p: {
+                                "count": v[0],
+                                "bytes": v[1],
+                                "seconds": round(v[2], 6),
+                            }
+                            for p, v in per.items()
+                        }
+                        for dev, per in by_dev.items()
+                    }
+                    for direction, by_dev in self._xfer.items()
+                },
+                "compiles": {
+                    "entries": [dict(e) for e in self._compiles],
+                    "dropped": self._compiles_dropped,
+                },
+                "hbm": {k: dict(v) for k, v in self._hbm.items()},
                 "events_recorded": self._n_recorded,
                 "events_retained": len(self._events),
                 "events_evicted": self._n_recorded - len(self._events),
@@ -559,15 +766,24 @@ class Tracer:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._xfer.clear()
+            self._compiles.clear()
+            self._compiles_dropped = 0
+            self._hbm.clear()
             self._n_recorded = 0
 
     def reset_metrics(self) -> None:
-        """Clear counters + gauges + histograms only (TimerRegistry.reset
-        delegates here so one reset clears the whole metrics surface)."""
+        """Clear counters + gauges + histograms (and the device-ledger
+        sections derived with them) only (TimerRegistry.reset delegates
+        here so one reset clears the whole metrics surface)."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._xfer.clear()
+            self._compiles.clear()
+            self._compiles_dropped = 0
+            self._hbm.clear()
 
     def absorb(self, other: "Tracer") -> None:
         """Merge another tracer's events + aggregates into this one
@@ -585,6 +801,14 @@ class Tracer:
                 k: {**v, "buckets": dict(v["buckets"])}
                 for k, v in other._hists.items()
             }
+            xfer = {
+                d: {dev: {p: list(v) for p, v in per.items()}
+                    for dev, per in by_dev.items()}
+                for d, by_dev in other._xfer.items()
+            }
+            compiles = [dict(e) for e in other._compiles]
+            compiles_dropped = other._compiles_dropped
+            hbm = {k: dict(v) for k, v in other._hbm.items()}
             n_rec = other._n_recorded
         with self._lock:
             self._events.extend(events)
@@ -633,6 +857,31 @@ class Tracer:
                     mine["last"] = g["last"]
                     mine["min"] = min(mine["min"], g["min"])
                     mine["max"] = max(mine["max"], g["max"])
+                    mine["n"] += g["n"]
+            for d, by_dev in xfer.items():
+                mdir = self._xfer.setdefault(d, {})
+                for dev, per in by_dev.items():
+                    mdev = mdir.setdefault(dev, {})
+                    for p, (c, nb, s) in per.items():
+                        agg = mdev.get(p)
+                        if agg is None:
+                            mdev[p] = [c, nb, s]
+                        else:
+                            agg[0] += c
+                            agg[1] += nb
+                            agg[2] += s
+            room = _MAX_COMPILE_ENTRIES - len(self._compiles)
+            self._compiles.extend(compiles[:room])
+            self._compiles_dropped += (
+                compiles_dropped + max(0, len(compiles) - room)
+            )
+            for k, g in hbm.items():
+                mine = self._hbm.get(k)
+                if mine is None:
+                    self._hbm[k] = dict(g)
+                else:
+                    mine["last"] = g["last"]
+                    mine["peak"] = max(mine["peak"], g["peak"])
                     mine["n"] += g["n"]
 
     # ---- exports ----------------------------------------------------------
@@ -721,12 +970,39 @@ class Tracer:
         # were evicted, or truncation reads as fabricated idle time.
         with self._lock:
             hists = {k: hist_summary(v) for k, v in self._hists.items()}
+            xfer = {
+                d: {
+                    dev: {
+                        p: {"count": v[0], "bytes": v[1],
+                            "seconds": round(v[2], 6)}
+                        for p, v in per.items()
+                    }
+                    for dev, per in by_dev.items()
+                }
+                for d, by_dev in self._xfer.items()
+            }
+            compiles = {
+                "entries": [dict(e) for e in self._compiles],
+                "dropped": self._compiles_dropped,
+            }
+            hbm = {k: dict(v) for k, v in self._hbm.items()}
+            counters = dict(self._counters)
             n_rec = self._n_recorded
             n_ret = len(self._events)
         return {
             "traceEvents": out,
             "displayTimeUnit": "ms",
             "histograms": hists,
+            # the device ledger rides along (viewers ignore unknown
+            # top-level keys): transfers/compiles/HBM are aggregates,
+            # not spans, so a trace alone could never reproduce them —
+            # and the analyzer must render the same report sections
+            # from either artifact kind.  Counters too: the tunnel byte
+            # totals and compile hit/miss counts live there.
+            "transfers": xfer,
+            "compiles": compiles,
+            "hbm": hbm,
+            "counters": counters,
             "events_recorded": n_rec,
             "events_evicted": n_rec - n_ret,
         }
@@ -895,6 +1171,12 @@ def key_stable_snapshot(tr: Tracer | None = None) -> dict:
     snap.setdefault("histograms", {})
     for name in sorted(DEVICE_ONLY_HISTOGRAMS):
         snap["histograms"].setdefault(name, hist_summary(_new_hist()))
+    # device-ledger sections: empty-but-present on the CPU leg
+    xfer = snap.setdefault("transfers", {})
+    for direction in ("h2d", "d2h"):
+        xfer.setdefault(direction, {})
+    snap.setdefault("compiles", {"entries": [], "dropped": 0})
+    snap.setdefault("hbm", {})
     return snap
 
 
@@ -927,8 +1209,11 @@ def merge_snapshots(snaps: list) -> dict:
 # --------------------------------------------------------------------------
 # Live progress heartbeat
 # --------------------------------------------------------------------------
-#: NDJSON schema tag every heartbeat line carries.
-HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/1"
+#: NDJSON schema tag every heartbeat line carries.  /2 added the
+#: device-ledger fields (tunnel bytes + HBM) — a /1 consumer keying on
+#: field NAMES keeps working (the /1 fields are a strict subset, same
+#: order); ``adam-tpu top`` accepts both.
+HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/2"
 
 #: THE heartbeat line field set — a stable contract (documented in
 #: docs/OBSERVABILITY.md, lint-enforced by scripts/check-telemetry-names):
@@ -945,6 +1230,10 @@ HEARTBEAT_FIELDS = (
     "reads_ingested",
     "reads_per_s",
     "bytes_written",
+    "h2d_bytes",
+    "d2h_bytes",
+    "hbm_bytes_in_use",
+    "hbm_peak_bytes",
     "inflight",
     "inflight_per_device",
     "retries",
@@ -956,6 +1245,67 @@ HEARTBEAT_FIELDS = (
 )
 
 _DEFAULT_HEARTBEAT_INTERVAL_S = 2.0
+
+#: Default size cap on a file heartbeat sink before rotation (bytes).
+_DEFAULT_PROGRESS_MAX_BYTES = 64 * 1024 * 1024
+
+
+def progress_max_bytes() -> int:
+    """Heartbeat sink rotation cap (``ADAM_TPU_PROGRESS_MAX_BYTES``,
+    default 64 MiB, ``0`` disables): when the NDJSON file passes the
+    cap it rotates to ``<path>.1`` and a fresh file continues — a
+    multi-hour service-style run cannot grow the sink unboundedly.
+    Malformed values degrade to the default (tuning-var contract)."""
+    raw = os.environ.get("ADAM_TPU_PROGRESS_MAX_BYTES", "").strip()
+    if not raw:
+        return _DEFAULT_PROGRESS_MAX_BYTES
+    try:
+        v = int(raw)
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ADAM_TPU_PROGRESS_MAX_BYTES=%r is not an int; using default "
+            "%d", raw, _DEFAULT_PROGRESS_MAX_BYTES,
+        )
+        return _DEFAULT_PROGRESS_MAX_BYTES
+    return max(0, v)
+
+
+def sample_hbm(devices=None) -> dict:
+    """Per-device HBM footprint via ``device.memory_stats()`` —
+    ``{device id: {"bytes_in_use": int, "peak_bytes_in_use": int}}``.
+
+    Graceful everywhere: devices whose backend lacks memory stats (or
+    reports none) are omitted, and a missing/unimportable jax yields
+    ``{}`` — the heartbeat and analyzer render an explicit
+    "unsupported" marker instead of fabricating zeros.  ``devices``
+    defaults to ``jax.local_devices()`` (already initialized by any
+    pipeline that has device work to measure)."""
+    try:
+        if devices is None:
+            import jax
+
+            devices = jax.local_devices()
+    except Exception:
+        return {}
+    out = {}
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms or "bytes_in_use" not in ms:
+            continue
+        key = getattr(d, "id", None)
+        key = str(key) if key is not None else str(d)
+        out[key] = {
+            "bytes_in_use": int(ms["bytes_in_use"]),
+            "peak_bytes_in_use": int(
+                ms.get("peak_bytes_in_use", ms["bytes_in_use"])
+            ),
+        }
+    return out
 
 
 def progress_sink_from_env() -> str | None:
@@ -1024,6 +1374,13 @@ class Heartbeat:
         self._total = None
         self._parts_total = None
         self._provider = None
+        # HBM sampling: the device set to poll memory_stats() on each
+        # beat (None = jax.local_devices() lazily); a backend that
+        # yields no stats flips _hbm_supported off after the first beat
+        # so an unsupported backend costs one probe, not one per tick
+        self._devices = None
+        self._hbm_supported = True
+        self._max_bytes = progress_max_bytes()
         self._stop_ev = threading.Event()
         self._state_lock = threading.Lock()
         self._emit_lock = threading.Lock()
@@ -1053,6 +1410,36 @@ class Heartbeat:
         supplies per-device in-flight depth this way)."""
         self._provider = fn
 
+    def set_devices(self, devices) -> None:
+        """The device set whose HBM footprint each beat samples
+        (default: every local jax device).  The streamed pipeline
+        passes its pool's devices so the per-device keys match the
+        ``device=<k>`` span attribution."""
+        self._devices = list(devices)
+
+    def _sample_hbm(self) -> dict:
+        """One HBM poll (graceful {} when unsupported), recorded into
+        the first tracer's ``hbm`` ledger so the run snapshot carries
+        the per-window peaks a tailing consumer saw live."""
+        if not self._hbm_supported:
+            return {}
+        try:
+            stats = sample_hbm(self._devices)
+        except Exception:
+            stats = {}
+        if not stats:
+            self._hbm_supported = False
+            return {}
+        if self._tracers:
+            tr = self._tracers[0]
+            total = 0
+            for key, s in stats.items():
+                tr.record_hbm(key, s["bytes_in_use"],
+                              s["peak_bytes_in_use"])
+                total += s["bytes_in_use"]
+            tr.gauge(G_HBM_IN_USE, total)
+        return stats
+
     # ---- lifecycle -----------------------------------------------------
     def start(self) -> None:
         with self._state_lock:
@@ -1064,8 +1451,11 @@ class Heartbeat:
             try:
                 # append, as documented: back-to-back runs pointed at
                 # one log keep their history (runs delimit themselves —
-                # seq restarts at 0 and the last line carries done=true)
-                self._fh = open(self._sink, "a")
+                # seq restarts at 0 and the last line carries done=true).
+                # Line-buffered: each line is one write()+implicit flush,
+                # so a tailing consumer (`adam-tpu top`) never reads a
+                # torn last line from the stdio buffer boundary.
+                self._fh = open(self._sink, "a", buffering=1)
                 self._owns_fh = True
             except OSError:
                 import logging
@@ -1106,6 +1496,39 @@ class Heartbeat:
         while not self._stop_ev.wait(self._interval):
             self._emit(done=False)
 
+    def _maybe_rotate(self) -> None:
+        """Size-capped rotation of a file sink (caller holds the emit
+        lock, so no line can be torn across the rotation): past the
+        ``ADAM_TPU_PROGRESS_MAX_BYTES`` cap the current file moves to
+        ``<path>.1`` (replacing any previous rotation) and a fresh file
+        continues — bounded disk for service-style multi-hour runs,
+        and a tailing consumer sees a normal truncate-to-zero.
+
+        Called BEFORE each write, never after: the newest line — in
+        particular the final ``done=true`` line — must always be in
+        the live file, or a tailer (``adam-tpu top``) could watch a
+        fresh empty file forever while the line that ends its loop
+        sits in the rotation."""
+        if (
+            not self._max_bytes or not self._owns_fh
+            or self._fh is None
+        ):
+            return
+        try:
+            if self._fh.tell() < self._max_bytes:
+                return
+            self._fh.close()
+            os.replace(self._sink, self._sink + ".1")
+            self._fh = open(self._sink, "a", buffering=1)
+        except OSError:
+            # rotation is hygiene, not correctness: on failure keep
+            # appending to whatever handle still works
+            try:
+                if self._fh is None or self._fh.closed:
+                    self._fh = open(self._sink, "a", buffering=1)
+            except OSError:
+                self._fh = None
+
     # ---- sampling ------------------------------------------------------
     def sample(self, done: bool = False) -> dict:
         """One heartbeat line as a dict (exactly HEARTBEAT_FIELDS)."""
@@ -1127,6 +1550,7 @@ class Heartbeat:
         eta = None
         if parts_total and parts:
             eta = round(elapsed * max(0, parts_total - parts) / parts, 1)
+        hbm = self._sample_hbm()
         line = {
             "schema": HEARTBEAT_SCHEMA,
             "seq": self._seq,
@@ -1145,6 +1569,19 @@ class Heartbeat:
                 round(reads / elapsed, 1) if elapsed > 0 else 0.0
             ),
             "bytes_written": counters.get(C_BYTES_WRITTEN, 0),
+            # tunnel byte accounting (the transfer ledger's run totals)
+            "h2d_bytes": counters.get(C_H2D_BYTES, 0),
+            "d2h_bytes": counters.get(C_D2H_BYTES, 0),
+            # HBM footprint per device ({} + null on backends without
+            # memory_stats — an explicit "unsupported" marker, never
+            # fabricated zeros)
+            "hbm_bytes_in_use": {
+                k: v["bytes_in_use"] for k, v in hbm.items()
+            },
+            "hbm_peak_bytes": (
+                max(v["peak_bytes_in_use"] for v in hbm.values())
+                if hbm else None
+            ),
             "inflight": gauges.get(G_DEVICE_INFLIGHT, {}).get("last", 0),
             "inflight_per_device": {},
             "retries": counters.get(C_RETRY_ATTEMPTS, 0),
@@ -1177,6 +1614,7 @@ class Heartbeat:
                 return
             if done:
                 self._closed = True
+            self._maybe_rotate()
             line = self.sample(done)
             self._seq += 1
             fh = self._fh if self._fh is not None else sys.stderr

@@ -1,0 +1,220 @@
+"""``adam-tpu top`` — live terminal dashboard over a heartbeat stream.
+
+The streamed pipeline's ``--progress PATH`` heartbeat
+(utils/telemetry.Heartbeat) emits one NDJSON line per sample; this
+module tails that file and renders a refreshing one-screen dashboard —
+the per-job progress view the always-on-service direction needs
+(ROADMAP: "the heartbeat becomes the per-job progress API").  It is a
+pure *consumer*: it holds the file read-only, attaches to a run that is
+already mid-flight, survives the heartbeat's size-capped rotation
+(``ADAM_TPU_PROGRESS_MAX_BYTES`` — a truncate-to-zero reads as a fresh
+file), tolerates a torn last line (only newline-terminated lines are
+parsed; the line-buffered writer makes tears transient), accepts both
+``adam_tpu.heartbeat/1`` and ``/2`` lines, and exits 0 when the stream
+carries ``done=true`` (non-zero when that final line says ``ok=false``).
+
+Split renderer/follower so the dashboard is unit-testable without a
+terminal: :func:`render_frame` is a pure ``dict -> str`` and
+:func:`follow` owns the tail-loop/TTY behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+from adam_tpu.utils.telemetry import format_bytes as _fmt_bytes
+
+#: Heartbeat schema tags this dashboard understands (missing /2 fields
+#: render as "-"; unknown future fields are ignored).
+ACCEPTED_SCHEMAS = ("adam_tpu.heartbeat/1", "adam_tpu.heartbeat/2")
+
+_CLEAR = "\x1b[H\x1b[2J"
+
+
+def parse_heartbeat_text(text: str) -> list:
+    """NDJSON text -> parsed heartbeat lines, in order.
+
+    Only newline-terminated lines parse (the last line of a live file
+    may still be mid-write — the next poll completes it); non-JSON or
+    non-heartbeat lines are skipped rather than fatal, so a corrupt
+    line in a multi-hour stream costs one sample, not the dashboard."""
+    out = []
+    for raw in text.splitlines(keepends=True):
+        if not raw.endswith("\n"):
+            break  # torn tail: re-read on the next poll
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            line = json.loads(raw)
+        except ValueError:
+            continue
+        if (
+            isinstance(line, dict)
+            and line.get("schema") in ACCEPTED_SCHEMAS
+        ):
+            out.append(line)
+    return out
+
+
+def _bar(frac, width: int = 24) -> str:
+    if frac is None:
+        return "[" + "?" * width + "]"
+    frac = min(max(float(frac), 0.0), 1.0)
+    n = int(round(frac * width))
+    return "[" + "#" * n + "-" * (width - n) + "]"
+
+
+def _fmt_s(v) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    if v >= 3600:
+        return f"{int(v) // 3600}h{(int(v) % 3600) // 60:02d}m"
+    if v >= 60:
+        return f"{int(v) // 60}m{int(v) % 60:02d}s"
+    return f"{v:.1f}s"
+
+
+def render_frame(line: dict, source: str = "") -> str:
+    """One dashboard frame from one heartbeat line (pure function)."""
+    done = bool(line.get("done"))
+    ok = line.get("ok", True)
+    if not done:
+        state = "RUNNING"
+    else:
+        state = "DONE" if ok else "FAILED"
+    wt = line.get("windows_total")
+    wi = line.get("windows_ingested", 0)
+    frac = (wi / wt) if wt else None
+    out = [
+        f"adam-tpu top — {source or 'heartbeat'}   "
+        f"{line.get('schema', '?')}  seq {line.get('seq', '-')}",
+        f"state    {state:<8} elapsed {_fmt_s(line.get('elapsed_s')):<9}"
+        f" eta {_fmt_s(line.get('eta_s'))}",
+        f"windows  {_bar(frac)} {wi}/{wt if wt is not None else '?'}"
+        f"   resumed {line.get('windows_resumed', 0)}"
+        f"   parts {line.get('parts_written', 0)}",
+        f"reads    {line.get('reads_ingested', 0):,}"
+        f"  ({line.get('reads_per_s', 0):,.0f} reads/s)",
+        f"bytes    written {_fmt_bytes(line.get('bytes_written'))}"
+        f"   h2d {_fmt_bytes(line.get('h2d_bytes'))}"
+        f"   d2h {_fmt_bytes(line.get('d2h_bytes'))}",
+    ]
+    per_dev = line.get("inflight_per_device") or {}
+    inflight = line.get("inflight", 0)
+    if per_dev:
+        # depth bars against the double-buffer depth of 2 per device
+        devs = "  ".join(
+            f"{dev}:{_bar(min(n, 2) / 2.0, 6)}{n}"
+            for dev, n in sorted(per_dev.items())
+        )
+        out.append(f"inflight {inflight} total   {devs}")
+    else:
+        out.append(f"inflight {inflight} total")
+    hbm = line.get("hbm_bytes_in_use")
+    if hbm:
+        peak = line.get("hbm_peak_bytes")
+        devs = "  ".join(
+            f"{dev}:{_fmt_bytes(b)}" for dev, b in sorted(hbm.items())
+        )
+        out.append(f"hbm      {devs}   peak {_fmt_bytes(peak)}")
+    elif "hbm_bytes_in_use" in line:
+        out.append("hbm      (unsupported backend — no memory stats)")
+    out.append(
+        f"events   retries {line.get('retries', 0)}"
+        f"   faults {line.get('faults', 0)}"
+        f"   evicted {line.get('devices_evicted', 0)}"
+    )
+    if done:
+        out.append(
+            "run complete — output is final" if ok else
+            "RUN FAILED — the final heartbeat carries ok=false"
+        )
+    return "\n".join(out)
+
+
+def follow(path: str, interval: float = 0.5, out=None,
+           once: bool = False, clear: Optional[bool] = None,
+           max_wait_s: Optional[float] = None) -> int:
+    """Tail a heartbeat file and render frames until ``done=true``.
+
+    * attaches mid-run: the first frame renders the newest line already
+      in the file;
+    * survives rotation: a file that shrinks (the heartbeat moved it to
+      ``<path>.1`` and started fresh) re-reads from the top;
+    * ``once`` renders a single frame from the newest line and exits
+      (scripting/CI mode — no TTY needed);
+    * ``max_wait_s`` bounds the wait for the file/new lines (None =
+      wait forever, the interactive default).
+
+    Exit codes: 0 on ``done=true, ok=true`` (or ``once``), 1 on a final
+    line with ``ok=false``, 2 when the file never appeared / carried no
+    heartbeat lines within the wait bound.
+    """
+    out = out if out is not None else sys.stdout
+    if clear is None:
+        clear = hasattr(out, "isatty") and out.isatty() and not once
+    t0 = time.monotonic()
+    last: Optional[dict] = None
+    pos = 0
+    buf = ""
+
+    def expired() -> bool:
+        return (
+            max_wait_s is not None
+            and time.monotonic() - t0 > max_wait_s
+        )
+
+    while True:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = None
+        if size is None:
+            if once or expired():
+                print(f"top: no heartbeat file at {path}",
+                      file=sys.stderr)
+                return 2
+            time.sleep(interval)
+            continue
+        if size < pos:
+            pos = 0  # rotated/truncated: the writer started fresh
+            buf = ""
+        if size > pos:
+            with open(path, "rb") as fh:
+                fh.seek(pos)
+                chunk = fh.read()
+                pos = fh.tell()
+            buf += chunk.decode("utf-8", errors="replace")
+            lines = parse_heartbeat_text(buf)
+            # keep only the unterminated tail for the next poll
+            nl = buf.rfind("\n")
+            buf = buf[nl + 1:] if nl >= 0 else buf
+            if lines:
+                last = lines[-1]
+                frame = render_frame(last, source=path)
+                if clear:
+                    out.write(_CLEAR)
+                out.write(frame + "\n")
+                if not clear:
+                    out.write("\n")
+                out.flush()
+        if last is not None:
+            if last.get("done"):
+                return 0 if last.get("ok", True) else 1
+            if once:
+                return 0
+        elif once:
+            print(f"top: no heartbeat lines in {path}", file=sys.stderr)
+            return 2
+        if expired():
+            print(
+                f"top: no done=true within {max_wait_s:.0f}s "
+                f"(run still live, or stream stalled)", file=sys.stderr,
+            )
+            return 2
+        time.sleep(interval)

@@ -47,7 +47,26 @@ def _max_threads() -> int:
     at the affinity count regressed the 1-core target to a serial fetch
     and gave back the measured ~2x (ROADMAP "re-measure chunked
     device_fetch under the affinity cap"), so the floor keeps two RPCs
-    in flight regardless of affinity."""
+    in flight regardless of affinity.
+
+    ``ADAM_TPU_FETCH_THREADS`` overrides the floor (clamped to [1, 8])
+    for the real-tunnel experiment the ``device.d2h.bps`` throughput
+    histogram now makes decidable: if the histogram shows the link
+    idling between chunk turnarounds at floor 2, set 4 and re-measure —
+    no code change required.  The CPU-leg measurement (docs/PERF.md
+    "fetch-pool I/O floor") could NOT justify raising the default: its
+    fetch wall is kernel-execution wait, not link idle."""
+    raw = os.environ.get("ADAM_TPU_FETCH_THREADS", "").strip()
+    if raw:
+        try:
+            return max(1, min(8, int(raw)))
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ADAM_TPU_FETCH_THREADS=%r is not an int; using the "
+                "affinity-derived default", raw,
+            )
     try:
         n = len(os.sched_getaffinity(0))
     except (AttributeError, OSError):  # non-Linux fallback
@@ -151,9 +170,25 @@ def device_fetch(x, threads: int = _MAX_THREADS,
     # latency histogram over every device->host fetch (seconds,
     # retries included — the caller-visible latency): on a tunneled
     # link the barrier-2 and pass-C walls are governed by the fetch
-    # TAIL, which the scalar span totals cannot show
+    # TAIL, which the scalar span totals cannot show.  The d2h transfer
+    # ledger rides the same timing: bytes + throughput attributed to
+    # the resident device and the active pipeline pass (pass_scope),
+    # so the analyzer can report tunnel utilization per direction.
     t0 = time.monotonic()
+    out = None
     try:
-        return retry_mod.retry_call(attempt, site="device.fetch")
+        out = retry_mod.retry_call(attempt, site="device.fetch")
+        return out
     finally:
-        tele.TRACE.observe(tele.H_FETCH_SECONDS, time.monotonic() - t0)
+        dur = time.monotonic() - t0
+        tele.TRACE.observe(tele.H_FETCH_SECONDS, dur)
+        if out is not None:
+            dev = _resident_device(x)
+            dev_id = None
+            if dev is not None:
+                dev_id = getattr(dev, "id", None)
+                if dev_id is None:
+                    dev_id = str(dev)
+            tele.TRACE.record_transfer(
+                "d2h", getattr(out, "nbytes", 0), dur, device=dev_id,
+            )

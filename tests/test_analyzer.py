@@ -142,11 +142,17 @@ def test_utilization_from_snapshot_is_bench_embeddable():
     util = analyzer.utilization_from_snapshot(tele.key_stable_snapshot(tr))
     assert util["wall_s"] == pytest.approx(10.0)
     assert set(util["devices"]) == {"0", "1"}
+    # the device ledger rides along for the bench artifact (the
+    # zero-filled key-stable snapshot yields hits/misses of 0)
+    assert util["transfers"] == {}  # no transfers recorded in this run
+    assert util["compiles"] == {}
     # the CPU-baseline shape: no device spans -> {} (key-stable)
     empty = analyzer.utilization_from_snapshot(
         tele.key_stable_snapshot(tele.Tracer(recording=True))
     )
-    assert empty == {"wall_s": None, "devices": {}}
+    assert empty == {
+        "wall_s": None, "devices": {}, "transfers": {}, "compiles": {},
+    }
 
 
 def test_render_report_and_document_kind(tmp_path):
@@ -216,3 +222,98 @@ def test_mirror_marker_prevents_twin_collapse():
     report = analyzer.analyze(tr.to_chrome_trace())
     assert report["devices"]["0"]["n_spans"] == 2
     assert report["histograms"][tele.SPAN_POOL_PREWARM_COMPILE]["count"] == 2
+
+
+# --------------------------------------------------------------------------
+# device ledger sections + resumed-run snapshots
+# --------------------------------------------------------------------------
+def _resumed_run_snapshot():
+    """Synthetic snapshot of a RESUMED 2-device run: this-process work
+    only (the skipped windows never dispatched), resume counters set,
+    and populated transfer/compile/HBM ledger sections."""
+    tr = _synthetic_two_device_tracer()
+    tr.count(tele.C_RESUME_WINDOWS_SKIPPED, 3)
+    tr.count(tele.C_RESUME_HISTOGRAMS_LOADED, 2)
+    tr.count(tele.C_READS_INGESTED, 10_000)
+    with tele.pass_scope("observe"):
+        tr.record_transfer("d2h", 2_000_000, 0.5, device="0")
+        tr.record_transfer("d2h", 2_000_000, 0.25, device="1")
+    with tele.pass_scope("apply"):
+        tr.record_transfer("h2d", 8_000_000, 0.01, device="0")
+    tr.record_compile("bqsr.observe", (1024, 128, 3), "cpu:1", 0.25,
+                      in_window=True)
+    tr.record_compile("bqsr.apply", (32768, 128, 3, 257), "cpu:0", 0.1,
+                      in_window=False)
+    tr.count(tele.C_COMPILE_HITS, 7)
+    tr.record_hbm("0", 1 << 30, peak_bytes=2 << 30)
+    return tr.snapshot()
+
+
+def test_resumed_run_snapshot_report_renders_ledger_sections():
+    """The satellite contract: a resumed run's snapshot analyzes with
+    the resume counters surfaced, busy/idle attribution counting only
+    this-process spans, and the transfer/compile/HBM sections rendering
+    (in-window cold compiles flagged as warnings)."""
+    snap = _resumed_run_snapshot()
+    report = analyzer.analyze(snap)
+    # resume counters present in the report's counter section
+    assert report["counters"][tele.C_RESUME_WINDOWS_SKIPPED] == 3
+    assert report["counters"][tele.C_RESUME_HISTOGRAMS_LOADED] == 2
+    # busy/idle attribution is exactly the this-process span totals
+    # (device 0: 2 s dispatch + 1 s fetch; nothing for skipped windows)
+    assert report["devices"]["0"]["busy_s"] == pytest.approx(3.0)
+    assert report["devices"]["0"]["idle_s"] == pytest.approx(7.0)
+    # transfers: totals, per-device split, throughput, bytes-per-read
+    xfer = report["transfers"]
+    assert xfer["h2d_bytes"] == 8_000_000
+    assert xfer["d2h_bytes"] == 4_000_000
+    assert xfer["devices"]["0"]["d2h"]["bytes_per_s"] == 4_000_000
+    assert xfer["devices"]["0"]["d2h"]["by_pass"] == {"observe": 2_000_000}
+    assert xfer["bytes_per_read"] == pytest.approx(1200.0)
+    # compile cache: the in-window miss is split out
+    comp = report["compiles"]
+    assert comp["cache_hits"] == 7 and comp["cache_misses"] == 2
+    assert comp["prewarmed"] == 1
+    assert [e["kernel"] for e in comp["in_window"]] == ["bqsr.observe"]
+    # HBM peaks
+    assert report["hbm"]["0"]["peak_bytes"] == 2 << 30
+    text = analyzer.render_report(report)
+    assert "Tunnel transfers" in text
+    assert "WARNING: shapes cold-compiled INSIDE a timed window" in text
+    assert "bqsr.observe[1024x128x3]" in text
+    assert "HBM footprint" in text
+    assert "resume.windows_skipped" in text
+
+
+def test_hbm_unsupported_marker_when_devices_but_no_samples():
+    """A device-attributed run whose backend lacks memory_stats must
+    say so explicitly — never render zeros."""
+    tr = _synthetic_two_device_tracer()
+    report = analyzer.analyze(tr.snapshot())
+    assert report["hbm"] == {"unsupported": True}
+    assert "unsupported backend" in analyzer.render_report(report)
+    # a host-only run (no device attribution) gets no HBM section
+    host = tele.Tracer(recording=True)
+    host.add_span(tele.SPAN_TOTAL, 0, S)
+    host_report = analyzer.analyze(host.snapshot())
+    assert host_report["hbm"] == {}
+
+
+def test_trace_mode_carries_ledger_sections_too():
+    """to_chrome_trace embeds transfers/compiles/hbm (+ counters), so
+    trace-mode reports render the same ledger sections as snapshots."""
+    snap_tr = tele.Tracer(recording=True)
+    snap_tr.add_span(tele.SPAN_TOTAL, 0, 10 * S)
+    snap_tr.add_span(tele.SPAN_APPLY_DISPATCH, S, S, device=0)
+    snap_tr.record_transfer("h2d", 1_000_000, 0.001, device="0",
+                            pass_name="apply")
+    snap_tr.record_compile("markdup.columns", (4096, 32, 128), "cpu:0",
+                           0.3, in_window=True)
+    snap_tr.record_hbm("0", 123456)
+    doc = snap_tr.to_chrome_trace()
+    report = analyzer.analyze(doc)
+    assert report["kind"] == "trace"
+    assert report["transfers"]["h2d_bytes"] == 1_000_000
+    assert len(report["compiles"]["in_window"]) == 1
+    assert report["hbm"]["0"]["bytes_in_use"] == 123456
+    assert report["counters"][tele.C_H2D_BYTES] == 1_000_000

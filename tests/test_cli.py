@@ -16,8 +16,9 @@ def run_cli(*argv):
 
 def test_registry_matches_reference():
     """Same command names as ADAMMain.scala:30-72, plus this repo's
-    observability extension (``analyze`` — the run-report half of the
-    telemetry layer has no reference analog)."""
+    observability extensions (``analyze`` — the post-hoc run report —
+    and ``top`` — the live heartbeat dashboard; neither has a
+    reference analog)."""
     names = {c.name for _, cmds in command_groups() for c in cmds}
     assert names == {
         "depth", "count_kmers", "count_contig_kmers", "transform",
@@ -26,7 +27,7 @@ def test_registry_matches_reference():
         "features2adam", "wigfix2bed",
         "print", "print_genes", "flagstat", "print_tags", "listdict",
         "allelecount", "buildinfo", "view",
-        "analyze",
+        "analyze", "top",
     }
 
 

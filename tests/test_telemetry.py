@@ -655,3 +655,163 @@ def test_heartbeat_disabled_is_a_noop(tmp_path, monkeypatch):
     st._stop_heartbeat(hb)
     assert tele.TRACE.recording is False
     assert tele.TRACE.snapshot()["counters"] == {}
+
+
+# --------------------------------------------------------------------------
+# device ledger: transfer accounting / compile ledger / HBM
+# --------------------------------------------------------------------------
+def test_transfer_ledger_attributes_per_device_and_pass():
+    """record_transfer lands the byte counters, the per-direction
+    throughput histograms, and a transfers section keyed by device and
+    by the thread-local pass_scope; absorb() merges all of it."""
+    tr = tele.Tracer(recording=True)
+    with tele.pass_scope("a"):
+        tr.record_transfer("h2d", 1000, 0.001, device="0")
+        with tele.pass_scope("observe"):  # inner scope shadows outer
+            tr.record_transfer("d2h", 4000, 0.002, device="0")
+    tr.record_transfer("d2h", 500, 0.0, device="1")  # no wall -> no bps
+    snap = tr.snapshot()
+    assert snap["counters"][tele.C_H2D_BYTES] == 1000
+    assert snap["counters"][tele.C_D2H_BYTES] == 4500
+    assert snap["transfers"]["h2d"]["0"]["a"]["bytes"] == 1000
+    assert snap["transfers"]["d2h"]["0"]["observe"]["count"] == 1
+    assert snap["transfers"]["d2h"]["1"][tele.PASS_OTHER]["bytes"] == 500
+    # throughput histograms: 1 MB/s and 2 MB/s observed; the zero-wall
+    # transfer contributed bytes but no bps sample
+    assert snap["histograms"][tele.H_H2D_BPS]["count"] == 1
+    assert snap["histograms"][tele.H_D2H_BPS]["count"] == 1
+    assert snap["histograms"][tele.H_D2H_BPS]["max"] == pytest.approx(2e6)
+    # disabled tracer records nothing
+    off = tele.Tracer(recording=False)
+    off.record_transfer("h2d", 10, 0.1, device="0")
+    assert off.snapshot()["transfers"] == {}
+    # absorb merges the per-(device, pass) aggregates additively
+    dst = tele.Tracer(recording=True)
+    dst.record_transfer("h2d", 24, 0.001, device="0", pass_name="a")
+    dst.absorb(tr)
+    merged = dst.snapshot()["transfers"]
+    assert merged["h2d"]["0"]["a"] == {
+        "count": 2, "bytes": 1024, "seconds": pytest.approx(0.002),
+    }
+
+
+def test_compile_ledger_hit_miss_and_in_window_flag():
+    """First dispatch of a (kernel, shape, device) triple is a miss
+    (flagged in_window outside a prewarm scope), later dispatches are
+    hits; a raising dispatch gives its claim back for the retry."""
+    from adam_tpu.utils import compile_ledger as cl
+
+    cl.reset()
+    tele.TRACE.recording = True
+    tele.TRACE.reset()
+    key = ("test.kernel", 128, 64)
+    with cl.prewarm_scope():
+        with cl.track(key, None):
+            pass  # "compile" under prewarm
+    with cl.track(key, None):
+        pass  # warm now -> hit
+    with cl.track(("test.kernel", 256, 64), None):
+        pass  # new shape at a dispatch site -> in-window miss
+    with pytest.raises(RuntimeError):
+        with cl.track(("test.kernel", 512, 64), None):
+            raise RuntimeError("transient")
+    with cl.track(("test.kernel", 512, 64), None):
+        pass  # the discarded claim makes the retry a (recorded) miss
+    snap = tele.TRACE.snapshot()
+    assert snap["counters"][tele.C_COMPILE_MISSES] == 3
+    assert snap["counters"][tele.C_COMPILE_HITS] == 1
+    assert snap["counters"][tele.C_COMPILE_IN_WINDOW] == 2
+    entries = snap["compiles"]["entries"]
+    assert [e["in_window"] for e in entries] == [False, True, True]
+    assert entries[0]["kernel"] == "test.kernel"
+    assert entries[0]["shape"] == [128, 64]
+    assert entries[0]["device"] == "default"
+    assert snap["histograms"][tele.H_COMPILE_SECONDS]["count"] == 3
+    cl.reset()
+
+
+def test_hbm_ledger_tracks_peak_and_key_stability():
+    tr = tele.Tracer(recording=True)
+    tr.record_hbm("0", 1000, peak_bytes=1500)
+    tr.record_hbm("0", 800)
+    tr.record_hbm("1", 2000)
+    snap = tr.snapshot()
+    assert snap["hbm"]["0"] == {"last": 800, "peak": 1500, "n": 2}
+    assert snap["hbm"]["1"] == {"last": 2000, "peak": 2000, "n": 1}
+    # absorb keeps the max peak
+    dst = tele.Tracer(recording=True)
+    dst.record_hbm("0", 3000)
+    dst.absorb(tr)
+    assert dst.snapshot()["hbm"]["0"] == {"last": 800, "peak": 3000, "n": 3}
+    # the CPU bench leg zero-fills the ledger sections key-stably
+    ks = tele.key_stable_snapshot(tele.Tracer(recording=True))
+    assert ks["transfers"] == {"h2d": {}, "d2h": {}}
+    assert ks["compiles"] == {"entries": [], "dropped": 0}
+    assert ks["hbm"] == {}
+    for name in (tele.C_H2D_BYTES, tele.C_D2H_BYTES,
+                 tele.C_COMPILE_HITS, tele.C_COMPILE_MISSES):
+        assert ks["counters"][name] == 0
+    for name in (tele.H_H2D_BPS, tele.H_D2H_BPS, tele.H_COMPILE_SECONDS):
+        assert ks["histograms"][name]["count"] == 0
+
+
+def test_heartbeat_v2_carries_tunnel_and_hbm_fields(tmp_path):
+    """The /2 schema fields: tunnel byte totals from the counters, HBM
+    as {} + null on backends without memory stats (the explicit
+    unsupported marker, distinct from zeros)."""
+    tr = tele.Tracer(recording=True)
+    tr.record_transfer("h2d", 12345, 0.001, device="0", pass_name="a")
+    tr.record_transfer("d2h", 54321, 0.002, device="0", pass_name="apply")
+    hb = tele.Heartbeat([tr], sink=str(tmp_path / "hb.ndjson"),
+                        interval_s=5.0)
+    hb.set_devices([])  # no devices -> unsupported marker path
+    hb.start()
+    hb.stop()
+    lines = [json.loads(l) for l in open(str(tmp_path / "hb.ndjson"))]
+    assert lines[-1]["schema"] == "adam_tpu.heartbeat/2"
+    assert lines[-1]["h2d_bytes"] == 12345
+    assert lines[-1]["d2h_bytes"] == 54321
+    assert lines[-1]["hbm_bytes_in_use"] == {}
+    assert lines[-1]["hbm_peak_bytes"] is None
+    for l in lines:
+        assert tuple(l.keys()) == tele.HEARTBEAT_FIELDS
+
+
+def test_heartbeat_rotation_caps_file_size(tmp_path, monkeypatch):
+    """Past ADAM_TPU_PROGRESS_MAX_BYTES the sink rotates to <path>.1
+    and a fresh file continues — no line is lost or torn across the
+    rotation, and seq stays monotonic across both files."""
+    monkeypatch.setenv("ADAM_TPU_PROGRESS_MAX_BYTES", "600")
+    tr = tele.Tracer(recording=True)
+    p = str(tmp_path / "hb.ndjson")
+    hb = tele.Heartbeat([tr], sink=p, interval_s=60.0)
+    hb.set_devices([])
+    hb.start()
+    for _ in range(6):  # each line is a few hundred bytes
+        hb._emit(done=False)
+    hb.stop()
+    rotated = p + ".1"
+    assert os.path.exists(rotated), "no rotation happened"
+    assert os.path.getsize(p) < 1200
+    all_lines = []
+    for path in (rotated, p):
+        for raw in open(path):
+            assert raw.endswith("\n")
+            all_lines.append(json.loads(raw))
+    # rotation keeps only the newest two files (bounded disk is the
+    # point): the surviving seqs are contiguous and end at the final
+    # line — nothing torn, nothing duplicated
+    seqs = [l["seq"] for l in all_lines]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    assert all_lines[-1]["done"] is True
+    # rotation happens BEFORE each write, so the final done=true line
+    # is always in the LIVE file — a tailer (`adam-tpu top`) watching
+    # the sink path must never have its exit line rotated away
+    live = [json.loads(raw) for raw in open(p)]
+    assert live and live[-1]["done"] is True
+    monkeypatch.delenv("ADAM_TPU_PROGRESS_MAX_BYTES")
+    assert tele.progress_max_bytes() == 64 * 1024 * 1024
+    monkeypatch.setenv("ADAM_TPU_PROGRESS_MAX_BYTES", "bogus")
+    assert tele.progress_max_bytes() == 64 * 1024 * 1024
+    monkeypatch.setenv("ADAM_TPU_PROGRESS_MAX_BYTES", "0")
+    assert tele.progress_max_bytes() == 0

@@ -1,0 +1,124 @@
+"""``adam-tpu top`` dashboard (utils/top.py): heartbeat parsing (torn
+lines, both schema versions), frame rendering, and the follow loop's
+exit contract (0 on done+ok, 1 on done+!ok, 2 on no stream)."""
+
+import io
+import json
+
+from adam_tpu.cli.main import main
+from adam_tpu.utils import telemetry as tele
+from adam_tpu.utils import top as top_mod
+
+
+def _line(**over):
+    base = {
+        "schema": tele.HEARTBEAT_SCHEMA,
+        "seq": 0,
+        "elapsed_s": 1.5,
+        "windows_ingested": 2,
+        "windows_total": 4,
+        "windows_resumed": 1,
+        "parts_written": 1,
+        "reads_ingested": 5000,
+        "reads_per_s": 3333.3,
+        "bytes_written": 1 << 20,
+        "h2d_bytes": 10 << 20,
+        "d2h_bytes": 5 << 20,
+        "hbm_bytes_in_use": {"0": 1 << 30},
+        "hbm_peak_bytes": 2 << 30,
+        "inflight": 2,
+        "inflight_per_device": {"0": 1, "1": 1},
+        "retries": 3,
+        "faults": 1,
+        "devices_evicted": 0,
+        "eta_s": 4.5,
+        "done": False,
+        "ok": True,
+    }
+    base.update(over)
+    return base
+
+
+def test_parse_ignores_torn_tail_and_junk():
+    good = json.dumps(_line(seq=0)) + "\n" + json.dumps(_line(seq=1)) + "\n"
+    text = good + "not json\n" + json.dumps(_line(seq=2))  # no newline
+    lines = top_mod.parse_heartbeat_text(text)
+    # the junk line stops nothing, the unterminated tail is deferred
+    assert [l["seq"] for l in lines] == [0, 1]
+    # a /1 line (no ledger fields) still parses
+    v1 = {k: v for k, v in _line().items()
+          if k not in ("h2d_bytes", "d2h_bytes", "hbm_bytes_in_use",
+                       "hbm_peak_bytes")}
+    v1["schema"] = "adam_tpu.heartbeat/1"
+    assert top_mod.parse_heartbeat_text(json.dumps(v1) + "\n")
+
+
+def test_render_frame_contents():
+    text = top_mod.render_frame(_line(), source="hb.ndjson")
+    assert "RUNNING" in text
+    assert "2/4" in text and "resumed 1" in text and "parts 1" in text
+    assert "5,000" in text
+    assert "10.0MiB" in text and "5.0MiB" in text  # tunnel totals
+    assert "1.0GiB" in text and "peak 2.0GiB" in text
+    assert "retries 3" in text and "faults 1" in text
+    done = top_mod.render_frame(_line(done=True, ok=True))
+    assert "DONE" in done and "run complete" in done
+    failed = top_mod.render_frame(_line(done=True, ok=False))
+    assert "FAILED" in failed and "ok=false" in failed
+    # /1 line without HBM fields: no fabricated zeros, no crash
+    v1 = {k: v for k, v in _line().items()
+          if not k.startswith(("h2d", "d2h", "hbm"))}
+    text = top_mod.render_frame(v1)
+    assert "h2d -" in text and "hbm" not in text.splitlines()[5]
+
+
+def test_follow_exit_codes(tmp_path, capsys):
+    p = str(tmp_path / "hb.ndjson")
+    with open(p, "w") as fh:
+        fh.write(json.dumps(_line(seq=0)) + "\n")
+        fh.write(json.dumps(_line(seq=1, done=True, ok=True)) + "\n")
+    out = io.StringIO()
+    assert top_mod.follow(p, interval=0.01, out=out) == 0
+    assert "DONE" in out.getvalue()
+    # crashed run: final line ok=false -> exit 1
+    with open(p, "w") as fh:
+        fh.write(json.dumps(_line(done=True, ok=False)) + "\n")
+    assert top_mod.follow(p, interval=0.01, out=io.StringIO()) == 1
+    # missing file in -once mode -> exit 2
+    assert top_mod.follow(str(tmp_path / "nope.ndjson"), once=True,
+                          out=io.StringIO()) == 2
+    # empty file in -once mode -> exit 2
+    empty = str(tmp_path / "empty.ndjson")
+    open(empty, "w").close()
+    assert top_mod.follow(empty, once=True, out=io.StringIO()) == 2
+    # live (not done) stream in -once mode renders one frame, exit 0
+    live = str(tmp_path / "live.ndjson")
+    with open(live, "w") as fh:
+        fh.write(json.dumps(_line(seq=0)) + "\n")
+    out = io.StringIO()
+    assert top_mod.follow(live, once=True, out=out) == 0
+    assert "RUNNING" in out.getvalue()
+
+
+def test_follow_survives_rotation_truncate(tmp_path):
+    """A file that shrinks (the heartbeat rotated it) re-reads from the
+    top instead of wedging on a stale offset."""
+    p = str(tmp_path / "hb.ndjson")
+    big = json.dumps(_line(seq=0, reads_ingested=10**9)) + "\n"
+    with open(p, "w") as fh:
+        fh.write(big * 5)
+    out = io.StringIO()
+    assert top_mod.follow(p, once=True, out=out) == 0
+    # simulate rotation: much smaller fresh file carrying the final line
+    with open(p, "w") as fh:
+        fh.write(json.dumps(_line(seq=9, done=True)) + "\n")
+    assert top_mod.follow(p, interval=0.01, out=io.StringIO()) == 0
+
+
+def test_top_cli_subcommand(tmp_path, capsys):
+    p = str(tmp_path / "hb.ndjson")
+    with open(p, "w") as fh:
+        fh.write(json.dumps(_line(done=True)) + "\n")
+    assert main(["top", p, "-once"]) == 0
+    assert "adam-tpu top" in capsys.readouterr().out
+    assert main(["top", str(tmp_path / "missing"), "-once"]) == 2
