@@ -454,6 +454,7 @@ class Transform(Command):
                     args.input, args.output,
                     window_reads=args.window_reads,
                     devices=getattr(args, "devices", None),
+                    partitioner=getattr(args, "partitioner", None),
                     progress=getattr(args, "progress", None),
                     run_dir=getattr(args, "run_dir", None),
                     resume=bool(getattr(args, "resume", False)), **kw,

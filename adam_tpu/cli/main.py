@@ -70,6 +70,18 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
         "path; requests beyond the attached count are capped)",
     )
     parser.add_argument(
+        "--partitioner", dest="partitioner", default=None,
+        choices=["pool", "mesh"],
+        help="how the streamed pipeline places device work across the "
+        "chips: 'pool' (default) round-robins whole windows with "
+        "host-side histogram merges; 'mesh' shards every window over a "
+        "batch Mesh, psums the BQSR observe histograms on-device (one "
+        "merged table crosses at barrier 2 instead of one per window) "
+        "and keeps the solved table device-resident through pass C — "
+        "bit-identical output, degrades to 'pool' on device failure; "
+        "also honored from ADAM_TPU_PARTITIONER",
+    )
+    parser.add_argument(
         "--fault-spec", dest="fault_spec", default=None, metavar="SPEC",
         help="arm deterministic fault injection at named pipeline "
         "points (testing/CI only; e.g. 'device.dispatch=transient,"

@@ -427,6 +427,71 @@ def make_pool(requested: Optional[int] = None) -> Optional[DevicePool]:
 # --------------------------------------------------------------------------
 # Streamed-pipeline kernel set
 # --------------------------------------------------------------------------
+def dummy_like(field, shape, fill=0) -> np.ndarray:
+    """Prewarm dummy argument: ``fill`` at ``shape`` in FIELD's dtype —
+    shapes AND dtypes must match the real dispatches bit-for-bit or the
+    jit cache treats the warm call as a different program.  Shared by
+    every prewarm entry builder (here and the mesh entries in
+    parallel/partitioner.py), so a kernel-signature change has one
+    dummy-construction idiom to keep in sync."""
+    dt = np.asarray(field).dtype
+    return np.full(shape, fill, dtype=dt)
+
+
+# Per-kernel dummy argument tuples — THE single source of truth for
+# each kernel's prewarm signature, shared by the pool entries below and
+# the mesh entries in parallel/partitioner.py (which only differ in the
+# row count ``g``: the mesh pads it to a device-count multiple).  A
+# kernel-signature change edits exactly one of these.
+def markdup_dummy_args(b, g: int, gl: int, gc: int) -> tuple:
+    """markdup_columns_local's 8 args at grid (g rows, gc cigar ops,
+    gl lanes)."""
+    from adam_tpu.formats import schema
+
+    _z = dummy_like
+    return (
+        _z(b.start, (g,), -1), _z(b.end, (g,), -1),
+        _z(b.flags, (g,), schema.FLAG_UNMAPPED),
+        _z(b.cigar_ops, (g, gc), schema.CIGAR_PAD),
+        _z(b.cigar_lens, (g, gc)), _z(b.cigar_n, (g,)),
+        _z(b.quals, (g, gl), schema.QUAL_PAD), _z(b.lengths, (g,)),
+    )
+
+
+def observe_dummy_args(b, g: int, gl: int) -> tuple:
+    """observe_kernel's 8 array args at grid (g rows, gl lanes) —
+    static (n_rg, gl) follow at the call site."""
+    from adam_tpu.formats import schema
+
+    _z = dummy_like
+    return (
+        _z(b.bases, (g, gl), schema.BASE_PAD),
+        _z(b.quals, (g, gl), schema.QUAL_PAD),
+        _z(b.lengths, (g,)),
+        _z(b.flags, (g,), schema.FLAG_UNMAPPED),
+        _z(b.read_group_idx, (g,), -1),
+        np.zeros((g, gl), bool), np.zeros((g, gl), bool),
+        np.zeros((g,), bool),
+    )
+
+
+def apply_dummy_args(b, g: int, gl: int) -> tuple:
+    """apply_table_kernel's 7 per-row args at grid (g rows, gl lanes) —
+    the u8 table dummy (shape depends on the solved width) and static
+    gl follow at the call site."""
+    from adam_tpu.formats import schema
+
+    _z = dummy_like
+    return (
+        _z(b.bases, (g, gl), schema.BASE_PAD),
+        _z(b.quals, (g, gl), schema.QUAL_PAD),
+        _z(b.lengths, (g,)),
+        _z(b.flags, (g,), schema.FLAG_UNMAPPED),
+        _z(b.read_group_idx, (g,), -1),
+        np.zeros((g,), bool), np.zeros((g,), bool),
+    )
+
+
 def streamed_prewarm_entries(
     b, n_rg: int, *, mark_duplicates: bool = True, recalibrate: bool = True,
 ) -> list[tuple]:
@@ -440,52 +505,33 @@ def streamed_prewarm_entries(
     """
     import jax
 
-    from adam_tpu.formats import schema
     from adam_tpu.formats.batch import grid_cols, grid_rows
 
     g = grid_rows(b.n_rows)
     gl = grid_cols(b.lmax)
     gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
 
-    def _z(field, shape, fill=0):
-        dt = np.asarray(field).dtype
-        return np.full(shape, fill, dtype=dt)
-
     entries: list[tuple] = []
     if mark_duplicates:
-        def warm_markdup(dev, _z=_z, g=g, gl=gl, gc=gc):
+        def warm_markdup(dev, g=g, gl=gl, gc=gc):
             from adam_tpu.pipelines.markdup import get_columns_jit
 
-            args = (
-                _z(b.start, (g,), -1), _z(b.end, (g,), -1),
-                _z(b.flags, (g,), schema.FLAG_UNMAPPED),
-                _z(b.cigar_ops, (g, gc), schema.CIGAR_PAD),
-                _z(b.cigar_lens, (g, gc)), _z(b.cigar_n, (g,)),
-                _z(b.quals, (g, gl), schema.QUAL_PAD), _z(b.lengths, (g,)),
-            )
-            out = get_columns_jit()(
-                *(jax.device_put(a, dev) for a in args)
-            )
+            out = get_columns_jit()(*(
+                jax.device_put(a, dev)
+                for a in markdup_dummy_args(b, g, gl, gc)
+            ))
             jax.block_until_ready(out)
 
         entries.append((("markdup.columns", g, gc, gl), warm_markdup))
 
     if recalibrate:
-        def warm_observe(dev, _z=_z, g=g, gl=gl):
+        def warm_observe(dev, g=g, gl=gl):
             from adam_tpu.pipelines.bqsr import observe_kernel
 
-            args = (
-                _z(b.bases, (g, gl), schema.BASE_PAD),
-                _z(b.quals, (g, gl), schema.QUAL_PAD),
-                _z(b.lengths, (g,)),
-                _z(b.flags, (g,), schema.FLAG_UNMAPPED),
-                _z(b.read_group_idx, (g,), -1),
-                np.zeros((g, gl), bool), np.zeros((g, gl), bool),
-                np.zeros((g,), bool),
-            )
-            out = observe_kernel(
-                *(jax.device_put(a, dev) for a in args), n_rg, gl
-            )
+            out = observe_kernel(*(
+                jax.device_put(a, dev)
+                for a in observe_dummy_args(b, g, gl)
+            ), n_rg, gl)
             jax.block_until_ready(out)
 
         entries.append((("bqsr.observe", g, gl, n_rg), warm_observe))
@@ -500,24 +546,12 @@ def streamed_prewarm_entries(
 def _apply_entry(b, n_rg: int, g: int, gl: int, n_cyc: int) -> tuple:
     import jax
 
-    from adam_tpu.formats import schema
-
-    def _z(field, shape, fill=0):
-        dt = np.asarray(field).dtype
-        return np.full(shape, fill, dtype=dt)
-
     def warm_apply(dev):
         from adam_tpu.pipelines.bqsr import (
             N_DINUC, N_QUAL, apply_table_kernel,
         )
 
-        args = (
-            _z(b.bases, (g, gl), schema.BASE_PAD),
-            _z(b.quals, (g, gl), schema.QUAL_PAD),
-            _z(b.lengths, (g,)),
-            _z(b.flags, (g,), schema.FLAG_UNMAPPED),
-            _z(b.read_group_idx, (g,), -1),
-            np.zeros((g,), bool), np.zeros((g,), bool),
+        args = apply_dummy_args(b, g, gl) + (
             np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8),
         )
         out = apply_table_kernel(
@@ -541,3 +575,146 @@ def apply_prewarm_entry(b, n_rg: int, table_n_cyc: int) -> tuple:
     return _apply_entry(
         b, n_rg, grid_rows(b.n_rows), grid_cols(b.lmax), table_n_cyc
     )
+
+
+def observe_prewarm_entry(b, n_rg: int) -> tuple:
+    """Observe-only prewarm entry at one batch view's grid shape — the
+    long-tail re-warm hook: residual windows and the realigned tail
+    part land on grids window 0 never saw (the measured grid-1024
+    0.26 s in-window cold compile, docs/PERF.md), so the streamed
+    pipeline re-prewarms on first sight of a new shape through the same
+    process-wide dedupe cache (already-warm shapes are free)."""
+    import jax
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+
+    g = grid_rows(b.n_rows)
+    gl = grid_cols(b.lmax)
+
+    def warm_observe(dev, g=g, gl=gl):
+        from adam_tpu.pipelines.bqsr import observe_kernel
+
+        out = observe_kernel(*(
+            jax.device_put(a, dev) for a in observe_dummy_args(b, g, gl)
+        ), n_rg, gl)
+        jax.block_until_ready(out)
+
+    return (("bqsr.observe", g, gl, n_rg), warm_observe)
+
+
+# --------------------------------------------------------------------------
+# Realign sweep fan-out: weighted round-robin over the pool/mesh devices
+# --------------------------------------------------------------------------
+#: Process-wide probe cache: device key -> TFLOP/s (one probe per
+#: device per process; the probe kernel compiles once and is tiny).
+_PROBE_TFLOPS: dict = {}
+_PROBE_LOCK = threading.Lock()
+
+
+def probe_device_tflops(device) -> float:
+    """One small timed f32 matmul on ``device`` -> TFLOP/s (cached per
+    process).  A deliberately light sibling of bench.py's 4096³ probe:
+    it only needs RELATIVE skew between time-sliced chips to pace the
+    sweep scheduler, not an absolute ceiling."""
+    key = _device_key(device)
+    with _PROBE_LOCK:
+        got = _PROBE_TFLOPS.get(key)
+    if got is not None:
+        return got
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        n = 1024
+        a = jax.device_put(jnp.ones((n, n), jnp.float32), device)
+        jax.block_until_ready(a @ a)  # compile + first run
+        # best-of-3: a single timed rep caught mid-stall on a
+        # time-sliced chip would mislabel the device for the whole
+        # process (the cache below is permanent) and skew the sweep
+        # schedule WORSE than plain round-robin
+        best_dt = float("inf")
+        for _ in range(3):
+            t0 = time.monotonic()
+            jax.block_until_ready(a @ a)
+            best_dt = min(best_dt, max(time.monotonic() - t0, 1e-9))
+        tf = 2 * n**3 / best_dt / 1e12
+    except Exception:
+        # NOT cached: a transient probe error must not permanently
+        # disable probe pacing for the process — the next schedule
+        # construction re-probes
+        return 0.0
+    with _PROBE_LOCK:
+        _PROBE_TFLOPS[key] = tf
+    return tf
+
+
+def sweep_weights(devices) -> list[float]:
+    """Relative throughput weight per device for the sweep scheduler.
+
+    Order: ``ADAM_TPU_SWEEP_TFLOPS`` (comma-separated floats — feed a
+    bench artifact's ``per_device_probe_tflops`` straight in; entry k
+    weights device **id** k, so eviction-shrunk device lists still pace
+    the right chips; ids past the list fall back to the mean, malformed
+    values degrade to equal weights), then a one-time in-process matmul
+    probe on accelerator devices, then equal weights (virtual-CPU test
+    meshes are symmetric by construction — probing them measures
+    scheduler noise).
+    """
+    n = len(devices)
+    raw = os.environ.get("ADAM_TPU_SWEEP_TFLOPS", "").strip()
+    if raw:
+        try:
+            vals = [float(v) for v in raw.split(",") if v.strip()]
+            if vals and all(v > 0 for v in vals):
+                mean = sum(vals) / len(vals)
+                # match by device ID, not list position: after an
+                # eviction the caller passes the SURVIVORS, and a
+                # positional match would pace every chip with its dead
+                # neighbor's weight
+                out = []
+                for i, d in enumerate(devices):
+                    dev_id = getattr(d, "id", i)
+                    out.append(
+                        vals[dev_id]
+                        if isinstance(dev_id, int) and 0 <= dev_id < len(vals)
+                        else mean
+                    )
+                return out
+        except ValueError:
+            pass
+        log.warning(
+            "ADAM_TPU_SWEEP_TFLOPS=%r is not a comma list of positive "
+            "floats; using equal weights", raw,
+        )
+        return [1.0] * n
+    if any(getattr(d, "platform", "cpu") != "cpu" for d in devices):
+        probed = [probe_device_tflops(d) for d in devices]
+        if all(v > 0 for v in probed):
+            return probed
+    return [1.0] * n
+
+
+class SweepSchedule:
+    """Deterministic deficit round-robin over a device set: chunk ``k``
+    goes to the device with the largest accumulated credit
+    (``weight share × chunks seen − chunks assigned``), so a chip with
+    2× the probe throughput receives 2× the sweep chunks.  Equal
+    weights degrade to plain round-robin.  Placement never affects the
+    sweep VALUES (each chunk is self-contained), so pacing is free to
+    chase the grant skew run by run."""
+
+    def __init__(self, devices, weights=None):
+        self.devices = list(devices)
+        w = list(weights) if weights is not None else sweep_weights(
+            self.devices
+        )
+        total = sum(w) or 1.0
+        self._share = [v / total for v in w]
+        self._credit = [0.0] * len(self.devices)
+
+    def next_device(self):
+        for i, s in enumerate(self._share):
+            self._credit[i] += s
+        i = max(range(len(self._credit)), key=lambda k: self._credit[k])
+        self._credit[i] -= 1.0
+        return self.devices[i]

@@ -23,6 +23,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SHARD_AXIS = "shard"
 
+#: Axis name of the streamed pipeline's per-window data-parallel mesh
+#: (parallel/partitioner.MeshPartitioner): each window's [N, L] arrays
+#: shard their read-row axis over it, observe histograms psum across it.
+BATCH_AXIS = "batch"
+
 # jax moved shard_map from jax.experimental (check_rep) to the top level
 # (check_vma) — accept both spellings so the collectives run on every
 # toolchain the container ships.
@@ -49,6 +54,20 @@ def genome_mesh(devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh over all (or the given) devices."""
     devices = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def batch_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D ``batch`` mesh over the given (or all local) devices — the
+    streamed pipeline's SPMD execution mesh.  Distinct from
+    :func:`genome_mesh` only in axis name, so the partitioner's
+    shardings read as what they are: data-parallel over read rows."""
+    devices = list(devices) if devices is not None else jax.local_devices()
+    return Mesh(np.array(devices), (BATCH_AXIS,))
+
+
+def batch_row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (read-row) axis over the ``batch`` mesh."""
+    return NamedSharding(mesh, P(BATCH_AXIS))
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,6 +1,6 @@
-"""Genome-coordinate partitioning.
+"""Genome-coordinate partitioning + streamed execution partitioners.
 
-Semantics of ``rdd/GenomicPartitioners.scala``:
+Genome-coordinate half — semantics of ``rdd/GenomicPartitioners.scala``:
 
 * :func:`position_partition` — GenomicPositionPartitioner.getPartition
   (:63-85): map (contig, pos) to one of N partitions by cumulative genome
@@ -10,16 +10,46 @@ Semantics of ``rdd/GenomicPartitioners.scala``:
 
 Both return plain arrays so the result can drive either a host-side
 scatter into per-device shards or a device all_to_all exchange.
+
+Execution half — how the streamed flagship places per-window device
+work (``--partitioner {pool,mesh}`` / ``ADAM_TPU_PARTITIONER``):
+
+* ``pool`` — the PR-3 round-robin :class:`~adam_tpu.parallel.
+  device_pool.DevicePool`: window *i*'s kernels land whole on device
+  ``i % n``, per-device observe histograms fetch to the host and merge
+  in window order at barrier 2.  The fault-tolerance layer
+  (eviction/replay, docs/ROBUSTNESS.md) lives here.
+* ``mesh`` — :class:`MeshPartitioner`, the SPMD mode: every window's
+  [N, L] arrays shard their read-row axis over a 1-D ``batch``
+  :class:`jax.sharding.Mesh` spanning ALL the devices, the pass-B
+  observe histograms ``psum`` on-device and accumulate into a
+  device-resident running table, and only THE merged table (one
+  compact [n_rg, 94, 2gl+1, 17] pair per distinct grid width) crosses
+  to the host at barrier 2 — instead of one fetched copy per window,
+  the measured 74%-of-wall barrier-2 cost (docs/PERF.md).  The solved
+  recalibration table is placed once, replicated, and stays
+  device-resident through pass C.  On any device failure the mode
+  **degrades to the pool path** (bit-identically — the kernels are the
+  same math; windows already folded into a suspect accumulator replay
+  through the pool/host observe), so PR 4's eviction/replay contract
+  holds unchanged.
 """
 
 from __future__ import annotations
 
+import logging
+import os
+import threading
+import time
 from dataclasses import dataclass
-from functools import cached_property
+from functools import cached_property, partial
+from typing import Optional, Sequence
 
 import numpy as np
 
 from adam_tpu.models.dictionaries import SequenceDictionary
+
+log = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -160,3 +190,425 @@ def shard_rows_by_contig(contig_idx, n_shards: int):
     """Row-index lists per shard under contig partitioning."""
     part = partition_by_contig(contig_idx, n_shards)
     return [np.flatnonzero(part == s) for s in range(n_shards)]
+
+
+# ==========================================================================
+# Streamed execution partitioners (--partitioner {pool,mesh})
+# ==========================================================================
+EXECUTION_MODES = ("pool", "mesh")
+
+
+def resolve_execution_mode(override: Optional[str] = None) -> str:
+    """Resolve the streamed pipeline's execution partitioner.
+
+    Order: explicit ``override`` (the ``--partitioner`` flag — invalid
+    values are a hard error), then ``ADAM_TPU_PARTITIONER`` (invalid
+    values warn and degrade to ``pool``, the tuning-var contract), then
+    ``pool`` — the fault-tolerance-hardened default; ``mesh`` is the
+    opt-in SPMD mode.
+    """
+    v = (override or "").strip().lower()
+    if v:
+        if v not in EXECUTION_MODES:
+            raise ValueError(
+                f"--partitioner={v!r}: expected one of {EXECUTION_MODES}"
+            )
+        return v
+    v = os.environ.get("ADAM_TPU_PARTITIONER", "").strip().lower()
+    if v and v not in EXECUTION_MODES:
+        log.warning(
+            "ADAM_TPU_PARTITIONER=%r is not one of %s; using 'pool'",
+            v, EXECUTION_MODES,
+        )
+        v = ""
+    return v or "pool"
+
+
+# ---- mesh jit wrappers (module level: ONE executable cache per shape,
+# shared by the prewarm and every window's dispatch) -----------------------
+def _mesh_specs(n_args: int):
+    from jax.sharding import PartitionSpec as P
+
+    from adam_tpu.parallel.mesh import BATCH_AXIS
+
+    return tuple(P(BATCH_AXIS) for _ in range(n_args))
+
+
+def _mesh_observe_jit_builder():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from adam_tpu.parallel.mesh import BATCH_AXIS, shard_map
+
+    @partial(jax.jit, static_argnames=("n_rg", "lmax", "mesh"))
+    def run(bases, quals, lengths, flags, rg, res_ok, is_mm, rd_ok,
+            n_rg, lmax, mesh):
+        from adam_tpu.pipelines.bqsr import observe_kernel
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=_mesh_specs(8),
+            out_specs=(P(), P()), check_vma=False,
+        )
+        def body(b, q, le, fl, r, ro, mm, ok):
+            # the exact single-chip kernel body per shard; the i64
+            # cross-shard psum is the on-device analog of the pool's
+            # host-side window-order merge — integer adds, so the sums
+            # are bitwise identical in any order
+            total, mism = observe_kernel.__wrapped__(
+                b, q, le, fl, r, ro, mm, ok, n_rg, lmax
+            )
+            return (
+                jax.lax.psum(total, BATCH_AXIS),
+                jax.lax.psum(mism, BATCH_AXIS),
+            )
+
+        return body(bases, quals, lengths, flags, rg, res_ok, is_mm, rd_ok)
+
+    return run
+
+
+def _mesh_apply_jit_builder(donate: bool):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from adam_tpu.parallel.mesh import BATCH_AXIS, shard_map
+
+    def run(bases, quals, lengths, flags, rg, has_qual, valid, table,
+            lmax, mesh):
+        from adam_tpu.pipelines.bqsr import apply_table_kernel
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=_mesh_specs(7) + (P(),),
+            out_specs=P(BATCH_AXIS), check_vma=False,
+        )
+        def body(b, q, le, fl, r, hq, v, tbl):
+            return apply_table_kernel.__wrapped__(
+                b, q, le, fl, r, hq, v, tbl, lmax
+            )
+
+        return body(bases, quals, lengths, flags, rg, has_qual, valid, table)
+
+    kw = {"static_argnames": ("lmax", "mesh")}
+    if donate:
+        # the new quals alias the old quals' shape/dtype: donating the
+        # input buffer keeps pass C's HBM footprint at one [g, gl] u8
+        # per in-flight window instead of two
+        kw["donate_argnums"] = (1,)
+    return partial(jax.jit, **kw)(run)
+
+
+def _mesh_markdup_jit_builder():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from adam_tpu.parallel.mesh import BATCH_AXIS, shard_map
+
+    @partial(jax.jit, static_argnames=("mesh",))
+    def run(start, end, flags, ops, lens, n_ops, quals, lengths, mesh):
+        from adam_tpu.pipelines.markdup import markdup_columns_local
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=_mesh_specs(8),
+            out_specs=(P(BATCH_AXIS), P(BATCH_AXIS)),
+            check_vma=False,
+        )
+        def body(s, e, f, o, ln, n, q, le):
+            return markdup_columns_local(s, e, f, o, ln, n, q, le)
+
+        return body(start, end, flags, ops, lens, n_ops, quals, lengths)
+
+    return run
+
+
+_MESH_JITS: dict = {}
+_MESH_JITS_LOCK = threading.Lock()
+
+
+def _mesh_jit(kind: str, donate: bool = False):
+    """Lazily-built module-level mesh jits (one executable cache each,
+    shared by prewarm and dispatch — the device_pool get_columns_jit
+    discipline)."""
+    key = (kind, donate)
+    fn = _MESH_JITS.get(key)
+    if fn is None:
+        with _MESH_JITS_LOCK:
+            fn = _MESH_JITS.get(key)
+            if fn is None:
+                builder = {
+                    "observe": _mesh_observe_jit_builder,
+                    "markdup": _mesh_markdup_jit_builder,
+                }.get(kind)
+                if builder is not None:
+                    fn = builder()
+                else:
+                    fn = _mesh_apply_jit_builder(donate)
+                _MESH_JITS[key] = fn
+    return fn
+
+
+class MeshPartitioner:
+    """SPMD execution mode for the streamed pipeline (module docstring).
+
+    Holds the 1-D ``batch`` mesh over the run's device set, the row/
+    replicated shardings, and the device-resident pass-B observe
+    accumulator — one running (total, mism) i64 pair per distinct grid
+    width, so barrier 2 fetches table-scale bytes however many windows
+    streamed through.  All placement goes through :meth:`put_rows` /
+    :meth:`put_replicated`, which feed the h2d transfer ledger with the
+    bytes split per member device (sharded) or counted once per device
+    (replicated) — "mesh dispatch sites attributed per device" in
+    ``adam-tpu analyze``.  Dispatch *spans* carry ``device="mesh"``:
+    collective work occupies every device at once, so it gets its own
+    track instead of a fabricated per-chip split.
+    """
+
+    def __init__(self, devices: Sequence):
+        from adam_tpu.parallel.mesh import batch_mesh
+
+        self.devices = list(devices)
+        if not self.devices:
+            raise ValueError("MeshPartitioner needs at least one device")
+        self.mesh = batch_mesh(self.devices)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from adam_tpu.parallel.mesh import batch_row_sharding
+
+        self._rows = batch_row_sharding(self.mesh)
+        self._rep = NamedSharding(self.mesh, P())
+        # gl -> [total, mism] replicated device i64 arrays (no lock:
+        # observe dispatch and the barrier fetch both run on the
+        # streamed pipeline's single driver thread)
+        self._acc: dict = {}
+        self._dev_ids = [
+            getattr(d, "id", i) for i, d in enumerate(self.devices)
+        ]
+
+    @property
+    def n(self) -> int:
+        return len(self.devices)
+
+    def ledger_key(self) -> str:
+        """The compile-ledger 'device' key for mesh executables: one
+        per mesh width — a 2-device and an 8-device mesh compile
+        different programs."""
+        return f"mesh:{self.n}"
+
+    def rows_for(self, g: int) -> int:
+        """Row count the mesh needs: ``g`` padded up to a multiple of
+        the device count (pow2 grids over pow2 meshes are unchanged)."""
+        return -(-int(g) // self.n) * self.n
+
+    # ---- placement (the h2d side of the transfer ledger) --------------
+    def _put(self, x, sharding, bytes_per_device: int):
+        import jax
+
+        from adam_tpu.utils import telemetry as tele
+
+        if not tele.TRACE.recording:
+            return jax.device_put(x, sharding)
+        t0 = time.monotonic()
+        out = jax.device_put(x, sharding)
+        dur = time.monotonic() - t0
+        for dev_id in self._dev_ids:
+            tele.TRACE.record_transfer(
+                "h2d", bytes_per_device, dur / self.n, device=dev_id,
+            )
+        return out
+
+    def put_rows(self, x):
+        """Place one row-sharded array (leading axis must divide by
+        ``n`` — pad with :meth:`rows_for` first)."""
+        nbytes = getattr(x, "nbytes", 0)
+        return self._put(x, self._rows, nbytes // self.n)
+
+    def put_replicated(self, x):
+        """Place one fully-replicated array (each device holds a copy,
+        and the ledger charges each its copy)."""
+        return self._put(x, self._rep, getattr(x, "nbytes", 0))
+
+    # ---- pass B: observe + on-device accumulate ------------------------
+    def observe_window(self, arrays: tuple, n_rg: int, gl: int):
+        """Dispatch one window's observe scatter-add across the mesh ->
+        lazy replicated (total, mism) i64 device arrays.
+
+        ``arrays``: the 8 host arrays of the observe kernel signature,
+        already padded to (:meth:`rows_for`(g), gl) rows/lanes.
+        """
+        placed = tuple(self.put_rows(a) for a in arrays)
+        return _mesh_jit("observe")(*placed, n_rg=n_rg, lmax=gl,
+                                    mesh=self.mesh)
+
+    def accumulate(self, total, mism, gl: int) -> None:
+        """Fold one window's lazy histograms into the device-resident
+        running table for its grid width (i64 adds: bitwise identical
+        to the pool path's host-side window-order merge)."""
+        import jax.numpy as jnp
+
+        acc = self._acc.get(int(gl))
+        if acc is None:
+            self._acc[int(gl)] = [total, mism]
+        else:
+            acc[0] = jnp.add(acc[0], total)
+            acc[1] = jnp.add(acc[1], mism)
+
+    def has_accumulated(self) -> bool:
+        return bool(self._acc)
+
+    def fetch_accumulated(self, tracer=None) -> list:
+        """Barrier 2: bring the merged tables home — ONE compact
+        (total, mism, gl) per distinct grid width, each through the
+        chunked transfer helper (d2h ledger + ``device.fetch.observe``
+        span, ``device="mesh"`` attributed).  Clears the accumulator."""
+        from adam_tpu.utils import telemetry as tele
+        from adam_tpu.utils.transfer import device_fetch
+
+        tr = tracer if tracer is not None else tele.TRACE
+        out = []
+        try:
+            for gl in sorted(self._acc):
+                total, mism = self._acc[gl]
+                with tr.span(tele.SPAN_OBS_FETCH, device="mesh"):
+                    out.append(
+                        (device_fetch(total), device_fetch(mism), gl)
+                    )
+        finally:
+            self._acc.clear()
+        return out
+
+    def reset_accumulator(self) -> None:
+        self._acc.clear()
+
+    # ---- pass A: markdup columns ---------------------------------------
+    def markdup_window(self, arrays: tuple):
+        """Row-sharded [N, L] markdup reductions -> lazy (five, score)
+        row-sharded device arrays (padded rows included; caller
+        slices)."""
+        placed = tuple(self.put_rows(a) for a in arrays)
+        return _mesh_jit("markdup")(*placed, mesh=self.mesh)
+
+    # ---- pass C: apply with the device-resident table ------------------
+    def apply_supports_donation(self) -> bool:
+        # buffer donation is a no-op (with a warning) on some CPU
+        # runtimes: keep the virtual-device test legs quiet and donate
+        # where it pays — on real accelerators
+        return all(
+            getattr(d, "platform", "cpu") != "cpu" for d in self.devices
+        )
+
+    def apply_window(self, arrays: tuple, table_dev, gl: int):
+        """Dispatch one window's recalibration gather across the mesh
+        -> lazy row-sharded u8[g, gl] quals.  ``table_dev`` must come
+        from :meth:`put_replicated` — placed once, device-resident for
+        every window of pass C (the B→C no-round-trip contract)."""
+        placed = tuple(self.put_rows(a) for a in arrays)
+        return _mesh_jit("apply", donate=self.apply_supports_donation())(
+            *placed, table_dev, lmax=gl, mesh=self.mesh
+        )
+
+    # ---- compile prewarm ----------------------------------------------
+    def prewarm(self, entries: Sequence[tuple], tracer=None) -> int:
+        """Compile the mesh kernel set before the first window's
+        dispatch — the mesh analog of ``DevicePool.prewarm``, sharing
+        its process-wide dedupe cache keyed by (entry key,
+        :meth:`ledger_key`) so warm shapes are never re-compiled.
+        ``entries``: ``(key, fn)`` pairs where ``fn(None)`` invokes the
+        mesh jit to completion on dummy data."""
+        from adam_tpu.parallel import device_pool as dp
+        from adam_tpu.utils import compile_ledger
+        from adam_tpu.utils import telemetry as tele
+
+        tr = tracer if tracer is not None else tele.TRACE
+        todo = []
+        with dp._PREWARM_LOCK:
+            for key, fn in entries:
+                cache_key = (key, self.ledger_key())
+                if cache_key not in dp._PREWARMED:
+                    dp._PREWARMED.add(cache_key)
+                    todo.append((key, fn, cache_key))
+        done = 0
+        for key, fn, cache_key in todo:
+            try:
+                with tr.span(
+                    tele.SPAN_POOL_PREWARM_COMPILE, device="mesh",
+                    kernel=str(key[0]),
+                ), compile_ledger.prewarm_scope(), \
+                        tele.pass_scope("prewarm"), \
+                        compile_ledger.track(key, self.ledger_key()):
+                    fn(None)
+            except Exception:
+                with dp._PREWARM_LOCK:
+                    dp._PREWARMED.discard(cache_key)
+                log.warning(
+                    "mesh prewarm of %s failed; the shape will compile "
+                    "at first dispatch instead", key, exc_info=True,
+                )
+                continue
+            tr.count(tele.C_POOL_PREWARM_COMPILES)
+            done += 1
+        return done
+
+
+def mesh_observe_prewarm_entry(b, n_rg: int, part: MeshPartitioner) -> tuple:
+    """Prewarm entry for the mesh observe jit at one window's grid
+    shape — the same kernel dummy args as the pool entry
+    (``device_pool.observe_dummy_args``, the single source of truth per
+    kernel signature), only the row count pads to the mesh width."""
+    import jax
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+    from adam_tpu.parallel.device_pool import observe_dummy_args
+
+    g = part.rows_for(grid_rows(b.n_rows))
+    gl = grid_cols(b.lmax)
+
+    def warm(_dev, g=g, gl=gl):
+        jax.block_until_ready(
+            part.observe_window(observe_dummy_args(b, g, gl), n_rg, gl)
+        )
+
+    return (("mesh.observe", g, gl, n_rg), warm)
+
+
+def mesh_markdup_prewarm_entry(b, part: MeshPartitioner) -> tuple:
+    """Prewarm entry for the mesh markdup-columns jit at one window's
+    grid shape (``device_pool.markdup_dummy_args``)."""
+    import jax
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+    from adam_tpu.parallel.device_pool import markdup_dummy_args
+
+    g = part.rows_for(grid_rows(b.n_rows))
+    gl = grid_cols(b.lmax)
+    gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
+
+    def warm(_dev, g=g, gl=gl, gc=gc):
+        jax.block_until_ready(
+            part.markdup_window(markdup_dummy_args(b, g, gl, gc))
+        )
+
+    return (("mesh.markdup", g, gc, gl), warm)
+
+
+def mesh_apply_prewarm_entry(b, n_rg: int, n_cyc: int,
+                             part: MeshPartitioner) -> tuple:
+    """Prewarm entry for the mesh apply jit keyed by the SOLVED table's
+    real cycle width (the pass-C re-warm, device_pool.apply_prewarm_entry
+    semantics; ``device_pool.apply_dummy_args``)."""
+    import jax
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+    from adam_tpu.parallel.device_pool import apply_dummy_args
+    from adam_tpu.pipelines.bqsr import N_DINUC, N_QUAL
+
+    g = part.rows_for(grid_rows(b.n_rows))
+    gl = grid_cols(b.lmax)
+
+    def warm(_dev, g=g, gl=gl):
+        tbl = part.put_replicated(
+            np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8)
+        )
+        jax.block_until_ready(
+            part.apply_window(apply_dummy_args(b, g, gl), tbl, gl)
+        )
+
+    return (("mesh.apply", g, gl, n_rg, n_cyc), warm)

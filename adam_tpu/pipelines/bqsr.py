@@ -303,7 +303,7 @@ class ObservationTable:
 
 def _observe_device(
     ds: AlignmentDataset, known_snps: Optional[SnpTable] = None,
-    backend: Optional[str] = None, device=None,
+    backend: Optional[str] = None, device=None, mesh=None,
 ):
     """Run the observation pass -> (total, mism, rg_names, lmax).
 
@@ -321,25 +321,32 @@ def _observe_device(
 
     ``device``: explicit jax device for the ``device`` backend's
     scatter-add (the multi-chip pool's round-robin target); ``None``
-    keeps the default device.  Downstream consumers dispatch on
+    keeps the default device.  ``mesh``: a
+    :class:`~adam_tpu.parallel.partitioner.MeshPartitioner` — the
+    window's [N, L] arrays shard over its ``batch`` axis, the
+    scatter-add runs per shard and the histograms ``psum`` on-device;
+    the returned (total, mism) are lazy *replicated* device arrays the
+    streamed pipeline folds into its device-resident accumulator
+    instead of fetching per window.  Downstream consumers dispatch on
     ``isinstance(total, np.ndarray)`` so each path stays on its side of
     the device link."""
     backend = bqsr_backend(backend)
     from adam_tpu.parallel.device_pool import span_attrs
 
     # span carries the resolved backend so device-vs-host attribution is
-    # visible per window in the flight recorder
-    attrs = span_attrs(device)
+    # visible per window in the flight recorder; mesh dispatches land on
+    # the collective "mesh" track (they occupy every device at once)
+    attrs = {"device": "mesh"} if mesh is not None else span_attrs(device)
     with _tele.TRACE.span(
         _tele.SPAN_BQSR_OBSERVE, backend=backend,
         reads=int(ds.batch.n_rows), **attrs,
     ):
-        return _observe_impl(ds, known_snps, backend, device)
+        return _observe_impl(ds, known_snps, backend, device, mesh)
 
 
 def _observe_impl(
     ds: AlignmentDataset, known_snps: Optional[SnpTable], backend: str,
-    device=None,
+    device=None, mesh=None,
 ):
     b = ds.batch.to_numpy()
     lmax = b.lmax
@@ -440,6 +447,35 @@ def _observe_impl(
                 t2[:, :, off : off + 2 * lmax + 1, :] = total
                 m2[:, :, off : off + 2 * lmax + 1, :] = mism
                 total, mism = t2, m2
+        elif mesh is not None:
+            from adam_tpu.utils import compile_ledger, faults
+            from adam_tpu.utils import retry as _retry
+
+            gm = mesh.rows_for(g)
+
+            def dispatch_mesh():
+                # the sharded placement + collective dispatch re-run as
+                # one idempotent unit, exactly like the pool path
+                faults.point("device.dispatch")
+                return mesh.observe_window((
+                    pad_rows_np(b.bases, gm, schema.BASE_PAD, cols=gl),
+                    pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=gl),
+                    pad_rows_np(b.lengths, gm, 0),
+                    pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
+                    pad_rows_np(b.read_group_idx, gm, -1),
+                    pad_rows_np(residue_ok, gm, False, cols=gl),
+                    pad_rows_np(is_mm, gm, False, cols=gl),
+                    pad_rows_np(read_ok, gm, False),
+                ), n_rg, gl)
+
+            # ledger key == the mesh prewarm entry key: an in-window
+            # miss here is a mesh prewarm coverage gap
+            with compile_ledger.track(
+                ("mesh.observe", gm, gl, n_rg), mesh.ledger_key()
+            ):
+                total, mism = _retry.retry_call(
+                    dispatch_mesh, site="bqsr.observe.dispatch"
+                )
         else:
             from adam_tpu.parallel.device_pool import putter
             from adam_tpu.utils import faults
@@ -720,7 +756,10 @@ def merge_observations(parts: list[tuple], replays=None,
     ``window_ids``: optional parallel list of true window indices for
     the span attribution — residual windows drop out of ``parts``, so
     the part position ``k`` is NOT the window index whenever any
-    window had zero valid rows.
+    window had zero valid rows.  A ``None`` entry marks a part with no
+    single source window (the mesh partitioner's fetched accumulator
+    sums many windows): ``on_part`` is skipped for it — a multi-window
+    histogram must never persist as one window's sidecar.
 
     ``on_part``: optional ``on_part(window, total, mism, g)`` callback
     invoked with each part's HOST-resident histogram as it merges
@@ -759,9 +798,9 @@ def merge_observations(parts: list[tuple], replays=None,
             tt, mm, g = replay(e)
             tt = np.asarray(tt)
             mm = np.asarray(mm)
-        if on_part is not None:
-            on_part(window_ids[k] if window_ids is not None else k,
-                    tt, mm, g)
+        win_id = window_ids[k] if window_ids is not None else k
+        if on_part is not None and win_id is not None:
+            on_part(win_id, tt, mm, g)
         off = gl - g
         total[:, :, off : off + 2 * g + 1, :] += tt
         mism[:, :, off : off + 2 * g + 1, :] += mm
@@ -808,7 +847,7 @@ def recalibrate_base_qualities(
 
 def apply_recalibration_dispatch(
     ds: AlignmentDataset, phred_table: np.ndarray, gl: int,
-    backend: Optional[str] = None, device=None,
+    backend: Optional[str] = None, device=None, mesh=None,
 ):
     """Start the per-residue table application for one window -> opaque
     handle for :func:`apply_recalibration_finish`.
@@ -820,22 +859,58 @@ def apply_recalibration_dispatch(
     commits the inputs to an explicit chip (multi-chip round-robin);
     ``phred_table`` may be a device-resident array (the pool replicates
     the solved table once per device instead of re-shipping it per
-    window).  The other backends compute eagerly and the handle is just
-    the result."""
+    window; under ``mesh`` it is the replicated placement from
+    ``MeshPartitioner.put_replicated`` — placed once, resident for the
+    whole pass).  The other backends compute eagerly and the handle is
+    just the result."""
     backend = bqsr_backend(backend)
     from adam_tpu.parallel.device_pool import span_attrs
 
+    attrs = {"device": "mesh"} if mesh is not None else span_attrs(device)
     with _tele.TRACE.span(
-        _tele.SPAN_BQSR_APPLY_DISPATCH, backend=backend,
-        **span_attrs(device),
+        _tele.SPAN_BQSR_APPLY_DISPATCH, backend=backend, **attrs,
     ):
-        return _apply_dispatch_impl(ds, phred_table, gl, backend, device)
+        return _apply_dispatch_impl(
+            ds, phred_table, gl, backend, device, mesh
+        )
 
 
 def _apply_dispatch_impl(
-    ds: AlignmentDataset, phred_table, gl: int, backend: str, device=None
+    ds: AlignmentDataset, phred_table, gl: int, backend: str, device=None,
+    mesh=None,
 ):
     b = ds.batch.to_numpy()
+    if backend == "device" and mesh is not None:
+        from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+        from adam_tpu.utils import compile_ledger, faults
+        from adam_tpu.utils import retry as _retry
+
+        n = b.n_rows
+        L = b.lmax
+        gm = mesh.rows_for(grid_rows(n))
+        glc = grid_cols(L)
+        n_rg = phred_table.shape[0]
+        n_cyc = phred_table.shape[2]
+
+        def dispatch_mesh():
+            faults.point("device.dispatch")
+            return mesh.apply_window((
+                pad_rows_np(b.bases, gm, schema.BASE_PAD, cols=glc),
+                pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=glc),
+                pad_rows_np(b.lengths, gm, 0),
+                pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
+                pad_rows_np(b.read_group_idx, gm, -1),
+                pad_rows_np(b.has_qual, gm, False),
+                pad_rows_np(b.valid, gm, False),
+            ), phred_table, glc)[:n, :L]
+
+        with compile_ledger.track(
+            ("mesh.apply", gm, glc, n_rg, n_cyc), mesh.ledger_key()
+        ):
+            new_dev = _retry.retry_call(
+                dispatch_mesh, site="bqsr.apply.dispatch"
+            )
+        return ds, b, new_dev
     if backend == "device":
         from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
 

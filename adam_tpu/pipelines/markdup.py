@@ -79,7 +79,7 @@ def get_columns_jit():
     return _COLUMNS_JIT
 
 
-def markdup_columns_dispatch(batch, device=None):
+def markdup_columns_dispatch(batch, device=None, mesh=None):
     """Dispatch the [N, L] markdup reductions on a device -> lazy
     (five, score) device arrays for the batch's real rows.
 
@@ -88,7 +88,10 @@ def markdup_columns_dispatch(batch, device=None):
     window i's columns are being fetched/summarized (double buffer).
     ``device``: an explicit jax device to commit the inputs to (the
     multi-chip pool's round-robin target); ``None`` keeps the default
-    device, exactly the single-chip behavior."""
+    device, exactly the single-chip behavior.  ``mesh``: a
+    :class:`~adam_tpu.parallel.partitioner.MeshPartitioner` — the
+    [N, L] arrays shard over its ``batch`` axis and every device works
+    the same window (SPMD), bitwise the single-chip columns."""
     jit = get_columns_jit()
 
     from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
@@ -98,7 +101,7 @@ def markdup_columns_dispatch(batch, device=None):
     from adam_tpu.utils import telemetry as _tele
 
     _put = putter(device)
-    attrs = span_attrs(device)
+    attrs = {"device": "mesh"} if mesh is not None else span_attrs(device)
     with _tele.TRACE.span(
         _tele.SPAN_MD_COLUMNS, backend="device",
         reads=int(batch.n_rows), **attrs,
@@ -112,6 +115,33 @@ def markdup_columns_dispatch(batch, device=None):
         # walks mask by lengths/cigar_n, so the padding lanes are inert)
         gl = grid_cols(b.lmax)
         gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
+
+        if mesh is not None:
+            from adam_tpu.utils import compile_ledger
+
+            gm = mesh.rows_for(g)
+
+            def dispatch_mesh():
+                faults.point("device.dispatch")
+                return mesh.markdup_window((
+                    pad_rows_np(b.start, gm, -1),
+                    pad_rows_np(b.end, gm, -1),
+                    pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
+                    pad_rows_np(b.cigar_ops, gm, schema.CIGAR_PAD,
+                                cols=gc),
+                    pad_rows_np(b.cigar_lens, gm, 0, cols=gc),
+                    pad_rows_np(b.cigar_n, gm, 0),
+                    pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=gl),
+                    pad_rows_np(b.lengths, gm, 0),
+                ))
+
+            with compile_ledger.track(
+                ("mesh.markdup", gm, gc, gl), mesh.ledger_key()
+            ):
+                five, score = _retry.retry_call(
+                    dispatch_mesh, site="markdup.dispatch"
+                )
+            return five[:n], score[:n]
 
         def dispatch():
             # the device_put + jit call is the RPC pair that fails
@@ -282,11 +312,23 @@ def _unique_inverse_fixed_bytes(names: np.ndarray) -> np.ndarray:
     return inv
 
 
-def resolve_duplicates(s: dict) -> np.ndarray:
+def resolve_duplicates(s: dict, sort_device=None,
+                       sort_info: dict | None = None) -> np.ndarray:
     """Global group-subgroup-argmax cascade over row summaries -> bool[N]
     duplicate mask.  One lexsort over the bucket table; row order across
     windows is the tie-break order, matching the reference's partition
-    concatenation."""
+    concatenation.
+
+    ``sort_device`` routes the 9-key lexsort cascade — the measured
+    1.56 s pure-host serial stage of the streamed barrier (BENCH_r05
+    ``resolve_s``) — through the device sort of the packed summary keys
+    (:func:`adam_tpu.parallel.dist.device_lexsort`; bitwise the host
+    permutation, host fallback on any failure).  ``None`` keeps the
+    host ``np.lexsort``; pass a jax device (the pool/mesh's device 0)
+    or the string ``"default"`` for the default device.  ``sort_info``
+    receives ``{"device_sort": bool}`` — whether the device sort
+    actually delivered (False on its internal host fallback), so the
+    caller's telemetry reports the outcome, not the request."""
     flags = s["flags"]
     valid = s["valid"]
     n = len(flags)
@@ -364,9 +406,19 @@ def resolve_duplicates(s: dict) -> np.ndarray:
     k1 = (bucket_lib << 2) | left_arr[:, 0]
     k3 = (left_arr[:, 2] << 3) | (left_arr[:, 3] << 2) | right_arr[:, 0]
     k5 = (right_arr[:, 2] << 1) | right_arr[:, 3]
-    group_order = np.lexsort(
-        (k5, right_arr[:, 1], k3, left_arr[:, 1], k1)
-    )
+    sort_keys = (k5, right_arr[:, 1], k3, left_arr[:, 1], k1)
+    if sort_device is not None:
+        from adam_tpu.parallel.dist import device_lexsort
+
+        group_order = device_lexsort(
+            sort_keys,
+            None if sort_device == "default" else sort_device,
+            info=sort_info,
+        )
+    else:
+        if sort_info is not None:
+            sort_info["device_sort"] = False
+        group_order = np.lexsort(sort_keys)
     go = group_order
     sl = np.concatenate([bucket_lib[go, None], left_arr[go]], axis=1)
     sr = right_arr[go]

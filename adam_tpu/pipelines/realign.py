@@ -779,6 +779,7 @@ def realign_indels(
     rng: Optional[random.Random] = None,
     target_mapping: str = "overlap",
     overlap_work=None,
+    sweep_devices=None,
 ) -> AlignmentDataset:
     """GATK-style local realignment (RealignIndels.scala:235-387).
 
@@ -800,7 +801,15 @@ def realign_indels(
     device queue drain) is reported back on the callable itself as
     ``overlap_ran_in_dispatch`` — the streamed pipeline's stage table
     only credits the overlap when it really happened (on the Python
-    fallback and the no-target early-outs the work runs serially)."""
+    fallback and the no-target early-outs the work runs serially).
+
+    ``sweep_devices``: explicit device set to fan the sweep GEMM
+    buckets across (the streamed pipeline passes its pool/mesh device
+    set) — chunks place round-robin weighted by
+    :class:`~adam_tpu.parallel.device_pool.SweepSchedule` (per-device
+    probe TFLOP/s pacing), instead of all landing on the default
+    device.  Placement never changes the sweep values, so the output
+    is bit-identical regardless of fan-out."""
     if overlap_work is not None:
         _orig_overlap = overlap_work
         _overlap_state = {"done": False}
@@ -823,6 +832,7 @@ def realign_indels(
             ds, consensus_model, known_indels, max_indel_size,
             max_consensus_number, lod_threshold, max_target_size, rng,
             target_mapping, overlap_work=overlap_work,
+            sweep_devices=sweep_devices,
         )
         if out is not None:
             return out
@@ -831,7 +841,7 @@ def realign_indels(
     return _realign_indels_py(
         ds, consensus_model, known_indels, max_indel_size,
         max_consensus_number, lod_threshold, max_target_size, sw_weights,
-        rng, target_mapping,
+        rng, target_mapping, sweep_devices=sweep_devices,
     )
 
 
@@ -846,6 +856,7 @@ def _realign_indels_py(
     sw_weights: tuple = (1.0, -0.333, -0.5, -0.5),
     rng: Optional[random.Random] = None,
     target_mapping: str = "overlap",
+    sweep_devices=None,
 ) -> AlignmentDataset:
     b = ds.batch.to_numpy()
     n = b.n_rows
@@ -950,6 +961,13 @@ def _realign_indels_py(
     _buckets: dict[tuple[int, int], dict] = {}
     _pending = []  # (chunk tasks, device (best_q, best_o))
     _remaining: dict[int, int] = {}  # target -> sweep results outstanding
+    # fan sweep chunks across the pool/mesh device set (probe-paced
+    # weighted round-robin); None = the default device, the old behavior
+    _sched = None
+    if sweep_devices is not None and len(sweep_devices) > 1:
+        from adam_tpu.parallel.device_pool import SweepSchedule
+
+        _sched = SweepSchedule(sweep_devices)
 
     def _flush_bucket(key) -> None:
         lr, lc = key
@@ -979,8 +997,9 @@ def _realign_indels_py(
         from adam_tpu.parallel.device_pool import putter as _putter
         from adam_tpu.utils import compile_ledger
 
-        _put = _putter()  # default device + h2d transfer accounting
-        with compile_ledger.track(("realign.sweep", ch, lr, nc, lc)):
+        dev = _sched.next_device() if _sched is not None else None
+        _put = _putter(dev)  # commit + h2d transfer accounting
+        with compile_ledger.track(("realign.sweep", ch, lr, nc, lc), dev):
             _pending.append((tasks, sweep_kernel_gather(
                 _put(rc), _put(rq), _put(rl),
                 _put(ct), _put(cl), _put(cidx), lr, lc,
@@ -1339,6 +1358,7 @@ def _realign_indels_native(
     rng: Optional[random.Random],
     target_mapping: str,
     overlap_work=None,
+    sweep_devices=None,
 ):
     """Same decisions as :func:`_realign_indels_py`, with the per-read
     host work (MD parse / reference rebuild / left-normalization /
@@ -1564,7 +1584,18 @@ def _realign_indels_native(
 
         # rows into the flat to_clean read index -> batch row, as i32
         r_row32 = r_row.astype(np.int32)
-        pending = []  # (pair slice indices, device (best_q, best_o))
+        pending = []  # (pair slice indices, device, lazy (best_q, best_o))
+        # fan GEMM chunks across the pool/mesh devices (probe-paced
+        # weighted round-robin, ROADMAP "realign sweep scheduling"):
+        # until now every bucket dispatched to the default device while
+        # the rest of the pool idled through the 1.31 s sweep net
+        from adam_tpu.parallel.device_pool import putter as _putter
+
+        _sched = None
+        if sweep_devices is not None and len(sweep_devices) > 1:
+            from adam_tpu.parallel.device_pool import SweepSchedule
+
+            _sched = SweepSchedule(sweep_devices)
         key = p_offb * 1024 + p_rt
         border = np.argsort(key, kind="stable")
         ukeys, ustarts = np.unique(key[border], return_index=True)
@@ -1598,9 +1629,11 @@ def _realign_indels_native(
                     cc = min(int(cons_lens[cid]), lc)
                     ct[j, :cc] = cons_mat[cid, :cc]
                     cl[j] = cons_lens[cid]
-                pending.append((part, sweep_gemm_kernel(
-                    jnp.asarray(rc), jnp.asarray(rq), jnp.asarray(rl),
-                    jnp.asarray(pm), jnp.asarray(ct), jnp.asarray(cl),
+                dev = _sched.next_device() if _sched is not None else None
+                _put = _putter(dev)  # commit + h2d transfer accounting
+                pending.append((part, dev, sweep_gemm_kernel(
+                    _put(rc), _put(rq), _put(rl),
+                    _put(pm), _put(ct), _put(cl),
                     off, rt, lr,
                 )))
 
@@ -1610,20 +1643,34 @@ def _realign_indels_native(
         _overlap_once(in_dispatch=bool(pending))
         _phase("Realign: overlapped host work")
         if pending:
-            # one fused fetch: per-chunk fetches each pay a tunnel
-            # round trip on the time-sliced chip
-            all_q = np.asarray(
-                jnp.concatenate([o[0].reshape(-1) for _, o in pending])
-            )
-            all_o = np.asarray(
-                jnp.concatenate([o[1].reshape(-1) for _, o in pending])
-            )
-            pos = 0
-            for part, out in pending:
-                Pc, rtc = out[0].shape
-                q2 = all_q[pos: pos + Pc * rtc].reshape(Pc, rtc)
-                o2 = all_o[pos: pos + Pc * rtc].reshape(Pc, rtc)
-                pos += Pc * rtc
+            # one fused fetch PER DEVICE: per-chunk fetches each pay a
+            # tunnel round trip on the time-sliced chip, and chunks
+            # committed to different pool devices cannot concatenate in
+            # one computation — so each device's chunks fuse into one
+            # drain through the transfer helper (d2h ledger + retry)
+            from adam_tpu.utils.transfer import device_fetch as _dfetch
+
+            groups: dict = {}
+            for k, (_part, dev, _out) in enumerate(pending):
+                gk = id(dev) if dev is not None else None
+                groups.setdefault(gk, []).append(k)
+            fetched_q: dict = {}
+            fetched_o: dict = {}
+            for idxs in groups.values():
+                gq = np.asarray(_dfetch(jnp.concatenate(
+                    [pending[k][2][0].reshape(-1) for k in idxs]
+                )))
+                go = np.asarray(_dfetch(jnp.concatenate(
+                    [pending[k][2][1].reshape(-1) for k in idxs]
+                )))
+                pos = 0
+                for k in idxs:
+                    Pc, rtc = pending[k][2][0].shape
+                    fetched_q[k] = gq[pos: pos + Pc * rtc].reshape(Pc, rtc)
+                    fetched_o[k] = go[pos: pos + Pc * rtc].reshape(Pc, rtc)
+                    pos += Pc * rtc
+            for k, (part, _dev, _out) in enumerate(pending):
+                q2, o2 = fetched_q[k], fetched_o[k]
                 for j, pi in enumerate(part):
                     nrt = int(p_n[pi])
                     rb = int(p_res[pi])

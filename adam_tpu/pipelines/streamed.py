@@ -231,6 +231,7 @@ def transform_streamed(
     max_target_size: int | None = None,
     dump_observations: Optional[str] = None,
     devices: Optional[int] = None,
+    partitioner: Optional[str] = None,
     progress: Optional[str] = None,
     run_dir: Optional[str] = None,
     resume: bool = False,
@@ -244,6 +245,18 @@ def transform_streamed(
     ``devices`` caps the device-pool fan-out (default: every attached
     device, or ``ADAM_TPU_DEVICES``); only the ``device`` backend uses
     it, and ``devices=1`` is exactly the single-chip path.
+
+    ``partitioner`` selects how device work places across those chips
+    (``--partitioner`` / ``ADAM_TPU_PARTITIONER``): ``"pool"`` (the
+    default) round-robins whole windows, ``"mesh"`` shards every
+    window's [N, L] arrays over a ``batch``
+    :class:`~jax.sharding.Mesh`, ``psum``s the pass-B observe
+    histograms on-device so barrier 2 fetches ONE merged table instead
+    of one per window, and keeps the solved recalibration table
+    device-resident through pass C.  Output is bit-identical across
+    modes; a mesh failure degrades to the pool path mid-run
+    (``device.mesh.degraded``), preserving the eviction/replay
+    contract (docs/ROBUSTNESS.md).
 
     ``progress`` names a live-heartbeat sink (``"stderr"`` or a file
     path; default: ``ADAM_TPU_PROGRESS``, off when unset): a daemon
@@ -279,7 +292,7 @@ def transform_streamed(
             max_consensus_number=max_consensus_number,
             lod_threshold=lod_threshold, max_target_size=max_target_size,
             dump_observations=dump_observations, devices=devices,
-            run_dir=run_dir, resume=resume,
+            partitioner=partitioner, run_dir=run_dir, resume=resume,
         )
     except BaseException:
         # crashed run: the final heartbeat line must carry ok=false —
@@ -314,9 +327,11 @@ def _transform_streamed_impl(
     max_target_size: int | None,
     dump_observations: Optional[str],
     devices: Optional[int],
+    partitioner: Optional[str],
     run_dir: Optional[str],
     resume: bool,
 ) -> dict:
+    from adam_tpu.parallel import partitioner as part_mod
     from adam_tpu.pipelines import bqsr as bqsr_mod
     from adam_tpu.pipelines import markdup as md_mod
     from adam_tpu.pipelines import realign as realign_mod
@@ -324,10 +339,6 @@ def _transform_streamed_impl(
     # live in-flight deques the heartbeat provider samples: (deque,
     # index of the device element in its items)
     hb_queues: list = []
-    if hb is not None:
-        hb.set_provider(
-            lambda: {"inflight_per_device": _inflight_per_device(hb_queues)}
-        )
     t_start_ns = time.monotonic_ns()
     stats: dict = {}
     # one backend decision for every per-residue pass in this run: the
@@ -345,12 +356,55 @@ def _transform_streamed_impl(
     stats["n_devices"] = dpool.n if dpool is not None else (
         1 if use_device else 0
     )
+    # execution partitioner (--partitioner / ADAM_TPU_PARTITIONER):
+    # "pool" round-robins whole windows; "mesh" shards every window
+    # over a batch Mesh spanning the same device set, psums the
+    # observe histograms on-device and keeps the solved table resident
+    # through pass C.  The pool stays constructed either way — it IS
+    # the degrade target when the mesh path fails mid-run.
+    exec_mode = part_mod.resolve_execution_mode(partitioner)
+    mesh_part = None
+    if use_device and exec_mode == "mesh":
+        try:
+            import jax
+
+            n_mesh = dp_mod.resolve_device_count(devices)
+            mesh_part = part_mod.MeshPartitioner(
+                jax.local_devices()[:n_mesh]
+            )
+        except Exception as e:
+            log.warning(
+                "mesh partitioner unavailable (%s); using the pool path",
+                e,
+            )
+    exec_state = {
+        "mesh": mesh_part,
+        "mode": "mesh" if mesh_part is not None else "pool",
+    }
+    stats["partitioner"] = exec_state["mode"]
+    # pass-B windows folded into the mesh's device-resident observe
+    # accumulator, kept referenced so a degrade can replay them through
+    # the pool/host path; the host-side merge lists live up here too so
+    # the degrade hook can append to them from any pass
+    mesh_obs: list = []
+    obs_parts: list = []
+    obs_replays: list = []
+    obs_windows: list = []
     if use_device:
         tr.gauge(tele.G_POOL_DEVICES, stats["n_devices"])
-    if hb is not None and dpool is not None:
+    if hb is not None:
         # HBM sampling keys must match the device=<k> span attribution,
-        # so the heartbeat polls exactly the pool's device set
-        hb.set_devices(dpool.devices)
+        # so the heartbeat polls exactly the run's device set
+        if mesh_part is not None:
+            hb.set_devices(mesh_part.devices)
+        elif dpool is not None:
+            hb.set_devices(dpool.devices)
+        hb.set_provider(lambda: {
+            "inflight_per_device": _inflight_per_device(hb_queues),
+            # live mode, not the launch mode: a degraded mesh run
+            # reports "pool" from its next beat on
+            "partitioner": exec_state["mode"] if use_device else None,
+        })
     os.makedirs(out_path, exist_ok=True)
     # purge a crashed run's staging dir: io/parquet publishes each part
     # by atomic rename out of out_path/_temporary, so a SIGKILL'd run
@@ -416,6 +470,39 @@ def _transform_streamed_impl(
                 return device_fn(dev)
             except Exception as e:
                 _evict_or_lose(dev, e)
+
+    def _mesh_degrade(exc, where: str = ""):
+        """A mesh collective failed past its retry budget: abandon the
+        mesh for the rest of the run (the accumulator on a dying device
+        set is no longer trustworthy) and fall back to the pool path —
+        bit-identical by the backend-parity contract.  Windows already
+        folded into the accumulator replay through the pool/host
+        observe under a ``device.pool.replay`` umbrella, so a dead
+        mesh costs the replayed windows, never the run."""
+        mp = exec_state["mesh"]
+        if mp is None:
+            return
+        exec_state["mesh"] = None
+        exec_state["mode"] = "pool"
+        stats["partitioner"] = "pool"
+        tr.count(tele.C_MESH_DEGRADED)
+        log.error(
+            "mesh partitioner failed%s (%s); degrading to the pool path"
+            "%s", f" at {where}" if where else "", exc,
+            (f" and replaying {len(mesh_obs)} accumulated window(s)"
+             if mesh_obs else ""),
+        )
+        mp.reset_accumulator()
+        if mesh_obs:
+            with tr.span(tele.SPAN_POOL_REPLAY, device="mesh"), \
+                    dp_mod.replay_scope():
+                for i, w in list(mesh_obs):
+                    got = _observe_window(i, w)
+                    if got is not None:
+                        obs_parts.append(got[0])
+                        obs_replays.append(got[1])
+                        obs_windows.append(i)
+            mesh_obs.clear()
     if known_indels is not None and consensus_model == "reads":
         # supplying known indels implies the knowns consensus model (the
         # reference's -known_indels flag semantics; realign_indels only
@@ -482,7 +569,19 @@ def _transform_streamed_impl(
     def _md_dispatch(win, batch):
         """Dispatch one window's [N, L] markdup reductions -> (device,
         lazy cols), walking to the next survivor after a spent retry
-        budget; None = compute the summary on the host instead."""
+        budget; None = compute the summary on the host instead.  Under
+        the mesh partitioner the window shards across every device at
+        once (device tag ``"mesh"``); a mesh failure degrades to the
+        pool path and re-dispatches here."""
+        mp = exec_state["mesh"]
+        if mp is not None:
+            try:
+                cols = md_mod.markdup_columns_dispatch(batch, mesh=mp)
+                tr.count(tele.C_DEVICE_DISPATCHED)
+                tr.count(tele.C_MESH_DISPATCHED)
+                return "mesh", cols
+            except Exception as e:
+                _mesh_degrade(e, "pass-A markdup")
 
         def on_device(dev):
             cols = md_mod.markdup_columns_dispatch(batch, device=dev)
@@ -499,12 +598,16 @@ def _transform_streamed_impl(
                     score = np.asarray(device_fetch(cols[1]))
             except Exception as e:
                 # fetch failed past the transfer layer's retry budget:
-                # evict the chip and replay the window's reductions on
-                # a survivor (the loop re-fetches), host when none left
+                # evict the chip (or abandon the mesh) and replay the
+                # window's reductions on what remains (the loop
+                # re-fetches), host when nothing is left
                 with tr.span(tele.SPAN_POOL_REPLAY, window=win,
                              **dp_mod.span_attrs(dev)), \
                         dp_mod.replay_scope():
-                    _evict_or_lose(dev, e)
+                    if dev == "mesh":
+                        _mesh_degrade(e, "pass-A markdup fetch")
+                    else:
+                        _evict_or_lose(dev, e)
                     nxt = _md_dispatch(win, ds.batch)
                 if nxt is None:
                     break
@@ -516,6 +619,96 @@ def _transform_streamed_impl(
             )
             return
         summaries.append(md_mod.row_summary(ds))
+
+    # ---- long-tail shape prewarm ---------------------------------------
+    # The window-0 prewarm covers only window 0's grid; residual windows
+    # (a short final ingest window drops to a smaller pow2 row grid) and
+    # the realigned tail part land on shapes it never saw and used to
+    # cold-compile INSIDE their window (the measured grid-1024 0.26 s
+    # `device.compile.in_window` entry, docs/PERF.md).  Re-prewarm on
+    # FIRST SIGHT of each new grid shape instead — the process-wide
+    # dedupe cache makes repeats (and warm bench runs) free.
+    seen_grid_shapes: set = set()
+
+    def _prewarm_window_shapes(ds):
+        mp = exec_state["mesh"]
+        if (mp is None and dpool is None) or res["device_lost"]:
+            return
+        b = ds.batch.to_numpy()
+        from adam_tpu.formats.batch import grid_cols, grid_rows
+
+        key = (
+            grid_rows(b.n_rows), grid_cols(b.lmax),
+            grid_cols(
+                b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1
+            ),
+            exec_state["mode"],
+        )
+        if key in seen_grid_shapes:
+            return
+        seen_grid_shapes.add(key)
+        n_rg = len(ds.read_groups) + 1
+        t_pw = time.monotonic_ns()
+        try:
+            if mp is not None:
+                entries = []
+                if mark_duplicates:
+                    entries.append(
+                        part_mod.mesh_markdup_prewarm_entry(b, mp)
+                    )
+                if recalibrate:
+                    entries.append(
+                        part_mod.mesh_observe_prewarm_entry(b, n_rg, mp)
+                    )
+                mp.prewarm(entries, tracer=tr)
+            else:
+                from adam_tpu.parallel.device_pool import (
+                    streamed_prewarm_entries,
+                )
+
+                dpool.prewarm(
+                    streamed_prewarm_entries(
+                        b, n_rg, mark_duplicates=mark_duplicates,
+                        recalibrate=recalibrate,
+                    ),
+                    tracer=tr,
+                )
+        finally:
+            # the umbrella records the WALL (the stats view subtracts
+            # it back out of the enclosing pass's row)
+            tr.add_span(
+                tele.SPAN_POOL_PREWARM, t_pw,
+                time.monotonic_ns() - t_pw,
+            )
+
+    def _prewarm_observe_shape(ds):
+        """Tail hook: warm the observe kernel at the REALIGNED part's
+        grid before its in-window dispatch (its row/lane grid rarely
+        matches any ingest window's)."""
+        mp = exec_state["mesh"]
+        if (
+            not recalibrate or res["device_lost"]
+            or (mp is None and dpool is None)
+        ):
+            return
+        b = ds.batch.to_numpy()
+        n_rg = len(ds.read_groups) + 1
+        t_pw = time.monotonic_ns()
+        try:
+            if mp is not None:
+                mp.prewarm(
+                    [part_mod.mesh_observe_prewarm_entry(b, n_rg, mp)],
+                    tracer=tr,
+                )
+            else:
+                dpool.prewarm(
+                    [dp_mod.observe_prewarm_entry(b, n_rg)], tracer=tr
+                )
+        finally:
+            tr.add_span(
+                tele.SPAN_POOL_PREWARM, t_pw,
+                time.monotonic_ns() - t_pw,
+            )
 
     # transfer-ledger pass attribution: every h2d put / d2h fetch on
     # this thread inside the scope lands under the pass's bucket in the
@@ -541,32 +734,14 @@ def _transform_streamed_impl(
                 tr.count(tele.C_WINDOWS_INGESTED)
                 # chaos-harness kill point: one arrival per pass-A window
                 faults.point("proc.kill", device="pass_a")
-                if dpool is not None and win == 0:
-                    # compile the grid-quantized kernel set once per
-                    # device, concurrently, BEFORE any window's device
-                    # work — a 20-40 s cold remote compile must never
-                    # serialize inside a window (process-wide cache:
-                    # warm runs skip this entirely).  The umbrella span
-                    # records the WALL (the concurrent per-compile
-                    # spans sum past it), and the stats view subtracts
-                    # it back out of pass A's row.
-                    from adam_tpu.parallel.device_pool import (
-                        streamed_prewarm_entries,
-                    )
-
-                    t_pw = time.monotonic_ns()
-                    dpool.prewarm(
-                        streamed_prewarm_entries(
-                            batch.to_numpy(), len(ds.read_groups) + 1,
-                            mark_duplicates=mark_duplicates,
-                            recalibrate=recalibrate,
-                        ),
-                        tracer=tr,
-                    )
-                    tr.add_span(
-                        tele.SPAN_POOL_PREWARM, t_pw,
-                        time.monotonic_ns() - t_pw,
-                    )
+                # compile the grid-quantized kernel set for this
+                # window's grid shape BEFORE its device work — a
+                # 20-40 s cold remote compile must never serialize
+                # inside a window.  First sight of each shape only
+                # (window 0 plus any residual-grid stragglers);
+                # process-wide cache makes warm runs a no-op.
+                if use_device:
+                    _prewarm_window_shapes(ds)
                 if mark_duplicates:
                     # dispatch window i's [N, L] key/score reductions
                     # (on device i % n under a pool), then drain the
@@ -630,9 +805,54 @@ def _transform_streamed_impl(
         return stats
 
     # ---- barrier 1: resolve duplicates + merge targets ----------------
-    with tr.span(tele.SPAN_RESOLVE):
+    def _resolve_sort_device():
+        """Where the duplicate-resolve lexsort runs: a pool/mesh device
+        (the packed summary keys sort on-chip via dist.device_lexsort,
+        bitwise the host permutation) or None for the host np.lexsort.
+        ``ADAM_TPU_RESOLVE_SORT={device,host}`` overrides the
+        device-when-available default."""
+        mode = os.environ.get("ADAM_TPU_RESOLVE_SORT", "").strip().lower()
+        if mode and mode not in ("device", "host"):
+            # the tuning-var contract every other ADAM_TPU_* knob keeps:
+            # a typo warns and degrades to the default, never silently
+            # does something else
+            log.warning(
+                "ADAM_TPU_RESOLVE_SORT=%r is not one of ('device', "
+                "'host'); using the device-when-available default", mode,
+            )
+            mode = ""
+        if mode == "host":
+            return None
+        if not use_device:
+            # explicit override only: host backends keep the host sort
+            # unless the operator asks for the default jax device
+            return "default" if mode == "device" else None
+        if res["device_lost"]:
+            return None
+        mp = exec_state["mesh"]
+        if mp is not None:
+            return mp.devices[0]
+        if dpool is not None:
+            alive = dpool.alive_devices()
+            return alive[0] if alive else None
+        return "default"
+
+    with tr.span(tele.SPAN_RESOLVE), tele.pass_scope("resolve"):
         if mark_duplicates and summaries:
-            dup = md_mod.resolve_duplicates(md_mod.concat_summaries(summaries))
+            sort_dev = _resolve_sort_device()
+            sort_info: dict = {}
+            dup = md_mod.resolve_duplicates(
+                md_mod.concat_summaries(summaries), sort_device=sort_dev,
+                sort_info=sort_info,
+            )
+            # gauge the OUTCOME, not the request: device_lexsort falls
+            # back to the host np.lexsort internally on failure, and the
+            # analyzer's "[device sort]" tag must never claim a win the
+            # host sort actually delivered
+            tr.gauge(
+                tele.G_RESOLVE_DEVICE_SORT,
+                1 if sort_info.get("device_sort") else 0,
+            )
             off = 0
             for i, w in enumerate(windows):
                 n = w.batch.n_rows
@@ -654,15 +874,12 @@ def _transform_streamed_impl(
         )
 
     # ---- pass B: candidate split (pre-BQSR, reference order) ----------
+    # (obs_parts/obs_replays/obs_windows — the host-side merge lists,
+    # window-index attributed — are defined up top so the mesh degrade
+    # hook can replay into them from any pass)
     with tr.span(tele.SPAN_SPLIT):
         candidates: list[AlignmentDataset] = []
         window_valid: list[int] = []
-        obs_parts = []
-        obs_replays = []
-        # true window index per part, for the barrier-2 fetch spans:
-        # residual windows drop out of obs_parts, so the part position
-        # is not the window index
-        obs_windows = []
         for i, w in enumerate(windows):
             n_valid = w.batch.n_rows
             if targets:
@@ -710,12 +927,16 @@ def _transform_streamed_impl(
         return replay
 
     def _observe_window(i, w):
-        """Observe one window -> ((total, mism, g), replay hook or
-        None), walking dispatch failures to the next survivor and to
-        the host backend when the pool is gone.  A histogram persisted
-        by a previous run (the barrier sidecars) loads instead of
-        recomputing — identical int64 sums, so the window-ordered merge
-        stays bit-identical."""
+        """Observe one window -> ((total, mism, g), replay hook) for
+        the host-side merge, or **None when the histograms were folded
+        into the mesh's device-resident accumulator** (nothing comes
+        home until barrier 2 fetches the one merged table).  Walks
+        dispatch failures to the next survivor and to the host backend
+        when the pool is gone; a mesh failure degrades to the pool path
+        and replays the accumulated windows.  A histogram persisted by
+        a previous run (the barrier sidecars) loads instead of
+        recomputing — identical int64 sums, so the merge stays
+        bit-identical."""
         if journal is not None and journal.resumed:
             got = journal.load_observation(i)
             if got is not None:
@@ -724,6 +945,21 @@ def _transform_streamed_impl(
                         got[2]), None
         if not use_device:
             return _observe_host(w), None
+        mp = exec_state["mesh"]
+        if mp is not None:
+            try:
+                with tele.pass_scope("observe"):
+                    total, mism, _rg, g = bqsr_mod._observe_device(
+                        w, known_snps, backend, mesh=mp
+                    )
+                    mp.accumulate(total, mism, g)
+                mesh_obs.append((i, w))
+                tr.count(tele.C_DEVICE_DISPATCHED)
+                tr.count(tele.C_MESH_DISPATCHED)
+                return None
+            except Exception as e:
+                _mesh_degrade(e, "pass-B observe")
+                # fall through: this window re-dispatches on the pool
 
         def on_device(dev):
             total, mism, _rg, g = bqsr_mod._observe_device(
@@ -752,15 +988,18 @@ def _transform_streamed_impl(
             if recalibrate:
                 for i, w in enumerate(windows):
                     if window_valid[i]:
-                        # round-robin: window i's scatter-add queues on
-                        # device i % n; the per-device histograms are
-                        # compact tables that merge host-side (in window
-                        # order) at the barrier — dist.distributed_observe's
-                        # psum shape, without needing a live mesh
-                        part, replay = _observe_window(i, w)
-                        obs_parts.append(part)
-                        obs_replays.append(replay)
-                        obs_windows.append(i)
+                        # pool: window i's scatter-add queues on device
+                        # i % n and its compact table merges host-side
+                        # at the barrier.  mesh: the window shards over
+                        # EVERY device, the histograms psum on-device
+                        # and fold into the device-resident accumulator
+                        # (_observe_window returns None) — barrier 2
+                        # fetches one merged table, not one per window.
+                        got = _observe_window(i, w)
+                        if got is not None:
+                            obs_parts.append(got[0])
+                            obs_replays.append(got[1])
+                            obs_windows.append(i)
 
     # ---- tail: realign the gathered candidates (observing remainders
     # under the device wait), then observe the realigned part with its
@@ -790,6 +1029,16 @@ def _transform_streamed_impl(
     if candidates and not skip_realign:
         cand = AlignmentDataset.concat(candidates)
         tr.count(tele.C_CANDIDATE_ROWS, int(cand.batch.n_rows))
+        # fan the sweep GEMM buckets across the run's device set
+        # (probe-paced weighted round-robin) instead of queueing them
+        # all on the default device while the rest of the pool idles
+        sweep_devs = None
+        if use_device and not res["device_lost"]:
+            if exec_state["mesh"] is not None:
+                sweep_devs = exec_state["mesh"].devices
+            elif dpool is not None:
+                alive = dpool.alive_devices()
+                sweep_devs = alive if len(alive) > 1 else None
         with tele.pass_scope("sweep"):
             # the sweep scope covers the realign GEMM dispatch+drain;
             # the overlapped observe pass shadows it with its own scope
@@ -802,12 +1051,17 @@ def _transform_streamed_impl(
                 lod_threshold=lod,
                 max_target_size=mts,
                 overlap_work=_observe_remainders,
+                sweep_devices=sweep_devs,
             )
         if recalibrate and realigned.batch.n_rows and resume_table is None:
-            part, replay = _observe_window(len(windows), realigned)
-            obs_parts.append(part)
-            obs_replays.append(replay)
-            obs_windows.append(len(windows))
+            # the realigned part's grid shape rarely matches any ingest
+            # window's: warm its observe kernel before the dispatch
+            _prewarm_observe_shape(realigned)
+            got = _observe_window(len(windows), realigned)
+            if got is not None:
+                obs_parts.append(got[0])
+                obs_replays.append(got[1])
+                obs_windows.append(len(windows))
         # subtract the observe wall from the tail ONLY when realign
         # reports it genuinely ran under the sweeps' device drain — on
         # the serial paths (Python fallback, no dispatched sweeps) the
@@ -842,6 +1096,8 @@ def _transform_streamed_impl(
     # ---- barrier 2: merge histograms, solve the table ------------------
     table = None
     gl = 0
+    _mp_b2 = exec_state["mesh"]
+    have_acc = _mp_b2 is not None and _mp_b2.has_accumulated()
     if resume_table is not None:
         # post-barrier-2 resume: the persisted table IS the barrier's
         # output (solved from the identical window histograms), so the
@@ -849,10 +1105,31 @@ def _transform_streamed_impl(
         table = np.ascontiguousarray(resume_table[0], np.uint8)
         gl = int(resume_table[1])
         tr.add_span(tele.SPAN_SOLVE, time.monotonic_ns(), 0)
-    elif recalibrate and obs_parts:
+    elif recalibrate and (obs_parts or have_acc):
         # chaos-harness kill point: barrier-2 entry (nothing persisted
         # yet — a resume replays every un-persisted observation)
         faults.point("proc.kill", device="barrier2")
+
+        if have_acc:
+            # THE mesh payoff: one compact merged table per distinct
+            # grid width comes home — not one fetched copy per window.
+            # A failed fetch degrades: the accumulated windows replay
+            # through the pool/host observe into obs_parts.
+            try:
+                with tele.pass_scope("observe"):
+                    acc_parts = exec_state["mesh"].fetch_accumulated(tr)
+                tr.count(tele.C_DEVICE_FETCHED, len(acc_parts))
+                mesh_obs.clear()
+                for tt, mm, g_acc in acc_parts:
+                    obs_parts.append(
+                        (np.asarray(tt), np.asarray(mm), int(g_acc))
+                    )
+                    obs_replays.append(None)
+                    # no single source window: the accumulator sums
+                    # many — None suppresses the per-window sidecar
+                    obs_windows.append(None)
+            except Exception as e:
+                _mesh_degrade(e, "barrier-2 accumulator fetch")
 
         def _persist_obs(win, tt, mm, g):
             # one atomic sidecar per window, written at the barrier as
@@ -963,6 +1240,247 @@ def _transform_streamed_impl(
         pool.submit(_part_path(out_path, idx), ds.batch, ds.sidecar,
                     ds.header)
 
+    def _apply_parts_mesh(plist):
+        """Mesh pass C: the solved table places ONCE, replicated, and
+        stays device-resident while every window's [N, L] gather shards
+        over the mesh (double-buffered: window j+1's collective runs
+        while window j fetches).  Returns the parts still to do — ``[]``
+        on success, or (on a mesh failure) the in-flight + undispatched
+        remainder for the pool path to finish, bit-identically."""
+        mp = exec_state["mesh"]
+        try:
+            tbl_dev = mp.put_replicated(
+                np.ascontiguousarray(table, np.uint8)
+            )
+            # re-warm the mesh apply against the SOLVED table's real
+            # width, one entry per distinct window grid shape (the
+            # pool path's apply_prewarm_entry semantics)
+            seen_dims = {}
+            for item in plist:
+                bw = item[1].batch
+                seen_dims.setdefault((bw.n_rows, bw.lmax), item[1])
+            t_pwc = time.monotonic_ns()
+            mp.prewarm(
+                [
+                    part_mod.mesh_apply_prewarm_entry(
+                        w.batch.to_numpy(), table.shape[0],
+                        table.shape[2], mp,
+                    )
+                    for w in seen_dims.values()
+                ],
+                tracer=tr,
+            )
+            tr.add_span(
+                tele.SPAN_POOL_PREWARM_C, t_pwc,
+                time.monotonic_ns() - t_pwc,
+            )
+        except Exception as e:
+            _mesh_degrade(e, "pass-C table placement")
+            rem = list(plist)
+            for j in range(len(plist)):
+                plist[j] = None  # only the handed-off list may pin
+            return rem
+        pend: deque = deque()
+        hb_queues.append((pend, 1))  # items: (idx, "mesh", handle)
+        k = 0
+
+        def _remainder(exc, where):
+            # hand the un-finished work to the pool: in-flight handles
+            # still carry their pre-recalibration datasets
+            _mesh_degrade(exc, where)
+            rem = [
+                (i, bqsr_mod.apply_handle_dataset(h))
+                for i, _tag, h in pend
+            ]
+            pend.clear()
+            rem.extend(p for p in plist[k:] if p is not None)
+            # the handed-off list must be the ONLY thing pinning the
+            # remaining windows: the pool loop frees rem entries as it
+            # dispatches, but the original parts list would keep every
+            # dataset resident through the rest of pass C
+            for j in range(len(plist)):
+                plist[j] = None
+            return rem
+
+        while k < len(plist) or pend:
+            # every device works each window, so the classic depth-2
+            # double buffer is the whole pipeline depth
+            if k < len(plist) and len(pend) < 2:
+                idx, w = plist[k]
+                try:
+                    with tr.span(
+                        tele.SPAN_APPLY_DISPATCH, window=idx,
+                        device="mesh",
+                    ):
+                        handle = bqsr_mod.apply_recalibration_dispatch(
+                            w, tbl_dev, gl, backend, mesh=mp
+                        )
+                except Exception as e:
+                    return _remainder(e, "pass-C apply dispatch")
+                tr.count(tele.C_DEVICE_DISPATCHED)
+                tr.count(tele.C_MESH_DISPATCHED)
+                pend.append((idx, "mesh", handle))
+                tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend))
+                plist[k] = None  # must not pin every window
+                k += 1
+                continue
+            p_idx, _tag, p_handle = pend[0]
+            try:
+                with tr.span(
+                    tele.SPAN_APPLY_FETCH, window=p_idx, device="mesh",
+                ):
+                    done = bqsr_mod.apply_recalibration_finish(p_handle)
+            except Exception as e:
+                return _remainder(e, "pass-C apply fetch")
+            pend.popleft()
+            tr.count(tele.C_DEVICE_FETCHED)
+            # OUTSIDE the mesh try blocks, like the pool path: a writer-
+            # pool fail-fast error is an output failure, not a mesh
+            # failure — it must abort the run with its own attribution,
+            # never trigger a degrade-and-replay
+            _submit(p_idx, done)
+            if p_idx < len(windows):
+                windows[p_idx] = None  # free as we go
+        return []
+
+    def _apply_parts_pool(plist):
+        # replicate the solved u8 table once per pool device
+        # (~4 MB each) instead of re-shipping it per window
+        dev_tables = None
+        if dpool is not None:
+            tbl_c = np.ascontiguousarray(table, np.uint8)
+            # replicas keyed by ORIGINAL pool index (stable
+            # under eviction); dead devices get no replica —
+            # _pick_device never hands them out.  Placed via
+            # putter so the per-device table replication shows
+            # up in the h2d transfer ledger.
+            alive_now = dpool.alive_devices()
+            dev_tables = [
+                dp_mod.putter(d)(tbl_c) if d in alive_now
+                else None
+                for d in dpool.devices
+            ]
+            # re-warm the apply gather against the SOLVED
+            # table's real width: merge_observations can widen
+            # the table past window 0's grid, which pass A's
+            # prewarm assumed — uniform-lmax inputs dedupe this
+            # to a no-op against the process-wide cache.  One
+            # entry per distinct window grid shape.
+            from adam_tpu.parallel.device_pool import (
+                apply_prewarm_entry,
+            )
+
+            seen_dims = {}
+            for item in plist:
+                bw = item[1].batch
+                seen_dims.setdefault(
+                    (bw.n_rows, bw.lmax), item[1]
+                )
+            t_pwc = time.monotonic_ns()
+            dpool.prewarm(
+                [
+                    apply_prewarm_entry(
+                        w.batch.to_numpy(), table.shape[0],
+                        table.shape[2],
+                    )
+                    for w in seen_dims.values()
+                ],
+                tracer=tr,
+            )
+            # umbrella wall for the re-warm: the stats view
+            # folds it into prewarm_s and subtracts it from
+            # apply_split_s, so compile time never shows up as
+            # host encode/submit time
+            tr.add_span(
+                tele.SPAN_POOL_PREWARM_C, t_pwc,
+                time.monotonic_ns() - t_pwc,
+            )
+        # in-flight queue of (part idx, device, handle): depth
+        # 2 single-device (the classic double buffer); with a
+        # pool a double buffer per device — window j+1's gather
+        # on chip B runs while window j fetches from chip A
+        apply_depth = 2 if dpool is None else 2 * dpool.n
+        pend_q: deque = deque()
+        hb_queues.append((pend_q, 1))  # items: (idx, dev, handle)
+
+        def _host_apply(w):
+            return bqsr_mod.apply_recalibration(
+                w, table, gl, _host_backend()
+            )
+
+        def _device_table(dev):
+            return (
+                table if dpool is None
+                else dev_tables[dpool.devices.index(dev)]
+            )
+
+        def _replay_apply(p_idx, dev, w, exc):
+            """Window p_idx's apply died on ``dev``: evict it
+            and re-run dispatch+fetch synchronously on a
+            survivor, host backend when none remain."""
+
+            def on_device(nd):
+                h = bqsr_mod.apply_recalibration_dispatch(
+                    w, _device_table(nd), gl, backend, device=nd
+                )
+                return bqsr_mod.apply_recalibration_finish(h)
+
+            with tr.span(tele.SPAN_POOL_REPLAY, window=p_idx,
+                         **dp_mod.span_attrs(dev)), \
+                    dp_mod.replay_scope():
+                _evict_or_lose(dev, exc)
+                return _on_survivors(
+                    p_idx, on_device, lambda: _host_apply(w)
+                )
+
+        def _fetch_one():
+            p_idx, p_dev, p_handle = pend_q.popleft()
+            attrs = dp_mod.span_attrs(p_dev)
+            try:
+                with tr.span(
+                    tele.SPAN_APPLY_FETCH, window=p_idx, **attrs
+                ):
+                    done = bqsr_mod.apply_recalibration_finish(
+                        p_handle
+                    )
+                tr.count(tele.C_DEVICE_FETCHED)
+            except Exception as e:
+                done = _replay_apply(
+                    p_idx, p_dev,
+                    bqsr_mod.apply_handle_dataset(p_handle), e,
+                )
+            _submit(p_idx, done)
+
+        for j in range(len(plist)):
+            idx, w = plist[j]
+            plist[j] = None  # the list must not pin every window
+
+            def _dispatch_one(dev, idx=idx, w=w):
+                with tr.span(
+                    tele.SPAN_APPLY_DISPATCH, window=idx,
+                    **dp_mod.span_attrs(dev),
+                ):
+                    handle = bqsr_mod.apply_recalibration_dispatch(
+                        w, _device_table(dev), gl, backend,
+                        device=dev,
+                    )
+                tr.count(tele.C_DEVICE_DISPATCHED)
+                return dev, handle
+
+            got = _on_survivors(j, _dispatch_one, lambda: None)
+            if got is None:  # device path lost: apply host-side
+                _submit(idx, _host_apply(w))
+            else:
+                pend_q.append((idx,) + got)
+                tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_q))
+            del w
+            if idx < len(windows):
+                windows[idx] = None  # free as we go
+            if len(pend_q) >= apply_depth:
+                _fetch_one()
+        while pend_q:
+            _fetch_one()
+
     try:
         # the span wraps apply+submit only; the device dispatch/fetch
         # walls inside it are their own DISJOINT child spans, so the
@@ -970,142 +1488,11 @@ def _transform_streamed_impl(
         # with them to the pass wall instead of double-counting it
         with tr.span(tele.SPAN_PASS_C), tele.pass_scope("apply"):
             if table is not None and use_device and not res["device_lost"]:
-                # replicate the solved u8 table once per pool device
-                # (~4 MB each) instead of re-shipping it per window
-                dev_tables = None
-                if dpool is not None:
-                    tbl_c = np.ascontiguousarray(table, np.uint8)
-                    # replicas keyed by ORIGINAL pool index (stable
-                    # under eviction); dead devices get no replica —
-                    # _pick_device never hands them out.  Placed via
-                    # putter so the per-device table replication shows
-                    # up in the h2d transfer ledger.
-                    alive_now = dpool.alive_devices()
-                    dev_tables = [
-                        dp_mod.putter(d)(tbl_c) if d in alive_now
-                        else None
-                        for d in dpool.devices
-                    ]
-                    # re-warm the apply gather against the SOLVED
-                    # table's real width: merge_observations can widen
-                    # the table past window 0's grid, which pass A's
-                    # prewarm assumed — uniform-lmax inputs dedupe this
-                    # to a no-op against the process-wide cache.  One
-                    # entry per distinct window grid shape.
-                    from adam_tpu.parallel.device_pool import (
-                        apply_prewarm_entry,
-                    )
-
-                    seen_dims = {}
-                    for item in parts:
-                        bw = item[1].batch
-                        seen_dims.setdefault(
-                            (bw.n_rows, bw.lmax), item[1]
-                        )
-                    t_pwc = time.monotonic_ns()
-                    dpool.prewarm(
-                        [
-                            apply_prewarm_entry(
-                                w.batch.to_numpy(), table.shape[0],
-                                table.shape[2],
-                            )
-                            for w in seen_dims.values()
-                        ],
-                        tracer=tr,
-                    )
-                    # umbrella wall for the re-warm: the stats view
-                    # folds it into prewarm_s and subtracts it from
-                    # apply_split_s, so compile time never shows up as
-                    # host encode/submit time
-                    tr.add_span(
-                        tele.SPAN_POOL_PREWARM_C, t_pwc,
-                        time.monotonic_ns() - t_pwc,
-                    )
-                # in-flight queue of (part idx, device, handle): depth
-                # 2 single-device (the classic double buffer); with a
-                # pool a double buffer per device — window j+1's gather
-                # on chip B runs while window j fetches from chip A
-                apply_depth = 2 if dpool is None else 2 * dpool.n
-                pend_q: deque = deque()
-                hb_queues.append((pend_q, 1))  # items: (idx, dev, handle)
-
-                def _host_apply(w):
-                    return bqsr_mod.apply_recalibration(
-                        w, table, gl, _host_backend()
-                    )
-
-                def _device_table(dev):
-                    return (
-                        table if dpool is None
-                        else dev_tables[dpool.devices.index(dev)]
-                    )
-
-                def _replay_apply(p_idx, dev, w, exc):
-                    """Window p_idx's apply died on ``dev``: evict it
-                    and re-run dispatch+fetch synchronously on a
-                    survivor, host backend when none remain."""
-
-                    def on_device(nd):
-                        h = bqsr_mod.apply_recalibration_dispatch(
-                            w, _device_table(nd), gl, backend, device=nd
-                        )
-                        return bqsr_mod.apply_recalibration_finish(h)
-
-                    with tr.span(tele.SPAN_POOL_REPLAY, window=p_idx,
-                                 **dp_mod.span_attrs(dev)), \
-                            dp_mod.replay_scope():
-                        _evict_or_lose(dev, exc)
-                        return _on_survivors(
-                            p_idx, on_device, lambda: _host_apply(w)
-                        )
-
-                def _fetch_one():
-                    p_idx, p_dev, p_handle = pend_q.popleft()
-                    attrs = dp_mod.span_attrs(p_dev)
-                    try:
-                        with tr.span(
-                            tele.SPAN_APPLY_FETCH, window=p_idx, **attrs
-                        ):
-                            done = bqsr_mod.apply_recalibration_finish(
-                                p_handle
-                            )
-                        tr.count(tele.C_DEVICE_FETCHED)
-                    except Exception as e:
-                        done = _replay_apply(
-                            p_idx, p_dev,
-                            bqsr_mod.apply_handle_dataset(p_handle), e,
-                        )
-                    _submit(p_idx, done)
-
-                for j in range(len(parts)):
-                    idx, w = parts[j]
-                    parts[j] = None  # the list must not pin every window
-
-                    def _dispatch_one(dev, idx=idx, w=w):
-                        with tr.span(
-                            tele.SPAN_APPLY_DISPATCH, window=idx,
-                            **dp_mod.span_attrs(dev),
-                        ):
-                            handle = bqsr_mod.apply_recalibration_dispatch(
-                                w, _device_table(dev), gl, backend,
-                                device=dev,
-                            )
-                        tr.count(tele.C_DEVICE_DISPATCHED)
-                        return dev, handle
-
-                    got = _on_survivors(j, _dispatch_one, lambda: None)
-                    if got is None:  # device path lost: apply host-side
-                        _submit(idx, _host_apply(w))
-                    else:
-                        pend_q.append((idx,) + got)
-                        tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_q))
-                    del w
-                    if idx < len(windows):
-                        windows[idx] = None  # free as we go
-                    if len(pend_q) >= apply_depth:
-                        _fetch_one()
-                while pend_q:
-                    _fetch_one()
+                todo = parts
+                if exec_state["mesh"] is not None:
+                    todo = _apply_parts_mesh(parts)
+                if todo:
+                    _apply_parts_pool(todo)
             else:
                 # host path — also the full-degradation path: with the
                 # device backend lost, the per-residue apply runs on

@@ -267,7 +267,8 @@ def _devices_from_snapshot(snap: dict, wall: Optional[float]) -> dict:
 # --------------------------------------------------------------------------
 # Barrier decomposition
 # --------------------------------------------------------------------------
-def _stage_decomposition(span_totals: dict, wall: Optional[float]) -> dict:
+def _stage_decomposition(span_totals: dict, wall: Optional[float],
+                         gauges: Optional[dict] = None) -> dict:
     out = {}
     for key, name in _STAGES:
         t = span_totals.get(name)
@@ -277,7 +278,30 @@ def _stage_decomposition(span_totals: dict, wall: Optional[float]) -> dict:
         if wall:
             row["frac"] = round(t / wall, 4)
         out[key] = row
+    # barrier-1 resolve: whether the duplicate-resolve lexsort ran as
+    # the device sort of the packed summary keys or on the host
+    g = (gauges or {}).get(tele.G_RESOLVE_DEVICE_SORT)
+    if g is not None and "barrier1_resolve" in out:
+        out["barrier1_resolve"]["sort"] = (
+            "device" if g.get("last") else "host"
+        )
     return out
+
+
+def _partitioner_mode(counters: dict, devices: dict) -> Optional[str]:
+    """The run's execution partitioner, derived from the ledger: mesh
+    collective dispatches present -> "mesh" ("mesh->pool" when the run
+    degraded mid-flight), device-attributed work without them ->
+    "pool", nothing device-attributed -> None."""
+    if counters.get(tele.C_MESH_DISPATCHED, 0) > 0:
+        if counters.get(tele.C_MESH_DEGRADED, 0) > 0:
+            return "mesh->pool"
+        return "mesh"
+    if counters.get(tele.C_MESH_DEGRADED, 0) > 0:
+        return "mesh->pool"
+    if devices:
+        return "pool"
+    return None
 
 
 # --------------------------------------------------------------------------
@@ -472,12 +496,16 @@ def analyze(doc: dict) -> dict:
         cpath = None  # aggregates carry no timestamps to chain
         hists = doc.get("histograms") or {}
     counters = doc.get("counters") or {}
+    gauges = doc.get("gauges") or {}
     report = {
         "kind": kind,
         "events_evicted": doc.get("events_evicted", 0) or 0,
         "wall_s": round(wall, 6) if wall is not None else None,
+        # execution mode ("pool" | "mesh" | "mesh->pool" for a run that
+        # degraded mid-flight; None = no device-attributed work)
+        "partitioner": _partitioner_mode(counters, devices),
         "devices": devices,
-        "stages": _stage_decomposition(totals, wall),
+        "stages": _stage_decomposition(totals, wall, gauges),
         "histograms": _hist_rows(hists),
         # the device ledger (both artifact kinds embed the sections):
         # tunnel byte accounting, compile-cache hit/miss + in-window
@@ -495,6 +523,7 @@ def analyze(doc: dict) -> dict:
                 tele.C_COMPILE_IN_WINDOW,
                 tele.C_RETRY_ATTEMPTS, tele.C_FAULT_INJECTED,
                 tele.C_DEVICE_EVICTED,
+                tele.C_MESH_DISPATCHED, tele.C_MESH_DEGRADED,
                 # resumed-vs-fresh window accounting (a resumed run's
                 # report must say how much work the journal spared)
                 tele.C_RESUME_WINDOWS_SKIPPED,
@@ -536,10 +565,18 @@ def render_report(report: dict) -> str:
     """The human-readable run report (``adam-tpu analyze`` stdout)."""
     out = []
     wall = report.get("wall_s")
+    part = report.get("partitioner")
     out.append(
         f"Run report ({report['kind']} mode) — wall {_fmt_s(wall)} s"
+        + (f" — partitioner {part}" if part else "")
     )
     out.append("=" * len(out[0]))
+    if part == "mesh->pool":
+        out.append(
+            "NOTE: the mesh partitioner degraded to the pool path "
+            "mid-run (device.mesh.degraded) — output stays bit-"
+            "identical; attribution mixes both modes"
+        )
     evicted = report.get("events_evicted")
     if evicted and report["kind"] == "trace":
         out += ["", f"WARNING: {evicted} oldest events were evicted from "
@@ -642,8 +679,10 @@ def render_report(report: dict) -> str:
         for key, row in stages.items():
             frac = row.get("frac")
             pct = f"  ({frac * 100:5.1f}%)" if frac is not None else ""
+            sort = row.get("sort")
+            tag = f"  [{sort} sort]" if sort else ""
             out.append(
-                f"  {key.ljust(w)}  {_fmt_s(row['total_s']):>9} s{pct}"
+                f"  {key.ljust(w)}  {_fmt_s(row['total_s']):>9} s{pct}{tag}"
             )
     cpath = report.get("critical_path")
     if cpath:

@@ -78,9 +78,13 @@ def device_cache_key(device) -> str:
     """The per-device half of the ledger key — device_pool's
     ``_device_key`` for explicit devices, ``"default"`` for the
     single-chip default-device path (no pool → no prewarm → its first
-    dispatch genuinely compiles in-window, and the ledger says so)."""
+    dispatch genuinely compiles in-window, and the ledger says so).
+    Strings pass through: the mesh partitioner's collective executables
+    are keyed per mesh width (``"mesh:<n>"``), not per member chip."""
     if device is None:
         return "default"
+    if isinstance(device, str):
+        return device
     from adam_tpu.parallel.device_pool import _device_key
 
     return _device_key(device)

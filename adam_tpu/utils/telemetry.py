@@ -178,12 +178,23 @@ C_DEVICE_EVICTED = _metric("device.evicted")
 C_RESUME_WINDOWS_SKIPPED = _metric("resume.windows_skipped")
 C_RESUME_HISTOGRAMS_LOADED = _metric("resume.histograms_loaded")
 C_RESUME_REFUSED = _metric("resume.refused")
+# mesh execution mode (--partitioner mesh; parallel/partitioner.py):
+# collective dispatches actually run on the batch mesh (observe/apply/
+# markdup windows), and degradations — a mesh failure that dropped the
+# run back to the pool path (windows folded into a suspect accumulator
+# replay through the pool/host observe, bit-identically)
+C_MESH_DISPATCHED = _metric("device.mesh.dispatched")
+C_MESH_DEGRADED = _metric("device.mesh.degraded")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
 G_DEVICE_INFLIGHT = _metric("device.dispatch.in_flight")
 G_OBSERVE_HIDDEN = _metric("streamed.observe_overlap_hidden")
 G_POOL_DEVICES = _metric("device.pool.devices")
+# 1 when the barrier-1 duplicate-resolve lexsort ran as the device sort
+# of the packed summary keys (parallel/dist.device_lexsort), 0 when it
+# ran host-side — `adam-tpu analyze` labels the resolve stage with it
+G_RESOLVE_DEVICE_SORT = _metric("streamed.resolve.device_sort")
 
 # ---- device ledger: tunnel byte accounting (utils/transfer.py +
 # parallel/device_pool.py).  Counters carry the run totals; the
@@ -226,6 +237,7 @@ DEVICE_ONLY_COUNTERS = frozenset({
     C_DEVICE_DISPATCHED, C_DEVICE_FETCHED, C_POOL_PREWARM_COMPILES,
     C_H2D_BYTES, C_D2H_BYTES,
     C_COMPILE_HITS, C_COMPILE_MISSES, C_COMPILE_IN_WINDOW,
+    C_MESH_DISPATCHED, C_MESH_DEGRADED,
 })
 DEVICE_ONLY_GAUGES = frozenset({G_DEVICE_INFLIGHT, G_POOL_DEVICES})
 DEVICE_ONLY_HISTOGRAMS = frozenset(
@@ -987,6 +999,7 @@ class Tracer:
             }
             hbm = {k: dict(v) for k, v in self._hbm.items()}
             counters = dict(self._counters)
+            gauges = {k: dict(v) for k, v in self._gauges.items()}
             n_rec = self._n_recorded
             n_ret = len(self._events)
         return {
@@ -1003,6 +1016,10 @@ class Tracer:
             "compiles": compiles,
             "hbm": hbm,
             "counters": counters,
+            # gauges ride along too: the analyzer labels the resolve
+            # stage (device vs host sort) and the execution mode off
+            # them, from either artifact kind
+            "gauges": gauges,
             "events_recorded": n_rec,
             "events_evicted": n_rec - n_ret,
         }
@@ -1210,10 +1227,11 @@ def merge_snapshots(snaps: list) -> dict:
 # Live progress heartbeat
 # --------------------------------------------------------------------------
 #: NDJSON schema tag every heartbeat line carries.  /2 added the
-#: device-ledger fields (tunnel bytes + HBM) — a /1 consumer keying on
-#: field NAMES keeps working (the /1 fields are a strict subset, same
-#: order); ``adam-tpu top`` accepts both.
-HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/2"
+#: device-ledger fields (tunnel bytes + HBM); /3 appended the
+#: ``partitioner`` execution-mode field — each older version's fields
+#: are a strict prefix of the next, so a consumer keying on field NAMES
+#: keeps working; ``adam-tpu top`` accepts all three.
+HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/3"
 
 #: THE heartbeat line field set — a stable contract (documented in
 #: docs/OBSERVABILITY.md, lint-enforced by scripts/check-telemetry-names):
@@ -1242,6 +1260,10 @@ HEARTBEAT_FIELDS = (
     "eta_s",
     "done",
     "ok",
+    # /3: the streamed execution mode ("pool" | "mesh"; a mesh run that
+    # degraded mid-flight flips to "pool" on its next beat) — appended
+    # LAST so the /2 fields stay a strict prefix
+    "partitioner",
 )
 
 _DEFAULT_HEARTBEAT_INTERVAL_S = 2.0
@@ -1590,6 +1612,9 @@ class Heartbeat:
             "eta_s": eta,
             "done": done,
             "ok": self._ok,
+            # overridden by the streamed provider with the live mode
+            # ("pool" | "mesh"); None = the producer predates /3 fields
+            "partitioner": None,
         }
         if self._provider is not None:
             try:

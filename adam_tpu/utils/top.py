@@ -10,7 +10,7 @@ already mid-flight, survives the heartbeat's size-capped rotation
 (``ADAM_TPU_PROGRESS_MAX_BYTES`` — a truncate-to-zero reads as a fresh
 file), tolerates a torn last line (only newline-terminated lines are
 parsed; the line-buffered writer makes tears transient), accepts both
-``adam_tpu.heartbeat/1`` and ``/2`` lines, and exits 0 when the stream
+``adam_tpu.heartbeat/1``, ``/2`` and ``/3`` lines, and exits 0 when the stream
 carries ``done=true`` (non-zero when that final line says ``ok=false``).
 
 Split renderer/follower so the dashboard is unit-testable without a
@@ -28,9 +28,11 @@ from typing import Optional
 
 from adam_tpu.utils.telemetry import format_bytes as _fmt_bytes
 
-#: Heartbeat schema tags this dashboard understands (missing /2 fields
-#: render as "-"; unknown future fields are ignored).
-ACCEPTED_SCHEMAS = ("adam_tpu.heartbeat/1", "adam_tpu.heartbeat/2")
+#: Heartbeat schema tags this dashboard understands (missing /2 / /3
+#: fields render as "-"; unknown future fields are ignored).
+ACCEPTED_SCHEMAS = (
+    "adam_tpu.heartbeat/1", "adam_tpu.heartbeat/2", "adam_tpu.heartbeat/3",
+)
 
 _CLEAR = "\x1b[H\x1b[2J"
 
@@ -90,11 +92,13 @@ def render_frame(line: dict, source: str = "") -> str:
     wt = line.get("windows_total")
     wi = line.get("windows_ingested", 0)
     frac = (wi / wt) if wt else None
+    mode = line.get("partitioner")
     out = [
         f"adam-tpu top — {source or 'heartbeat'}   "
         f"{line.get('schema', '?')}  seq {line.get('seq', '-')}",
         f"state    {state:<8} elapsed {_fmt_s(line.get('elapsed_s')):<9}"
-        f" eta {_fmt_s(line.get('eta_s'))}",
+        f" eta {_fmt_s(line.get('eta_s'))}"
+        + (f"   mode {mode}" if mode else ""),
         f"windows  {_bar(frac)} {wi}/{wt if wt is not None else '?'}"
         f"   resumed {line.get('windows_resumed', 0)}"
         f"   parts {line.get('parts_written', 0)}",

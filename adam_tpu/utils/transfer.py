@@ -116,12 +116,26 @@ def _map_daemon(fn, items: list) -> list:
 
 def _resident_device(x):
     """The device an array lives on (None when indeterminable) — the
-    fault point's ``device=K`` filter and eviction logs key on it."""
+    fault point's ``device=K`` filter and eviction logs key on it.
+    Arrays spanning SEVERAL devices (the mesh partitioner's sharded or
+    replicated outputs: ``x.device`` is a Sharding, not a Device)
+    return the string ``"mesh"`` — the same collective attribution
+    their dispatch spans carry."""
     try:
         d = getattr(x, "device", None)
         if d is not None and not callable(d):
+            if getattr(d, "id", None) is None:  # a Sharding object
+                devs = getattr(x, "devices", None)
+                ds = devs() if callable(devs) else set()
+                if len(ds) > 1:
+                    return "mesh"
+                if ds:
+                    return next(iter(ds))
             return d
-        return next(iter(x.devices()))
+        ds = x.devices()
+        if len(ds) > 1:
+            return "mesh"
+        return next(iter(ds))
     except Exception:
         return None
 

@@ -150,7 +150,7 @@ def _warmup_compiles(known) -> None:
         # per-device prewarm compiles so the timed windows never do
         transform_streamed(
             small, os.path.join(td, "w.adam"), known_snps=known,
-            devices=_DEVICES,
+            devices=_DEVICES, partitioner=_PARTITIONER,
         )
 
 
@@ -193,12 +193,16 @@ def _matmul_probe(reps: int = 10, device=None) -> float:
 #: --devices passthrough (None = all attached / ADAM_TPU_DEVICES).
 _DEVICES = None
 
+#: --partitioner passthrough (None = pool / ADAM_TPU_PARTITIONER).
+_PARTITIONER = None
+
 #: Zero-filled device leg: the CPU baseline records the SAME keys with
 #: empty/zero values so round-over-round artifact diffs stay key-stable.
 _NO_DEVICES = {
     "n_devices": 0,
     "devices_used": [],
     "per_device_probe_tflops": [],
+    "partitioner": None,
     "error": None,
 }
 
@@ -227,6 +231,7 @@ def _device_info(probe: bool = True) -> dict:
                 _matmul_probe(device=d) if probe else float("nan")
                 for d in devs
             ],
+            "partitioner": _PARTITIONER or "pool",
             "error": None,
         }
     except Exception as e:
@@ -319,7 +324,7 @@ def _run_streamed(known, trials: int = 1, probe: bool = True) -> dict:
             with tempfile.TemporaryDirectory() as td:
                 stats = transform_streamed(
                     _SYNTH, os.path.join(td, "out.adam"), known_snps=known,
-                    devices=_DEVICES,
+                    devices=_DEVICES, partitioner=_PARTITIONER,
                 )
         finally:
             tele.TRACE.recording = was_recording
@@ -756,9 +761,33 @@ def _parse_devices_arg(argv: list) -> None:
             return
 
 
+def _parse_partitioner_arg(argv: list) -> None:
+    """Consume ``--partitioner {pool,mesh}`` (the streamed execution
+    mode passthrough); invalid values are a usage error so the
+    artifact's ``partitioner`` key never mislabels the run."""
+    global _PARTITIONER
+    for i, a in enumerate(list(argv)):
+        if a == "--partitioner" or a.startswith("--partitioner="):
+            if a == "--partitioner":
+                val = argv[i + 1] if i + 1 < len(argv) else None
+                span = 2
+            else:
+                val = a.split("=", 1)[1]
+                span = 1
+            if val not in ("pool", "mesh"):
+                sys.exit(
+                    f"bench.py: --partitioner must be pool or mesh "
+                    f"(got {val!r})"
+                )
+            _PARTITIONER = val
+            del argv[i : i + span]
+            return
+
+
 if __name__ == "__main__":
     argv = sys.argv[1:]
     _parse_devices_arg(argv)
+    _parse_partitioner_arg(argv)
     if argv and argv[0] == "--cpu-child":
         _cpu_child()
         sys.exit(0)

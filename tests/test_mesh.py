@@ -1,0 +1,416 @@
+"""Mesh execution partitioner (--partitioner mesh): SPMD parity,
+on-device psum merge, degrade-to-pool fault matrix, the device resolve
+lexsort, long-tail re-prewarm, and sweep fan-out pacing.
+
+The 8 virtual CPU devices (tests/conftest.py) stand in for a multi-chip
+topology: the streamed flagship under ``--partitioner mesh`` must be
+**bit-identical** to the pool path and the host backends on 1, 2 and 8
+devices — the mesh only changes WHERE work runs (sharded collectives
+instead of per-window round-robin) and WHAT crosses the link at
+barrier 2 (one psum-merged table instead of per-window copies), never
+what is computed.  PR 4's eviction/replay matrix is the degrade
+contract: a mesh failure mid-run must fall back to the pool path with
+byte-identical output.
+"""
+
+import hashlib
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from adam_tpu.parallel import device_pool as dp
+from adam_tpu.parallel import partitioner as part_mod
+from adam_tpu.utils import telemetry as tele
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "tools")
+)
+
+
+def _sha_parts(d):
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(d, f), "rb").read()
+        ).hexdigest()
+        for f in os.listdir(d) if f.startswith("part-")
+    }
+
+
+# ---------------------------------------------------------------------------
+# Execution-mode resolution
+# ---------------------------------------------------------------------------
+def test_resolve_execution_mode(monkeypatch):
+    monkeypatch.delenv("ADAM_TPU_PARTITIONER", raising=False)
+    assert part_mod.resolve_execution_mode() == "pool"
+    assert part_mod.resolve_execution_mode("mesh") == "mesh"
+    monkeypatch.setenv("ADAM_TPU_PARTITIONER", "mesh")
+    assert part_mod.resolve_execution_mode() == "mesh"
+    # explicit arg beats env; malformed env degrades (warn + pool),
+    # malformed arg is a hard error (the CLI flag contract)
+    assert part_mod.resolve_execution_mode("pool") == "pool"
+    monkeypatch.setenv("ADAM_TPU_PARTITIONER", "bogus")
+    assert part_mod.resolve_execution_mode() == "pool"
+    with pytest.raises(ValueError, match="partitioner"):
+        part_mod.resolve_execution_mode("bogus")
+
+
+# ---------------------------------------------------------------------------
+# psum-merge associativity: on-device accumulation == window-order merge
+# ---------------------------------------------------------------------------
+def test_mesh_accumulator_matches_window_order_merge():
+    """The mesh accumulates (total, mism) in dispatch order on device;
+    the pool merges host-side in window order with centered gl padding.
+    Integer adds are exact, so ANY accumulation grouping must equal the
+    window-order merge bitwise — including mixed grid widths."""
+    import jax
+
+    from adam_tpu.pipelines.bqsr import merge_observations
+
+    rng = np.random.default_rng(7)
+    n_rg = 3
+    parts = []
+    for gl in (32, 64, 32, 64, 32):
+        shape = (n_rg, 94, 2 * gl + 1, 17)
+        parts.append((
+            rng.integers(0, 1 << 40, shape).astype(np.int64),
+            rng.integers(0, 1 << 40, shape).astype(np.int64),
+            gl,
+        ))
+    ref_t, ref_m, ref_gl = merge_observations([p for p in parts])
+
+    part = part_mod.MeshPartitioner(jax.devices()[:2])
+    order = [4, 1, 3, 0, 2]  # arbitrary accumulation order
+    for k in order:
+        t, m, gl = parts[k]
+        part.accumulate(jax.numpy.asarray(t), jax.numpy.asarray(m), gl)
+    fetched = part.fetch_accumulated(tele.Tracer(recording=False))
+    got_t, got_m, got_gl = merge_observations(
+        [(np.asarray(t), np.asarray(m), g) for t, m, g in fetched]
+    )
+    assert got_gl == ref_gl
+    np.testing.assert_array_equal(got_t, ref_t)
+    np.testing.assert_array_equal(got_m, ref_m)
+    assert not part.has_accumulated()  # fetch clears
+
+
+# ---------------------------------------------------------------------------
+# Device lexsort: bitwise np.lexsort, ties included
+# ---------------------------------------------------------------------------
+def test_device_lexsort_bit_parity():
+    from adam_tpu.parallel.dist import device_lexsort
+
+    rng = np.random.default_rng(11)
+    for n in (1, 3, 97, 4096, 5000):
+        # heavy ties (small ranges) exercise the stability contract
+        ks = tuple(
+            rng.integers(-4, 4, n).astype(np.int64) for _ in range(5)
+        )
+        np.testing.assert_array_equal(device_lexsort(ks), np.lexsort(ks))
+        # full-range keys (the unmapped-hash words)
+        lo, hi = np.iinfo(np.int64).min // 2, np.iinfo(np.int64).max // 2
+        ks2 = tuple(rng.integers(lo, hi, n) for _ in range(3))
+        np.testing.assert_array_equal(
+            device_lexsort(ks2), np.lexsort(ks2)
+        )
+
+
+def test_resolve_duplicates_device_sort_parity():
+    """resolve_duplicates with the device sort of the packed summary
+    keys marks exactly the rows the host lexsort marks."""
+    from adam_tpu.formats import schema
+    from adam_tpu.pipelines.markdup import resolve_duplicates
+
+    rng = np.random.default_rng(5)
+    n = 2000
+    flags = np.where(
+        rng.random(n) < 0.1, schema.FLAG_UNMAPPED, 0
+    ).astype(np.int32)
+    names = np.array(
+        [f"r{rng.integers(0, 700)}".encode() for _ in range(n)], "S12"
+    )
+    s = dict(
+        flags=flags,
+        valid=rng.random(n) < 0.98,
+        score=rng.integers(0, 3000, n).astype(np.int32),
+        row_key=np.stack([
+            np.where((flags & schema.FLAG_UNMAPPED) == 0, 1, 2),
+            rng.integers(0, 3, n),
+            rng.integers(0, 1000, n),
+            rng.integers(0, 2, n),
+        ], axis=1).astype(np.int64),
+        rg_idx=rng.integers(-1, 2, n).astype(np.int64),
+        lib_per_row=rng.integers(-1, 2, n).astype(np.int64),
+        name_bytes=names,
+    )
+    host = resolve_duplicates(s)
+    dev = resolve_duplicates(s, sort_device="default")
+    np.testing.assert_array_equal(host, dev)
+    assert host.any()  # a real workload, not a vacuous equality
+
+
+# ---------------------------------------------------------------------------
+# Streamed parity: mesh vs pool vs host on 1/2/8 virtual devices
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def mesh_runs(tmp_path_factory):
+    """One streamed run per (mode, device count) over the same input
+    (ragged last window + realign tail, so the long-tail prewarm paths
+    execute), each with its telemetry snapshot captured."""
+    from make_wgs_sam import make_wgs
+
+    from adam_tpu.pipelines.streamed import transform_streamed
+
+    d = tmp_path_factory.mktemp("mesh_parity")
+    path = str(d / "in.sam")
+    # 4500 reads / window 2048 -> grids 2048, 2048, 1024: the residual
+    # window exercises the re-prewarm; indels produce a realign tail
+    make_wgs(path, 4500, 100, n_contigs=2, contig_len=30_000,
+             indel_every=700, snp_every=400)
+    runs = {}
+    legs = [
+        ("host", None, None),
+        ("pool2", "pool", 2),
+        ("mesh1", "mesh", 1),
+        ("mesh2", "mesh", 2),
+        ("mesh8", "mesh", 8),
+    ]
+    for label, mode, n in legs:
+        out = str(d / f"out.{label}.adam")
+        csv = str(d / f"obs.{label}.csv")
+        if mode is not None:
+            os.environ["ADAM_TPU_BQSR_BACKEND"] = "device"
+        tele.TRACE.reset()
+        tele.TRACE.recording = True
+        try:
+            stats = transform_streamed(
+                path, out, window_reads=2048, devices=n,
+                partitioner=mode, dump_observations=csv,
+            )
+            snap = tele.TRACE.snapshot()
+        finally:
+            tele.TRACE.recording = False
+            os.environ.pop("ADAM_TPU_BQSR_BACKEND", None)
+        runs[label] = (out, csv, stats, snap)
+    return runs
+
+
+def test_mesh_parts_bit_identical_across_modes(mesh_runs):
+    ref = _sha_parts(mesh_runs["host"][0])
+    assert ref
+    for label in ("pool2", "mesh1", "mesh2", "mesh8"):
+        assert _sha_parts(mesh_runs[label][0]) == ref, label
+
+
+def test_mesh_observe_table_identical(mesh_runs):
+    """The merged observation table (the recalibration source of
+    truth): the on-device psum + accumulator path cannot drift from
+    the host window-order merge."""
+    ref = open(mesh_runs["host"][1]).read()
+    assert len(ref.splitlines()) > 1
+    for label in ("pool2", "mesh1", "mesh2", "mesh8"):
+        assert open(mesh_runs[label][1]).read() == ref, label
+
+
+def test_mesh_actually_ran_collectives(mesh_runs):
+    for label in ("mesh1", "mesh2", "mesh8"):
+        _out, _csv, stats, snap = mesh_runs[label]
+        assert stats["partitioner"] == "mesh", label
+        assert snap["counters"].get(tele.C_MESH_DISPATCHED, 0) > 0, label
+        assert snap["counters"].get(tele.C_MESH_DEGRADED, 0) == 0, label
+    assert mesh_runs["pool2"][3]["counters"].get(
+        tele.C_MESH_DISPATCHED, 0
+    ) == 0
+
+
+def test_mesh_barrier2_fetches_one_table_not_per_window(mesh_runs):
+    """THE tentpole claim, measured off the device ledger: the mesh
+    leg's observe-pass d2h bytes must undercut the pool leg's by at
+    least the window count's worth of per-window tables."""
+    def observe_d2h(snap):
+        total = 0
+        for _dev, per in (snap.get("transfers", {}).get("d2h") or {}).items():
+            e = per.get("observe")
+            if e:
+                total += e["bytes"]
+        return total
+
+    pool_b = observe_d2h(mesh_runs["pool2"][3])
+    mesh_b = observe_d2h(mesh_runs["mesh2"][3])
+    assert pool_b > 0 and mesh_b > 0
+    # 3 windows + realigned tail fetch per-window on the pool leg; the
+    # mesh fetches one merged pair per distinct grid width (2 here)
+    assert mesh_b * 2 <= pool_b, (pool_b, mesh_b)
+
+
+def test_clean_run_has_no_in_window_compiles(mesh_runs):
+    """Long-tail re-prewarm: the residual-window grid and the
+    realigned-tail observe must compile under a prewarm scope, leaving
+    the `device.compile.in_window` warning list empty."""
+    for label in ("pool2", "mesh2", "mesh8"):
+        snap = mesh_runs[label][3]
+        in_win = [
+            e for e in snap.get("compiles", {}).get("entries", [])
+            if e.get("in_window")
+        ]
+        assert snap["counters"].get(tele.C_COMPILE_IN_WINDOW, 0) == 0, (
+            label, in_win,
+        )
+
+
+def test_mesh_resolve_used_device_sort(mesh_runs):
+    snap = mesh_runs["mesh2"][3]
+    g = snap["gauges"].get(tele.G_RESOLVE_DEVICE_SORT)
+    assert g and g["last"] == 1
+    # and the host leg kept the host sort
+    g_host = mesh_runs["host"][3]["gauges"].get(tele.G_RESOLVE_DEVICE_SORT)
+    assert g_host is None or g_host["last"] == 0
+
+
+def test_analyzer_reports_mesh_mode(mesh_runs):
+    from adam_tpu.utils import analyzer
+
+    snap = mesh_runs["mesh2"][3]
+    report = analyzer.analyze(snap)
+    assert report["partitioner"] == "mesh"
+    assert report["stages"]["barrier1_resolve"]["sort"] == "device"
+    text = analyzer.render_report(report)
+    assert "partitioner mesh" in text and "[device sort]" in text
+    report_pool = analyzer.analyze(mesh_runs["pool2"][3])
+    assert report_pool["partitioner"] == "pool"
+
+
+# ---------------------------------------------------------------------------
+# Fault matrix under --partitioner mesh (the PR 4 contract)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("spec,expect_degrade", [
+    # transient faults: absorbed by the retry wrappers, mesh stays up
+    ("device.dispatch=transient,every=3", False),
+    # permanent faults mid-run: the mesh degrades to the pool, the pool
+    # evicts through to the host backend — output identical throughout
+    ("device.dispatch=permanent,after=6", True),
+])
+def test_mesh_fault_matrix_degrades_bit_identically(
+    mesh_runs, tmp_path, spec, expect_degrade, monkeypatch
+):
+    from adam_tpu.pipelines.streamed import transform_streamed
+    from adam_tpu.utils import faults
+
+    ref = _sha_parts(mesh_runs["host"][0])
+    src = mesh_runs["host"][0].replace("out.host.adam", "in.sam")
+    out = str(tmp_path / "faulted.adam")
+    monkeypatch.setenv("ADAM_TPU_BQSR_BACKEND", "device")
+    monkeypatch.setenv("ADAM_TPU_RETRY_BACKOFF_S", "0.001")
+    faults.install(spec)
+    tele.TRACE.reset()
+    tele.TRACE.recording = True
+    try:
+        stats = transform_streamed(
+            src, out, window_reads=2048, devices=2, partitioner="mesh"
+        )
+        snap = tele.TRACE.snapshot()
+    finally:
+        tele.TRACE.recording = False
+        faults.clear()
+    assert _sha_parts(out) == ref
+    assert snap["counters"].get(tele.C_FAULT_INJECTED, 0) > 0
+    degraded = snap["counters"].get(tele.C_MESH_DEGRADED, 0)
+    if expect_degrade:
+        assert degraded == 1 and stats["partitioner"] == "pool"
+    else:
+        assert degraded == 0 and stats["partitioner"] == "mesh"
+
+
+# ---------------------------------------------------------------------------
+# Sweep fan-out pacing
+# ---------------------------------------------------------------------------
+def test_sweep_schedule_deficit_round_robin():
+    devs = ["a", "b"]
+    # 3:1 weights -> 3 of every 4 chunks land on the fast device
+    sched = dp.SweepSchedule(devs, weights=[3.0, 1.0])
+    got = [sched.next_device() for _ in range(8)]
+    assert got.count("a") == 6 and got.count("b") == 2
+    # equal weights degrade to plain round-robin
+    sched = dp.SweepSchedule(devs, weights=[1.0, 1.0])
+    got = [sched.next_device() for _ in range(4)]
+    assert got == ["a", "b", "a", "b"]
+
+
+def test_sweep_weights_env_override(monkeypatch):
+    import jax
+
+    devs = jax.devices()[:3]
+    monkeypatch.setenv("ADAM_TPU_SWEEP_TFLOPS", "2.0,1.0")
+    w = dp.sweep_weights(devs)
+    assert w[0] == 2.0 and w[1] == 1.0 and w[2] == 1.5  # padded w/ mean
+    monkeypatch.setenv("ADAM_TPU_SWEEP_TFLOPS", "bogus")
+    assert dp.sweep_weights(devs) == [1.0] * 3
+    monkeypatch.delenv("ADAM_TPU_SWEEP_TFLOPS")
+    # virtual CPU devices are symmetric: no probe, equal weights
+    assert dp.sweep_weights(devs) == [1.0] * 3
+
+
+def test_realign_sweep_fans_out_bit_identically():
+    """realign_indels with sweep_devices fanned over 4 virtual chips
+    returns exactly the single-device result (placement never changes
+    the sweep values)."""
+    import jax
+
+    from make_wgs_sam import make_wgs
+
+    from adam_tpu.io import context
+    from adam_tpu.pipelines.realign import realign_indels
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "in.sam")
+        make_wgs(path, 1500, 100, n_contigs=1, contig_len=20_000,
+                 indel_every=600, snp_every=300)
+        ds = context.load_alignments(path)
+        one = realign_indels(ds)
+        fan = realign_indels(ds, sweep_devices=list(jax.devices()[:4]))
+    b1, b2 = one.batch.to_numpy(), fan.batch.to_numpy()
+    for f in ("start", "end", "mapq", "cigar_ops", "cigar_lens",
+              "cigar_n", "flags"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(b1, f)), np.asarray(getattr(b2, f)), f
+        )
+    assert list(one.sidecar.md) == list(fan.sidecar.md)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat surfaces the mode
+# ---------------------------------------------------------------------------
+def test_heartbeat_carries_partitioner_field(tmp_path, monkeypatch):
+    from make_wgs_sam import make_wgs
+
+    import json
+
+    from adam_tpu.pipelines.streamed import transform_streamed
+
+    path = str(tmp_path / "in.sam")
+    make_wgs(path, 1200, 100, n_contigs=1, contig_len=20_000)
+    hb_path = str(tmp_path / "hb.ndjson")
+    monkeypatch.setenv("ADAM_TPU_BQSR_BACKEND", "device")
+    monkeypatch.setenv("ADAM_TPU_PROGRESS_INTERVAL_S", "0.1")
+    transform_streamed(
+        path, str(tmp_path / "out.adam"), window_reads=1024, devices=2,
+        partitioner="mesh", progress=hb_path,
+    )
+    lines = [json.loads(l) for l in open(hb_path)]
+    assert lines
+    for l in lines:
+        assert tuple(l.keys()) == tele.HEARTBEAT_FIELDS
+        # the immediate first beat fires before the pipeline resolves
+        # its mode (provider not yet registered): None there, the live
+        # mode on every later line
+        assert l["partitioner"] in (None, "mesh")
+    assert lines[-1]["partitioner"] == "mesh"
+    assert lines[-1]["done"] is True and lines[-1]["ok"] is True
+    # adam-tpu top renders the mode
+    from adam_tpu.utils.top import render_frame
+
+    frame = render_frame(lines[-1])
+    assert "mode mesh" in frame
