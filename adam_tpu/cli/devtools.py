@@ -1,0 +1,36 @@
+"""Development / CI commands (no reference analog — this repo's
+contract tooling face, like ``analyze`` and ``top`` are its
+observability face)."""
+
+from __future__ import annotations
+
+import argparse
+
+from adam_tpu.cli.main import Command
+
+
+class Check(Command):
+    """``adam-tpu check`` — the AST-based contract checker
+    (adam_tpu/staticcheck; docs/STATIC_ANALYSIS.md).  Deliberately
+    importable without jax: CI gates on it before any device code
+    runs."""
+
+    name = "check"
+    description = ("Run the static contract checker (device-sync, "
+                   "compile-ledger, durability, fault-point and lock "
+                   "discipline)")
+
+    @classmethod
+    def configure(cls, parser: argparse.ArgumentParser) -> None:
+        from adam_tpu.staticcheck import cli as check_cli
+
+        check_cli.configure(parser)
+
+    @classmethod
+    def run(cls, args: argparse.Namespace) -> int:
+        from adam_tpu.staticcheck import cli as check_cli
+
+        return check_cli.run(args)
+
+
+COMMANDS = [Check]

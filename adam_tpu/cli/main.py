@@ -126,12 +126,13 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
 
 
 def command_groups():
-    from adam_tpu.cli import actions, conversions, printers
+    from adam_tpu.cli import actions, conversions, devtools, printers
 
     return [
         ("ADAM ACTIONS", actions.COMMANDS),
         ("CONVERSION OPERATIONS", conversions.COMMANDS),
         ("PRINT", printers.COMMANDS),
+        ("DEVELOPMENT", devtools.COMMANDS),
     ]
 
 

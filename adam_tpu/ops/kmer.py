@@ -22,6 +22,7 @@ import numpy as np
 from adam_tpu.formats import schema
 from adam_tpu.formats.batch import ReadBatch
 from adam_tpu.ops.phred import phred_to_success_probability
+from adam_tpu.utils.transfer import device_fetch
 
 MAX_PACKED_K = 21  # 3 bits/base in a signed i64
 
@@ -91,7 +92,7 @@ def histogram_to_dict(bases, lengths, valid, k: int) -> dict[str, int]:
         jnp.asarray(bases), jnp.asarray(lengths), jnp.asarray(valid), k
     )
     s, run_counts, is_head = (
-        np.asarray(s), np.asarray(run_counts), np.asarray(is_head),
+        device_fetch(s), device_fetch(run_counts), device_fetch(is_head),
     )
     return {
         unpack_kmer(int(key), k): int(v)
@@ -129,7 +130,7 @@ def count_qmers(batch: ReadBatch, k: int) -> dict[str, float]:
         return {}
     b = batch.to_device()
     keys, weights = device_qmer_weights(b.bases, b.quals, b.lengths, b.valid, k)
-    keys, weights = np.asarray(keys), np.asarray(weights)
+    keys, weights = device_fetch(keys), device_fetch(weights)
     order = np.argsort(keys, kind="stable")
     keys, weights = keys[order], weights[order]
     uniq, start_idx = np.unique(keys, return_index=True)

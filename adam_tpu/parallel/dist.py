@@ -30,6 +30,7 @@ from adam_tpu.formats.batch import ReadBatch
 from adam_tpu.ops import flagstat as fs
 from adam_tpu.ops import kmer as kmer_ops
 from adam_tpu.parallel.mesh import SHARD_AXIS, genome_mesh, shard_map
+from adam_tpu.utils.transfer import device_fetch
 
 
 def _row_specs(batch: ReadBatch):
@@ -201,11 +202,13 @@ def distributed_count_kmers(batch: ReadBatch, k: int, mesh=None) -> dict[str, in
     s, counts, heads, dropped = _distributed_kmers_jit(
         batch.bases, batch.lengths, batch.valid, k, mesh, cap
     )
-    if int(dropped) > 0:  # rare: pathological key skew
+    if int(device_fetch(dropped)) > 0:  # rare: pathological key skew
         s, counts, heads, dropped = _distributed_kmers_jit(
             batch.bases, batch.lengths, batch.valid, k, mesh, m
         )
-    s, counts, heads = np.asarray(s), np.asarray(counts), np.asarray(heads)
+    s, counts, heads = (
+        device_fetch(s), device_fetch(counts), device_fetch(heads)
+    )
     out: dict[str, int] = {}
     for d in range(s.shape[0]):
         keys = s[d][heads[d]]
@@ -264,7 +267,7 @@ def distributed_sort_keys(keys, mesh):
     m = int(np.prod(keys.shape)) // n_dev
     cap = min(m, 4 * m // n_dev + 64)
     out, dropped = _distributed_sort_jit(keys, mesh, cap)
-    if int(dropped) > 0:  # splitters degenerate (heavy key duplication)
+    if int(device_fetch(dropped)) > 0:  # degenerate splitters
         out, dropped = _distributed_sort_jit(keys, mesh, m)
     return out
 
@@ -439,9 +442,9 @@ def distributed_sort_rows(keys, payload, mesh):
     m = int(np.prod(np.shape(keys))) // n_dev
     cap = min(m, 4 * m // n_dev + 64)
     k, rows, dropped = _distributed_sort_rows_jit(keys, payload, mesh, cap)
-    if int(dropped) > 0:  # degenerate splitters: exact worst-case retry
+    if int(device_fetch(dropped)) > 0:  # degenerate splitters: retry exact
         k, rows, dropped = _distributed_sort_rows_jit(keys, payload, mesh, m)
-    valid = np.asarray(k) != np.iinfo(np.int64).max
+    valid = device_fetch(k) != np.iinfo(np.int64).max
     return k, rows, valid
 
 
@@ -489,8 +492,8 @@ def distributed_markdup(ds, mesh=None):
     five, score = _markdup_columns_jit(padded, mesh)
     s = md.row_summary(
         ds, b,
-        five_prime=np.asarray(five)[:n],
-        score=np.asarray(score)[:n],
+        five_prime=device_fetch(five)[:n],
+        score=device_fetch(score)[:n],
     )
     dup = md.resolve_duplicates(s)
     return ds.with_batch(

@@ -45,6 +45,7 @@ import time
 import numpy as np
 
 from adam_tpu.api.datasets import AlignmentDataset
+from adam_tpu.utils.transfer import device_fetch
 
 
 def transform_sharded(
@@ -262,7 +263,7 @@ def transform_sharded(
                         ds, known_snps
                     )
                     obs_parts.append(
-                        (np.asarray(total), np.asarray(mism), g)
+                        (device_fetch(total), device_fetch(mism), g)
                     )
             stats["observe_s"] = time.perf_counter() - t0
 
@@ -287,7 +288,7 @@ def transform_sharded(
                 total, mism, _rg, g = bqsr_mod._observe_device(
                     realigned, known_snps
                 )
-                obs_parts.append((np.asarray(total), np.asarray(mism), g))
+                obs_parts.append((device_fetch(total), device_fetch(mism), g))
         else:
             _observe_remainders()
         stats["realign_s"] = (

@@ -40,6 +40,7 @@ from adam_tpu.ops import cigar as cigar_ops
 from adam_tpu.ops.mdtag import batch_md_arrays
 from adam_tpu.ops.phred import PHRED_TO_ERROR
 from adam_tpu.utils import telemetry as _tele
+from adam_tpu.utils.transfer import device_fetch
 
 N_QUAL = 94  # valid phred range 0..93 (QualityScore.scala)
 N_DINUC = 17  # 16 (prev,cur) pairs + index 16 = None ("NN")
@@ -534,7 +535,9 @@ def build_observation_table(
     ds: AlignmentDataset, known_snps: Optional[SnpTable] = None
 ) -> ObservationTable:
     total, mism, rg_names, lmax = _observe_device(ds, known_snps)
-    return ObservationTable(np.asarray(total), np.asarray(mism), rg_names, lmax)
+    return ObservationTable(
+        device_fetch(total), device_fetch(mism), rg_names, lmax
+    )
 
 
 # --------------------------------------------------------------------------
@@ -812,7 +815,9 @@ def solve_recalibration_table(total, mism) -> np.ndarray:
     barrier step between the observe and apply passes)."""
     if isinstance(total, np.ndarray):
         return recalibration_phred_table_np(total, mism).astype(np.uint8)
-    return np.asarray(recalibration_phred_table(total, mism).astype(jnp.uint8))
+    # adam-tpu: noqa[dispatch-ledger] reason=once-per-run barrier solve on table shapes; a ledger key would demand a solved-width prewarm entry before the solve exists (ROADMAP device-resident windows item)
+    tbl = recalibration_phred_table(total, mism)
+    return device_fetch(tbl.astype(jnp.uint8))
 
 
 def dump_observation_csv(total, mism, rg_names, lmax, path) -> None:
@@ -833,7 +838,7 @@ def recalibrate_base_qualities(
     total, mism, rg_names, lmax = _observe_device(ds, known_snps, backend)
     if dump_observation_table:
         dump_observation_csv(
-            np.asarray(total), np.asarray(mism), rg_names, lmax,
+            device_fetch(total), device_fetch(mism), rg_names, lmax,
             dump_observation_table,
         )
     # the delta-stack table is built from the psum-able histograms, but

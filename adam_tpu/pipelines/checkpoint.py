@@ -313,7 +313,8 @@ class RunJournal:
             if not self.resumed:
                 self._count_refused()
         if not self.resumed:
-            self._start_fresh()
+            with self._lock:
+                self._start_fresh_locked()
 
     # ---- paths ---------------------------------------------------------
     @property
@@ -407,10 +408,12 @@ class RunJournal:
         self._n_windows = n_windows
         return True
 
-    def _start_fresh(self) -> None:
+    def _start_fresh_locked(self) -> None:
         """Discard every prior artifact — journal, sidecars, and the
         previously published parts (stale output from a different run
-        must never mix with this one's)."""
+        must never mix with this one's).  Caller holds ``self._lock``
+        (the journal can be rewound from ``confirm_plan`` while the
+        writer pool's ``record_window`` callbacks are live)."""
         self._windows = {}
         self._n_windows = None
         for p in (self._journal_path, self._table_path):
@@ -453,7 +456,7 @@ class RunJournal:
                 )
                 self.resumed = False
                 self._count_refused()
-                self._start_fresh()
+                self._start_fresh_locked()
             self._n_windows = n_windows
             self._flush_locked()
 

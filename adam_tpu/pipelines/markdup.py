@@ -34,6 +34,7 @@ from adam_tpu.api.datasets import AlignmentDataset
 from adam_tpu.formats import schema
 from adam_tpu.formats.strings import StringColumn
 from adam_tpu.ops import cigar as cigar_ops
+from adam_tpu.utils.transfer import device_fetch
 
 
 def markdup_columns_local(
@@ -175,7 +176,7 @@ def markdup_columns_device(batch):
     """Blocking variant of :func:`markdup_columns_dispatch` -> host
     (five i64[N], score i32[N])."""
     five, score = markdup_columns_dispatch(batch)
-    return np.asarray(five), np.asarray(score)
+    return device_fetch(five), device_fetch(score)
 
 
 def _sequence_hashes(bases: np.ndarray, lengths: np.ndarray) -> np.ndarray:

@@ -905,7 +905,7 @@ def _transform_streamed_impl(
         total, mism, _rg, g = bqsr_mod._observe_device(
             w, known_snps, _host_backend() if use_device else backend
         )
-        return np.asarray(total), np.asarray(mism), g
+        return device_fetch(total), device_fetch(mism), g
 
     def _obs_replay(i, w, dev):
         """Recovery hook for window i's barrier fetch: evict the chip

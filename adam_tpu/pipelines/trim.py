@@ -32,6 +32,7 @@ from adam_tpu.api.datasets import AlignmentDataset
 from adam_tpu.formats import schema
 from adam_tpu.formats.batch import ReadBatch
 from adam_tpu.ops import phred
+from adam_tpu.utils.transfer import device_fetch
 
 # ------------------------------------------------------------------ profile
 
@@ -64,7 +65,7 @@ def mean_quality_profile(batch: ReadBatch, n_rg: int):
     """Per-(rg, cycle) mean phred: successProbabilityToPhred(exp(sum/count))
     (TrimReads.scala:76-87)."""
     sums, counts = quality_profile_kernel(batch.to_device(), n_rg)
-    sums, counts = np.asarray(sums), np.asarray(counts)
+    sums, counts = device_fetch(sums), device_fetch(counts)
     means = np.full(sums.shape, -1, np.int64)
     nz = counts > 0
     succ = np.exp(sums[nz] / counts[nz])

@@ -1,0 +1,8 @@
+"""``python -m adam_tpu.staticcheck`` — the scripts/staticcheck face."""
+
+import sys
+
+from adam_tpu.staticcheck.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

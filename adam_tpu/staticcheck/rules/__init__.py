@@ -1,0 +1,10 @@
+"""Built-in rules — importing this package registers them all."""
+
+from adam_tpu.staticcheck.rules import (  # noqa: F401
+    dispatch,
+    durability,
+    faultpoints,
+    hostsync,
+    locks,
+    telemetry_names,
+)

@@ -1,0 +1,131 @@
+"""Shared AST helpers for the built-in rules."""
+
+from __future__ import annotations
+
+import ast
+
+
+def terminal_name(func) -> str:
+    """The rightmost name of a call target: ``f`` for ``f(...)``,
+    ``track`` for ``compile_ledger.track(...)``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def dotted_name(node) -> str:
+    """Best-effort dotted path (``jax.jit`` / ``np.asarray``); empty
+    for dynamic expressions."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_expr(node) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``partial(jax.jit, ...)`` /
+    ``functools.partial(jax.jit, ...)`` — the value side of a binding
+    that produces a jit-compiled callable."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    if d in ("jax.jit", "jit"):
+        return True
+    if d in ("partial", "functools.partial") and node.args:
+        return dotted_name(node.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+def is_jit_decorated(fn) -> bool:
+    """Function carries ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit,
+    ...)`` — its body is trace-time code, its NAME is a dispatchable."""
+    for dec in fn.decorator_list:
+        if dotted_name(dec) in ("jax.jit", "jit"):
+            return True
+        if _is_jit_expr(dec):
+            return True
+    return False
+
+
+def collect_jit_callables(tree) -> set:
+    """Names in this module that are jit-compiled callables: decorated
+    functions, plus any name bound to ``jax.jit(...)`` (e.g. the
+    module-level ``_COLUMNS_JIT``) or to a call of a ``*_jit`` factory
+    (the ``jit = get_columns_jit()`` idiom)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if is_jit_decorated(node):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            v = node.value
+            factory = (
+                isinstance(v, ast.Call)
+                and terminal_name(v.func).endswith("_jit")
+            )
+            if _is_jit_expr(v) or factory:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+#: Function-name patterns whose bodies are compile-forcing by design:
+#: prewarm entry thunks (executed under DevicePool.prewarm /
+#: MeshPartitioner.prewarm's own compile_ledger.track), TFLOP/s probes
+#: and micro-benchmarks.  block_until_ready and direct kernel calls
+#: there are the POINT, not hot-path drift.
+WARMUP_FN_PATTERNS = ("warm*", "*prewarm*", "*probe*", "*bench*")
+
+
+def in_warmup_function(ctx, node) -> bool:
+    """``node`` sits inside a function whose (or whose ancestor's) name
+    marks it as warm/probe/bench code."""
+    import fnmatch
+
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(fnmatch.fnmatchcase(anc.name, p)
+                   for p in WARMUP_FN_PATTERNS):
+                return True
+    return False
+
+
+def enclosing_function(ctx, node):
+    """The nearest enclosing FunctionDef (None at module level)."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def in_with_matching(ctx, node, match) -> bool:
+    """True when ``node`` sits lexically inside a ``with`` statement one
+    of whose context expressions satisfies ``match(expr)``."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if match(item.context_expr):
+                    return True
+    return False
+
+
+def name_contains_lock(node) -> bool:
+    """A ``with`` context expression that looks like a lock: a name or
+    attribute whose terminal name contains ``lock`` (``_LOCK``,
+    ``self._lock``, ``_PREWARM_LOCK``...), or a call on one
+    (``lk.acquire_timeout(...)`` style)."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    term = ""
+    if isinstance(node, ast.Name):
+        term = node.id
+    elif isinstance(node, ast.Attribute):
+        term = node.attr
+    return "lock" in term.lower()

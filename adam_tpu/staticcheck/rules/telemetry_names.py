@@ -1,0 +1,168 @@
+"""Rule ``telemetry-contract``: telemetry names and heartbeat fields
+are declared and documented.
+
+Absorbs ``scripts/check-telemetry-names`` (PR 2's grep lint) as a
+first-class staticcheck rule, AST-based instead of regex-based:
+
+* every string-literal name at a tracer call site
+  (``TRACE/tr/tracer .span/.count/.gauge/.observe/.add_span``) must be
+  declared in the ``adam_tpu/utils/telemetry.py`` registry — a renamed
+  or ad-hoc metric can't silently fork the contract;
+* every dotted registry name must appear in docs/OBSERVABILITY.md's
+  name contract (whole-token match, so a prefix can't ride on a longer
+  documented name);
+* every ``telemetry.HEARTBEAT_FIELDS`` member must appear in
+  docs/OBSERVABILITY.md's heartbeat schema.
+
+The declared-name set comes from a static parse of the registry module
+(``_span("...")``/``_metric("...")`` literal registrations and the
+``HEARTBEAT_FIELDS`` tuple); when the tree under check IS this repo,
+the imported registry is merged in as well, covering the handful of
+names registered through ``instrumentation`` constants in a loop.  The
+fault-point docs check that also lived in the old script now belongs
+to the ``fault-registry`` rule."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from adam_tpu.staticcheck.core import Finding, Rule, register
+from adam_tpu.staticcheck.rules._astutil import terminal_name
+
+REGISTRY_MODULE = "adam_tpu/utils/telemetry.py"
+DOC_FILE = "docs/OBSERVABILITY.md"
+
+_TRACER_RECEIVERS = frozenset({"TRACE", "tr", "tracer"})
+_TRACER_METHODS = frozenset({"span", "count", "gauge", "observe",
+                             "add_span"})
+
+
+def parse_registry(tree) -> tuple[set, tuple]:
+    """Static view of the registry: literal ``_span``/``_metric``
+    registrations + the HEARTBEAT_FIELDS literal tuple."""
+    declared: set[str] = set()
+    heartbeat: tuple = ()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and terminal_name(node.func) in (
+            "_span", "_metric"
+        ):
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                declared.add(node.args[0].value)
+        elif isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id == "HEARTBEAT_FIELDS"
+                   for t in node.targets):
+                v = node.value
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    heartbeat = tuple(
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    )
+    return declared, heartbeat
+
+
+def _whole_token(name: str, doc: str, charset: str = "a-z0-9_.") -> bool:
+    return bool(re.search(
+        rf"(?<![{charset}]){re.escape(name)}(?![{charset}])", doc
+    ))
+
+
+@register
+class TelemetryContractRule(Rule):
+    name = "telemetry-contract"
+    summary = ("undeclared telemetry names at tracer call sites; "
+               "registry names / heartbeat fields missing from "
+               "OBSERVABILITY.md")
+    contract = (
+        "Span/counter/gauge/histogram names used at call sites are "
+        "declared in utils/telemetry.py and documented in docs/"
+        "OBSERVABILITY.md, as are the heartbeat NDJSON fields — the "
+        "stable consumer contract (docs/OBSERVABILITY.md)."
+    )
+
+    def __init__(self, declared=None, heartbeat_fields=None):
+        # injectable for fixture tests; resolved lazily otherwise
+        self._declared = set(declared) if declared is not None else None
+        self._heartbeat = (tuple(heartbeat_fields)
+                           if heartbeat_fields is not None else None)
+        self._sites: list = []  # (name, relpath, line, col, snippet)
+
+    def visit(self, ctx):
+        if ctx.relpath == REGISTRY_MODULE:
+            return ()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _TRACER_METHODS):
+                continue
+            recv = terminal_name(f.value)
+            if recv not in _TRACER_RECEIVERS:
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                self._sites.append((
+                    node.args[0].value, ctx.relpath, node.lineno,
+                    node.col_offset, ctx.line_text(node.lineno),
+                ))
+        return ()
+
+    def _resolve_registry(self, project) -> tuple[set, tuple]:
+        if self._declared is not None:
+            return self._declared, self._heartbeat or ()
+        tree = project.parse_module(REGISTRY_MODULE)
+        if tree is None:
+            return set(), ()
+        declared, heartbeat = parse_registry(tree)
+        # checking this very repo: merge the imported registry, which
+        # also holds the loop-registered instrumentation timer names
+        try:
+            import adam_tpu.utils.telemetry as tele
+
+            pkg_file = os.path.abspath(tele.__file__)
+            if pkg_file == os.path.abspath(
+                os.path.join(project.root, REGISTRY_MODULE)
+            ):
+                declared |= set(tele.registered_names())
+                heartbeat = tuple(tele.HEARTBEAT_FIELDS)
+        except Exception:
+            pass
+        return declared, heartbeat
+
+    def finalize(self, project):
+        declared, heartbeat = self._resolve_registry(project)
+        if not declared:
+            return  # no registry in this tree: nothing to lint against
+        for name, path, line, col, snippet in self._sites:
+            if name not in declared:
+                yield Finding(
+                    self.name, path, line, col,
+                    f"undeclared telemetry name {name!r} — declare it "
+                    "in adam_tpu/utils/telemetry.py (and docs/"
+                    "OBSERVABILITY.md) or use a declared one",
+                    snippet,
+                )
+        doc = project.read_doc(DOC_FILE)
+        if doc is None:
+            return
+        for name in sorted(declared):
+            if re.fullmatch(r"[a-z0-9_.]+", name) and "." in name and \
+                    not _whole_token(name, doc):
+                yield Finding(
+                    self.name, REGISTRY_MODULE, 1, 0,
+                    f"registry name '{name}' missing from {DOC_FILE}'s "
+                    "name contract",
+                    "",
+                )
+        for fld in heartbeat:
+            if not _whole_token(fld, doc, charset="a-zA-Z0-9_"):
+                yield Finding(
+                    self.name, REGISTRY_MODULE, 1, 0,
+                    f"heartbeat field '{fld}' missing from {DOC_FILE}'s "
+                    "heartbeat schema",
+                    "",
+                )

@@ -17,8 +17,9 @@ def run_cli(*argv):
 def test_registry_matches_reference():
     """Same command names as ADAMMain.scala:30-72, plus this repo's
     observability extensions (``analyze`` — the post-hoc run report —
-    and ``top`` — the live heartbeat dashboard; neither has a
-    reference analog)."""
+    and ``top`` — the live heartbeat dashboard) and the contract
+    tooling (``check`` — the static analyzer, docs/STATIC_ANALYSIS.md);
+    none has a reference analog."""
     names = {c.name for _, cmds in command_groups() for c in cmds}
     assert names == {
         "depth", "count_kmers", "count_contig_kmers", "transform",
@@ -27,7 +28,7 @@ def test_registry_matches_reference():
         "features2adam", "wigfix2bed",
         "print", "print_genes", "flagstat", "print_tags", "listdict",
         "allelecount", "buildinfo", "view",
-        "analyze", "top",
+        "analyze", "top", "check",
     }
 
 

@@ -1,0 +1,753 @@
+"""Static contract checker tests (adam_tpu/staticcheck).
+
+Three layers: engine mechanics (suppressions, baseline round-trip,
+exit codes, JSON schema), per-rule fixture snippets (each rule must
+catch its seeded violation and stay quiet on the compliant twin), and
+the clean-repo gate (the real tree reports zero new findings and every
+baseline entry is justified — the acceptance bar of ISSUE 9)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from adam_tpu.staticcheck import core
+
+
+def _write(root, relpath, src):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # @NOQA@ keeps suppression directives out of THIS file's lines (the
+    # checker line-scans the real test tree for directives)
+    src = textwrap.dedent(src).replace("@NOQA@", "adam-tpu: noqa")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(src)
+    return path
+
+
+#: durability's scope is an explicit file list — fixtures must land on it
+DURABLE_FILE = "adam_tpu/pipelines/checkpoint.py"
+
+
+def _run(root, rules, baseline=None, update=False):
+    return core.run_checks(
+        str(root), rule_names=rules,
+        baseline_path=baseline or os.path.join(str(root), "bl.json"),
+        update_baseline=update,
+    )
+
+
+def _new(report, rule=None):
+    return [e for e in report.new_findings
+            if rule is None or e["rule"] == rule]
+
+
+# -------------------------------------------------------------------------
+# engine
+# -------------------------------------------------------------------------
+def test_suppression_requires_reason(tmp_path):
+    _write(tmp_path, DURABLE_FILE, """\
+        import os
+        def f(path):
+            os.replace(path, path + ".pub")  # @NOQA@[durability]
+    """)
+    rep = _run(tmp_path, ["durability"])
+    # the durability finding is NOT silenced (no reason) and the
+    # directive itself is reported
+    rules = sorted(e["rule"] for e in rep.new_findings)
+    assert rules == ["durability", "suppression"]
+    assert not rep.ok
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    _write(tmp_path, DURABLE_FILE, """\
+        import os
+        def f(path):
+            os.replace(path, path + ".pub")  # @NOQA@[durability] reason=unit fixture
+    """)
+    rep = _run(tmp_path, ["durability"])
+    assert rep.ok
+    assert rep.counts()["suppressed"] == 1
+
+
+def test_suppression_on_preceding_comment_line(tmp_path):
+    _write(tmp_path, DURABLE_FILE, """\
+        import os
+        def f(path):
+            # @NOQA@[durability] reason=publish is fsynced by the caller
+            os.replace(path, path + ".pub")
+    """)
+    rep = _run(tmp_path, ["durability"])
+    assert rep.ok and rep.counts()["suppressed"] == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    rel = DURABLE_FILE
+    _write(tmp_path, rel, """\
+        import os
+        def f(path):
+            os.replace(path, path + ".pub")
+    """)
+    bl = os.path.join(str(tmp_path), "bl.json")
+    # 1. finding is new
+    rep = _run(tmp_path, ["durability"], baseline=bl)
+    assert len(_new(rep, "durability")) == 1
+    # 2. update writes the baseline; entry still fails (no reason yet)
+    rep = _run(tmp_path, ["durability"], baseline=bl, update=True)
+    entries = core.load_baseline(bl)
+    assert len(entries) == 1
+    rep = _run(tmp_path, ["durability"], baseline=bl)
+    assert not rep.ok  # unjustified baseline entry
+    # 3. justify -> clean, reported as baselined
+    (fp, e), = entries.items()
+    e["reason"] = "triaged in the unit fixture"
+    core.write_baseline(bl, [e])
+    rep = _run(tmp_path, ["durability"], baseline=bl)
+    assert rep.ok and rep.counts()["baselined"] == 1
+    # 4. fix the code -> the entry is stale and fails the run
+    _write(tmp_path, rel, "def f(path):\n    return path\n")
+    rep = _run(tmp_path, ["durability"], baseline=bl)
+    assert not rep.ok
+    assert any(x["rule"] == "baseline" for x in rep.new_findings)
+
+
+def test_baseline_subset_run_keeps_other_rules(tmp_path):
+    """A --rules subset run must neither condemn nor drop baseline
+    entries belonging to the rules that did not run."""
+    bl = os.path.join(str(tmp_path), "bl.json")
+    core.write_baseline(bl, [{
+        "fingerprint": "0" * 16, "rule": "host-sync",
+        "path": "adam_tpu/pipelines/y.py", "line": 1, "snippet": "x",
+        "reason": "belongs to a rule not in this run",
+    }])
+    _write(tmp_path, "adam_tpu/pipelines/x.py", "VALUE = 1\n")
+    rep = _run(tmp_path, ["durability"], baseline=bl)
+    assert rep.ok, rep.new_findings
+    rep = _run(tmp_path, ["durability"], baseline=bl, update=True)
+    assert "0" * 16 in core.load_baseline(bl)
+
+
+def test_suppressing_a_baselined_finding_is_not_stale(tmp_path):
+    """Adding a noqa to a line whose finding is baselined must not
+    report the baseline entry as stale — the finding still exists."""
+    rel = DURABLE_FILE
+    _write(tmp_path, rel, """\
+        import os
+        def f(path):
+            os.replace(path, path + ".pub")
+    """)
+    bl = os.path.join(str(tmp_path), "bl.json")
+    _run(tmp_path, ["durability"], baseline=bl, update=True)
+    entries = list(core.load_baseline(bl).values())
+    entries[0]["reason"] = "triaged"
+    core.write_baseline(bl, entries)
+    # preceding-line directive: the flagged line's text (the
+    # fingerprint anchor) is unchanged, so the entry must match — as
+    # suppressed — rather than read as stale
+    _write(tmp_path, rel, """\
+        import os
+        def f(path):
+            # @NOQA@[durability] reason=now suppressed in place
+            os.replace(path, path + ".pub")
+    """)
+    rep = _run(tmp_path, ["durability"], baseline=bl)
+    assert not any(e["rule"] == "baseline" for e in rep.new_findings), \
+        rep.new_findings
+    assert rep.ok
+
+
+def test_unused_suppression_reported(tmp_path):
+    _write(tmp_path, DURABLE_FILE, """\
+        import os
+        def f(path):
+            return path  # @NOQA@[durability] reason=nothing fires here anymore
+    """)
+    rep = _run(tmp_path, ["durability"])
+    assert not rep.ok
+    assert any(e["rule"] == "suppression"
+               and "unused suppression" in e["message"]
+               for e in rep.new_findings)
+    # but a subset run for a DIFFERENT rule must not condemn it
+    rep = _run(tmp_path, ["fault-registry"])
+    assert not any("unused suppression" in e["message"]
+                   for e in rep.new_findings)
+    # and --update-baseline must not absorb suppression-hygiene
+    # findings into the baseline (they are fixed in place)
+    bl = os.path.join(str(tmp_path), "bl.json")
+    _run(tmp_path, ["durability"], baseline=bl, update=True)
+    assert core.load_baseline(bl) == {}
+
+
+def test_json_schema_and_exit_codes(tmp_path):
+    _write(tmp_path, "adam_tpu/pipelines/x.py", "VALUE = 1\n")
+    rep = _run(tmp_path, ["durability"])
+    doc = rep.to_json()
+    assert doc["schema"] == "adam_tpu.staticcheck/1"
+    for key in ("root", "rules", "counts", "findings", "ok"):
+        assert key in doc
+    assert rep.exit_code == core.EXIT_CLEAN
+    _write(tmp_path, DURABLE_FILE, """\
+        import os
+        def f(p):
+            os.replace(p, p)
+    """)
+    assert _run(tmp_path, ["durability"]).exit_code == core.EXIT_FINDINGS
+    with pytest.raises(ValueError):
+        core.run_checks(str(tmp_path), rule_names=["no-such-rule"])
+
+
+def test_plugin_rule_registration(tmp_path, monkeypatch):
+    mod = _write(tmp_path, "myplugin.py", """\
+        from adam_tpu.staticcheck.core import Rule
+
+        class EveryFile(Rule):
+            name = "every-file"
+            summary = "fires once per file"
+            def visit(self, ctx):
+                yield ctx.finding(self.name, ctx.tree, "seen")
+
+        RULES = [EveryFile]
+    """)
+    monkeypatch.syspath_prepend(os.path.dirname(mod))
+    # plugin registration is process-global by design; keep this test
+    # from leaking its rule into the other tests' full-registry runs
+    core._load_builtins()
+    monkeypatch.setattr(core, "_REGISTRY", dict(core._REGISTRY))
+    _write(tmp_path, "adam_tpu/pipelines/x.py", "VALUE = 1\n")
+    rep = core.run_checks(
+        str(tmp_path), rule_names=["every-file"], plugins=["myplugin"],
+        baseline_path=os.path.join(str(tmp_path), "bl.json"),
+    )
+    assert len(_new(rep, "every-file")) >= 1
+
+
+# -------------------------------------------------------------------------
+# host-sync
+# -------------------------------------------------------------------------
+HOT = "adam_tpu/pipelines/hot.py"
+
+
+def test_hostsync_flags_asarray_on_jit_result(tmp_path):
+    _write(tmp_path, HOT, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def my_kernel(x):
+            return x + 1
+
+        def run(x):
+            out = my_kernel(x)
+            return np.asarray(out)
+    """)
+    rep = _run(tmp_path, ["host-sync"])
+    assert len(_new(rep, "host-sync")) == 1
+
+
+def test_hostsync_device_fetch_launders(tmp_path):
+    _write(tmp_path, HOT, """\
+        import jax
+        import numpy as np
+        from adam_tpu.utils.transfer import device_fetch
+
+        @jax.jit
+        def my_kernel(x):
+            return x + 1
+
+        def run(x):
+            out = device_fetch(my_kernel(x))
+            return np.asarray(out), int(out.sum())
+    """)
+    rep = _run(tmp_path, ["host-sync"])
+    assert _new(rep, "host-sync") == []
+
+
+def test_hostsync_taint_flows_through_unpack_and_methods(tmp_path):
+    _write(tmp_path, HOT, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def pair_kernel(x):
+            return x, x
+
+        def run(x):
+            a, b = pair_kernel(x)
+            c = a.astype("int32")[:4]
+            return float(c.sum()), b.item()
+    """)
+    rep = _run(tmp_path, ["host-sync"])
+    assert len(_new(rep, "host-sync")) == 2  # float(...) and .item()
+
+
+def test_hostsync_isinstance_guard_narrows(tmp_path):
+    _write(tmp_path, HOT, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def my_kernel(x):
+            return x + 1
+
+        def run(x):
+            out = my_kernel(x)
+            if isinstance(out, np.ndarray):
+                return np.asarray(out)
+            return None
+    """)
+    rep = _run(tmp_path, ["host-sync"])
+    assert _new(rep, "host-sync") == []
+
+
+def test_hostsync_warm_and_out_of_scope_exempt(tmp_path):
+    src = """\
+        import jax
+
+        def warm_shapes(k):
+            jax.block_until_ready(k)
+
+        def probe_device(k):
+            return float(k)
+    """
+    _write(tmp_path, HOT, src)
+    _write(tmp_path, "adam_tpu/utils/helper.py",
+           "import jax\n\ndef f(x):\n    jax.block_until_ready(x)\n")
+    rep = _run(tmp_path, ["host-sync"])
+    assert _new(rep, "host-sync") == []  # warm fns + utils/ out of scope
+
+
+def test_hostsync_else_branch_taint_survives_join(tmp_path):
+    _write(tmp_path, HOT, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def my_kernel(x):
+            return x + 1
+
+        def run(x, cond):
+            if cond:
+                out = np.zeros(3)
+            else:
+                out = my_kernel(x)
+            return np.asarray(out)
+    """)
+    rep = _run(tmp_path, ["host-sync"])
+    assert len(_new(rep, "host-sync")) == 1
+
+
+def test_hostsync_comprehension_taint(tmp_path):
+    _write(tmp_path, HOT, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def my_kernel(x):
+            return x + 1
+
+        def run(xs):
+            vals = [my_kernel(x) for x in xs]
+            return np.asarray(vals[0])
+    """)
+    rep = _run(tmp_path, ["host-sync"])
+    assert len(_new(rep, "host-sync")) == 1
+
+
+def test_hostsync_conditional_def_walked_once(tmp_path):
+    # a def nested in a module-level try/if must yield ONE finding,
+    # not a duplicate pair with two fingerprints
+    _write(tmp_path, HOT, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def my_kernel(x):
+            return x + 1
+
+        try:
+            import fastpath
+        except ImportError:
+            def fallback(a):
+                return np.asarray(my_kernel(a))
+    """)
+    rep = _run(tmp_path, ["host-sync"])
+    assert len(_new(rep, "host-sync")) == 1
+
+
+def test_hostsync_flags_block_until_ready_and_device_get(tmp_path):
+    _write(tmp_path, HOT, """\
+        import jax
+
+        def run(x):
+            jax.block_until_ready(x)
+            return jax.device_get(x)
+    """)
+    rep = _run(tmp_path, ["host-sync"])
+    assert len(_new(rep, "host-sync")) == 2
+
+
+# -------------------------------------------------------------------------
+# dispatch-ledger
+# -------------------------------------------------------------------------
+DISPATCH_FILE = "adam_tpu/pipelines/streamed.py"  # in the rule's scope
+
+
+def test_dispatch_untracked_flagged_tracked_ok(tmp_path):
+    _write(tmp_path, DISPATCH_FILE, """\
+        from adam_tpu.utils import compile_ledger
+
+        def bad(b, observe_kernel):
+            return observe_kernel(b)
+
+        def good(b, observe_kernel, dev):
+            with compile_ledger.track(("k.observe", 8), dev):
+                return observe_kernel(b)
+    """)
+    rep = _run(tmp_path, ["dispatch-ledger"])
+    flagged = _new(rep, "dispatch-ledger")
+    dispatch = [f for f in flagged if "outside compile_ledger" in f["message"]]
+    assert len(dispatch) == 1 and dispatch[0]["line"] == 4
+
+
+def test_dispatch_nested_def_retry_idiom_covered(tmp_path):
+    _write(tmp_path, DISPATCH_FILE, """\
+        from adam_tpu.utils import compile_ledger
+        from adam_tpu.utils import retry as _retry
+
+        def run(b, observe_kernel, dev):
+            def dispatch():
+                return observe_kernel(b)
+
+            with compile_ledger.track(("k.observe", 8), dev):
+                return _retry.retry_call(dispatch, site="x")
+    """)
+    rep = _run(tmp_path, ["dispatch-ledger"])
+    assert not [f for f in _new(rep, "dispatch-ledger")
+                if "outside compile_ledger" in f["message"]]
+
+
+def test_dispatch_prewarm_cross_check(tmp_path):
+    # a tracked kernel whose key no prewarm entry builds is flagged;
+    # one with an entry is not
+    _write(tmp_path, DISPATCH_FILE, """\
+        from adam_tpu.utils import compile_ledger
+
+        def run(b, my_kernel, dev):
+            with compile_ledger.track(("k.orphan", 8), dev):
+                my_kernel(b)
+            with compile_ledger.track(("k.covered", 8), dev):
+                my_kernel(b)
+    """)
+    _write(tmp_path, "adam_tpu/parallel/device_pool.py", """\
+        def covered_prewarm_entry(g):
+            def warm(dev):
+                pass
+            return (("k.covered", g), warm)
+    """)
+    rep = _run(tmp_path, ["dispatch-ledger"])
+    orphans = [f for f in _new(rep, "dispatch-ledger")
+               if "no prewarm registry entry" in f["message"]]
+    assert len(orphans) == 1 and "k.orphan" in orphans[0]["message"]
+
+
+def test_dispatch_trace_time_calls_exempt(tmp_path):
+    _write(tmp_path, DISPATCH_FILE, """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def outer_kernel(x, n):
+            return inner_kernel(x) + n
+
+        def inner_kernel(x):
+            return x
+    """)
+    rep = _run(tmp_path, ["dispatch-ledger"])
+    assert not [f for f in _new(rep, "dispatch-ledger")
+                if "outside compile_ledger" in f["message"]]
+
+
+# -------------------------------------------------------------------------
+# durability
+# -------------------------------------------------------------------------
+def test_durability_primitives_flagged(tmp_path):
+    _write(tmp_path, "adam_tpu/pipelines/checkpoint.py", """\
+        import json
+        import os
+
+        def publish(doc, path):
+            with open(path, "w") as fh:
+                json.dump(doc, fh)
+            os.replace(path, path + ".final")
+    """)
+    rep = _run(tmp_path, ["durability"])
+    msgs = "\\n".join(f["message"] for f in _new(rep, "durability"))
+    assert "os.replace" in msgs and "json.dump" in msgs and "open" in msgs
+    assert len(_new(rep, "durability")) == 3
+
+
+def test_durability_staging_and_reads_ok(tmp_path):
+    _write(tmp_path, "adam_tpu/pipelines/checkpoint.py", """\
+        from adam_tpu.utils.durability import atomic_write_json, publish_file
+
+        def publish(doc, path):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(b"x")
+            publish_file(tmp, path)
+            atomic_write_json(path + ".json", doc)
+            with open(path, "rb") as fh:
+                return fh.read()
+    """)
+    rep = _run(tmp_path, ["durability"])
+    assert _new(rep, "durability") == []
+
+
+# -------------------------------------------------------------------------
+# fault-registry
+# -------------------------------------------------------------------------
+FIXTURE_FAULTS = """\
+    KNOWN_POINTS = frozenset({
+        "device.fetch",
+        "ghost.point",
+    })
+
+    def point(site, device=None):
+        pass
+"""
+
+
+def test_fault_registry_unknown_site_and_unused_member(tmp_path):
+    _write(tmp_path, "adam_tpu/utils/faults.py", FIXTURE_FAULTS)
+    _write(tmp_path, "adam_tpu/pipelines/x.py", """\
+        from adam_tpu.utils import faults
+
+        def f():
+            faults.point("device.fetch")
+            faults.point("device.typo")
+    """)
+    rep = _run(tmp_path, ["fault-registry"])
+    msgs = [f["message"] for f in _new(rep, "fault-registry")]
+    assert any("device.typo" in m and "not in faults.KNOWN_POINTS" in m
+               for m in msgs)
+    assert any("ghost.point" in m and "no faults.point call site" in m
+               for m in msgs)
+
+
+def test_fault_registry_docs_gap(tmp_path):
+    _write(tmp_path, "adam_tpu/utils/faults.py", """\
+        KNOWN_POINTS = frozenset({"device.fetch"})
+    """)
+    _write(tmp_path, "adam_tpu/pipelines/x.py", """\
+        from adam_tpu.utils import faults
+
+        def f():
+            faults.point("device.fetch")
+    """)
+    # no docs file: the docs check degrades to skipped
+    rep = _run(tmp_path, ["fault-registry"])
+    assert rep.ok
+    _write(tmp_path, "docs/ROBUSTNESS.md", "fault points: (none listed)\n")
+    rep = _run(tmp_path, ["fault-registry"])
+    assert any("missing from docs/ROBUSTNESS.md" in f["message"]
+               for f in _new(rep, "fault-registry"))
+
+
+# -------------------------------------------------------------------------
+# lock-discipline
+# -------------------------------------------------------------------------
+def test_lock_module_global_mutation(tmp_path):
+    _write(tmp_path, "adam_tpu/utils/pool.py", """\
+        import threading
+
+        _SEEN = set()
+        _LOCK = threading.Lock()
+        ENABLED = False
+
+        def spawn():
+            threading.Thread(target=lambda: None).start()
+
+        def bad(key):
+            global ENABLED
+            _SEEN.add(key)
+            ENABLED = True
+
+        def good(key):
+            global ENABLED
+            with _LOCK:
+                _SEEN.add(key)
+                ENABLED = True
+    """)
+    rep = _run(tmp_path, ["lock-discipline"])
+    flagged = _new(rep, "lock-discipline")
+    assert len(flagged) == 2
+    assert all(f["line"] in (12, 13) for f in flagged)
+
+
+def test_lock_class_discipline_and_locked_convention(tmp_path):
+    _write(tmp_path, "adam_tpu/utils/reg.py", """\
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+
+            def _get_locked(self, k):
+                if k not in self.items:
+                    self.items[k] = 0
+                return self.items[k]
+
+            def bad_call(self, k):
+                return self._get_locked(k)
+
+            def bad_mutate(self, k):
+                self.items[k] = 1
+
+            def good(self, k):
+                with self._lock:
+                    self.items[k] = self._get_locked(k) + 1
+    """)
+    rep = _run(tmp_path, ["lock-discipline"])
+    msgs = [f["message"] for f in _new(rep, "lock-discipline")]
+    assert len(msgs) == 2
+    assert any("_get_locked" in m for m in msgs)
+    assert any("item assignment" in m for m in msgs)
+
+
+def test_lock_quiet_without_threads_or_lock(tmp_path):
+    _write(tmp_path, "adam_tpu/utils/simple.py", """\
+        CACHE = {}
+
+        def put(k, v):
+            CACHE[k] = v
+    """)
+    rep = _run(tmp_path, ["lock-discipline"])
+    assert rep.ok  # no thread spawn, no lock-owning class: out of scope
+
+
+# -------------------------------------------------------------------------
+# telemetry-contract
+# -------------------------------------------------------------------------
+FIXTURE_TELE = """\
+    _R = set()
+
+    def _span(name):
+        _R.add(name)
+        return name
+
+    def _metric(name):
+        _R.add(name)
+        return name
+
+    SPAN_GOOD = _span("pipeline.good")
+    HEARTBEAT_FIELDS = ("schema", "undocumented_field")
+"""
+
+
+def test_telemetry_undeclared_name(tmp_path):
+    _write(tmp_path, "adam_tpu/utils/telemetry.py", FIXTURE_TELE)
+    _write(tmp_path, "adam_tpu/pipelines/x.py", """\
+        def f(tr):
+            with tr.span("pipeline.good"):
+                tr.count("pipeline.rogue")
+    """)
+    rep = _run(tmp_path, ["telemetry-contract"])
+    flagged = _new(rep, "telemetry-contract")
+    assert len(flagged) == 1 and "pipeline.rogue" in flagged[0]["message"]
+
+
+def test_telemetry_docs_gaps(tmp_path):
+    _write(tmp_path, "adam_tpu/utils/telemetry.py", FIXTURE_TELE)
+    _write(tmp_path, "docs/OBSERVABILITY.md",
+           "names: `schema` only is documented here\n")
+    rep = _run(tmp_path, ["telemetry-contract"])
+    msgs = [f["message"] for f in _new(rep, "telemetry-contract")]
+    assert any("pipeline.good" in m and "name contract" in m for m in msgs)
+    assert any("undocumented_field" in m for m in msgs)
+
+
+# -------------------------------------------------------------------------
+# the clean-repo gate + CLI
+# -------------------------------------------------------------------------
+def _repo_root():
+    import adam_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        adam_tpu.__file__
+    )))
+
+
+def test_repo_is_clean():
+    """The acceptance bar: `adam-tpu check` runs clean on this repo —
+    zero new findings, and every baselined finding carries a
+    justification (ISSUE 9 acceptance criteria)."""
+    rep = core.run_checks(_repo_root())
+    assert rep.parse_errors == []
+    assert rep.new_findings == [], "\n".join(
+        f"{e['path']}:{e['line']}: [{e['rule']}] {e['message']}"
+        for e in rep.new_findings
+    )
+    for e in rep.entries:
+        if e["status"] == "baselined":
+            assert e["reason"], f"unjustified baseline entry: {e}"
+
+
+def test_repo_seeded_violation_is_caught(tmp_path):
+    """End-to-end sanity that the gate is live: the same engine that
+    passes the clean repo fails a tree seeded with one violation."""
+    root = _repo_root()
+    rel = "adam_tpu/pipelines/checkpoint.py"
+    _write(tmp_path, rel, """\
+        import os
+
+        def f(p):
+            os.replace(p, p + ".pub")
+    """)
+    # scan the seeded file against the REAL repo root configuration by
+    # handing the engine an explicit file list rooted at the fixture
+    rep = core.run_checks(
+        str(tmp_path), rule_names=["durability"],
+        files=[os.path.join(str(tmp_path), rel)],
+        baseline_path=os.path.join(str(tmp_path), "bl.json"),
+    )
+    assert not rep.ok
+    del root
+
+
+def test_cli_check_json(tmp_path, capsys):
+    from adam_tpu.cli.main import main
+
+    out_path = str(tmp_path / "report.json")
+    rc = main(["check", "--json", out_path, "--quiet"])
+    assert rc == 0
+    with open(out_path) as fh:
+        doc = json.load(fh)
+    assert doc["schema"] == "adam_tpu.staticcheck/1"
+    assert doc["ok"] is True and doc["counts"]["new"] == 0
+
+
+def test_cli_check_list_rules(capsys):
+    from adam_tpu.cli.main import main
+
+    assert main(["check", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("host-sync", "dispatch-ledger", "durability",
+                 "fault-registry", "lock-discipline",
+                 "telemetry-contract"):
+        assert rule in out
+
+
+def test_check_telemetry_names_wrapper():
+    """The absorbed script keeps its contract: exit 0 + summary line."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_repo_root(), "scripts",
+                                      "check-telemetry-names")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "telemetry name contract OK" in proc.stdout
