@@ -1,0 +1,129 @@
+"""Library submission seam for the multi-job transform service.
+
+The thin front the ROADMAP's always-on-service direction names, sitting
+next to :mod:`adam_tpu.api.spark_executor` (the other embedding seam):
+callers hand :class:`~adam_tpu.serve.job.JobSpec`s to a
+:class:`TransformService` and get typed admission results back — the
+in-process analog of a submission RPC.  An HTTP/queue front would wrap
+exactly this surface; keeping it transport-free is what lets the CLI,
+the tests and the chaos harness drive the same scheduler.
+
+Manifest format (``adam-tpu serve --jobs FILE``)::
+
+    {"jobs": [{"job_id": "tenantA-1", "input": "a.bam",
+               "output": "a.adam", "tenant": "A", "weight": 2.0,
+               "window_reads": 4096}, ...]}
+
+A bare JSON list of job objects is accepted too.  Field names are the
+:class:`JobSpec` dataclass fields; unknown keys are rejected so a
+typo'd flag cannot silently no-op.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional, Union
+
+from adam_tpu.serve.job import Admitted, Busy, JobSpec
+from adam_tpu.serve.scheduler import JobScheduler
+
+
+def load_jobs_manifest(path: str) -> list:
+    """Parse a jobs manifest file into validated :class:`JobSpec`s.
+
+    Raises ``ValueError`` with the offending entry on any malformed
+    job — a half-loaded manifest must never submit a prefix."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict):
+        doc = doc.get("jobs")
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"jobs manifest {path}: expected a list of job objects or "
+            '{"jobs": [...]}'
+        )
+    specs = []
+    seen = set()
+    for i, entry in enumerate(doc):
+        if not isinstance(entry, dict):
+            raise ValueError(
+                f"jobs manifest {path}: entry {i} is not an object"
+            )
+        unknown = set(entry) - set(JobSpec.__dataclass_fields__)
+        if unknown:
+            raise ValueError(
+                f"jobs manifest {path}: entry {i} has unknown "
+                f"field(s) {sorted(unknown)}"
+            )
+        try:
+            spec = JobSpec.from_doc(entry)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"jobs manifest {path}: entry {i}: {e}"
+            ) from None
+        if spec.job_id in seen:
+            raise ValueError(
+                f"jobs manifest {path}: duplicate job_id "
+                f"{spec.job_id!r}"
+            )
+        seen.add(spec.job_id)
+        specs.append(spec)
+    return specs
+
+
+class TransformService:
+    """The in-process service facade: one scheduler, typed submissions.
+
+    Thin by design — every method is a one-line delegation plus the
+    blocking-submit convenience, so the robustness contract lives in
+    exactly one place (:class:`~adam_tpu.serve.scheduler.JobScheduler`).
+    """
+
+    def __init__(self, run_root: str, **scheduler_kw):
+        self.scheduler = JobScheduler(run_root, **scheduler_kw)
+
+    # ---- submission -----------------------------------------------------
+    def submit(self, spec: JobSpec) -> Union[Admitted, Busy]:
+        return self.scheduler.submit(spec)
+
+    def submit_blocking(self, spec: JobSpec,
+                        timeout: Optional[float] = None,
+                        poll_s: float = 0.1) -> Union[Admitted, Busy]:
+        """Submit, politely waiting out ``capacity`` rejections until a
+        slot frees (the well-behaved client loop: `has_capacity` gates
+        each attempt, so waiting does not spam the admission counters
+        or the ``sched.admit`` fault point).  ``draining`` and
+        ``duplicate`` rejections return immediately — retrying those
+        would spin forever."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        last = None
+        while True:
+            if last is None or self.scheduler.has_capacity():
+                last = self.scheduler.submit(spec)
+                if isinstance(last, Admitted) or last.kind != "capacity":
+                    return last
+            if deadline is not None and time.monotonic() >= deadline:
+                return last
+            self.scheduler.wait(timeout=poll_s)
+
+    # ---- lifecycle ------------------------------------------------------
+    def recover(self) -> list:
+        return self.scheduler.recover()
+
+    def request_drain(self) -> None:
+        self.scheduler.request_drain()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        return self.scheduler.drain(timeout)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.scheduler.wait(timeout)
+
+    def status(self) -> dict:
+        return self.scheduler.status()
+
+    def close(self) -> None:
+        self.scheduler.close()

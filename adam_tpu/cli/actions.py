@@ -637,6 +637,153 @@ class Transform(Command):
         return 0
 
 
+class Serve(Command):
+    """Multi-job transform service (adam_tpu/serve; docs/ROBUSTNESS.md
+    "Fault-isolated multi-job scheduling"): run N concurrent streamed
+    transform jobs on one shared device pool with admission control,
+    per-tenant weighted fairness, job quarantine, graceful SIGTERM
+    drain and whole-process crash recovery from the run-root."""
+
+    name = "serve"
+    description = ("Run concurrent streamed transform jobs on a shared "
+                   "device pool (bounded slots, per-tenant fairness, "
+                   "quarantine, graceful drain, crash recovery)")
+
+    @classmethod
+    def configure(cls, p):
+        p.add_argument(
+            "run_root", metavar="RUN_ROOT",
+            help="durable service state root: one subdirectory per job "
+            "(JOB.json + run/ journal + heartbeat.ndjson); on startup "
+            "every incomplete job found here resumes bit-identically "
+            "from its journal",
+        )
+        p.add_argument(
+            "--jobs", dest="jobs", default=None, metavar="FILE",
+            help="JSON manifest of jobs to submit (see "
+            "adam_tpu/api/transform_service.py for the format); jobs "
+            "already tracked in RUN_ROOT are skipped, so re-running "
+            "the same command after a crash only resumes",
+        )
+        p.add_argument(
+            "--max-jobs", dest="max_jobs", type=int, default=2,
+            metavar="N",
+            help="bounded job slots: submissions beyond N receive a "
+            "typed Busy rejection (the CLI's own manifest loop polls "
+            "until a slot frees; default 2)",
+        )
+        p.add_argument(
+            "--job-retries", dest="job_retries", type=int, default=None,
+            metavar="N",
+            help="resume a failing job from its journal N times before "
+            "quarantining it (default ADAM_TPU_SCHED_JOB_RETRIES or 1; "
+            "quarantine frees the job's slot and devices, its journal "
+            "stays resumable, surviving jobs are untouched)",
+        )
+
+    @classmethod
+    def run(cls, args):
+        import signal
+        import threading
+        import time as time_mod
+        from collections import deque
+
+        from adam_tpu.api.transform_service import (
+            TransformService,
+            load_jobs_manifest,
+        )
+        from adam_tpu.serve.job import Admitted
+
+        specs = []
+        if args.jobs:
+            try:
+                specs = load_jobs_manifest(args.jobs)
+            except (OSError, ValueError) as e:
+                print(f"serve: {e}", file=sys.stderr)
+                return 2
+        svc = TransformService(
+            args.run_root,
+            max_jobs=args.max_jobs,
+            devices=getattr(args, "devices", None),
+            partitioner=getattr(args, "partitioner", None),
+            job_retries=args.job_retries,
+        )
+        # SIGTERM/SIGINT = graceful drain: the handler only flips an
+        # event (signal-safe); the submission loop below performs the
+        # actual drain — admissions stop, every job finishes its
+        # in-flight windows, fsyncs its journal, and we exit 0
+        drain_req = threading.Event()
+        prev_handlers = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(
+                    sig, lambda _s, _f: drain_req.set()
+                )
+            except (ValueError, OSError):  # non-main thread / platform
+                pass
+        drained = False
+        try:
+            recovered = svc.recover()
+            if recovered:
+                print(f"serve: resumed {len(recovered)} incomplete "
+                      f"job(s) from {args.run_root}: "
+                      f"{', '.join(recovered)}")
+            tracked = set(svc.status()["jobs"])
+            pending = deque(s for s in specs if s.job_id not in tracked)
+            skipped = len(specs) - len(pending)
+            if skipped:
+                print(f"serve: {skipped} manifest job(s) already "
+                      "tracked in the run root; not resubmitting")
+            while True:
+                if drain_req.is_set() and not drained:
+                    svc.request_drain()
+                    drained = True
+                    pending.clear()
+                # has_capacity gates the poll so waiting for a slot
+                # doesn't count one sched.jobs.rejected per tick
+                if pending and svc.scheduler.has_capacity():
+                    got = svc.submit(pending[0])
+                    if isinstance(got, Admitted):
+                        print(f"serve: admitted {got.job_id}")
+                        pending.popleft()
+                        continue
+                    if got.kind != "capacity":
+                        print(f"serve: {pending[0].job_id} refused "
+                              f"({got.reason})", file=sys.stderr)
+                        pending.popleft()
+                        continue
+                    # lost a capacity race: poll for a freed slot below
+                if not pending and svc.wait(timeout=0.25):
+                    break
+                if pending:
+                    time_mod.sleep(0.1)
+        finally:
+            for sig, h in prev_handlers.items():
+                try:
+                    signal.signal(sig, h)
+                except (ValueError, OSError):
+                    pass
+            svc.close()
+        status = svc.status()
+        bad = 0
+        for jid, view in sorted(status["jobs"].items()):
+            line = f"serve: job {jid}: {view['state']}"
+            if view.get("windows_durable"):
+                # parts, not windows: the realign tail part rides past
+                # the window plan, so the count can exceed n_windows
+                line += f" ({view['windows_durable']} durable part(s))"
+            if view.get("error"):
+                line += f" — {view['error']}"
+            print(line)
+            if view["state"] == "quarantined":
+                bad += 1
+        if drained:
+            print("serve: drained cleanly (journals durable; rerun "
+                  "this command to resume)")
+            return 0
+        return 1 if bad else 0
+
+
 class Adam2Fastq(Command):
     """Export reads to FASTQ, optionally splitting pairs
     (Adam2Fastq.scala:25-80)."""
@@ -737,6 +884,7 @@ COMMANDS = [
     CountReadKmers,
     CountContigKmers,
     Transform,
+    Serve,
     Adam2Fastq,
     PluginExecutor,
     Flatten,

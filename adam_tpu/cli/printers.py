@@ -360,15 +360,20 @@ class Top(Command):
 
     name = "top"
     description = ("Live terminal dashboard tailing a streamed run's "
-                   "--progress heartbeat file (exits on done=true)")
+                   "--progress heartbeat file, or a serve run-root "
+                   "directory for the multi-job view (exits on done)")
 
     @classmethod
     def configure(cls, p):
         p.add_argument(
-            "heartbeat", metavar="HEARTBEAT.ndjson",
+            "heartbeat", metavar="HEARTBEAT.ndjson|RUN_ROOT",
             help="the NDJSON file a streamed transform is writing via "
             "--progress PATH (or ADAM_TPU_PROGRESS=PATH); may not "
-            "exist yet — top waits for the first line",
+            "exist yet — top waits for the first line.  A DIRECTORY "
+            "(an 'adam-tpu serve' run-root) switches to the multi-job "
+            "view: every <job>/heartbeat.ndjson under it aggregates "
+            "into one dashboard with per-job rows + pool totals, "
+            "tolerating jobs appearing and finishing mid-watch",
         )
         p.add_argument(
             "-interval", type=float, default=0.5,
@@ -387,8 +392,15 @@ class Top(Command):
 
     @classmethod
     def run(cls, args):
+        import os
+
         from adam_tpu.utils import top as top_mod
 
+        if os.path.isdir(args.heartbeat):
+            return top_mod.follow_root(
+                args.heartbeat, interval=max(0.05, args.interval),
+                once=args.once, max_wait_s=args.max_wait,
+            )
         return top_mod.follow(
             args.heartbeat, interval=max(0.05, args.interval),
             once=args.once, max_wait_s=args.max_wait,

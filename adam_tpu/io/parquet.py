@@ -305,11 +305,17 @@ def to_arrow_alignments(
     return table.replace_schema_metadata(_header_meta(header))
 
 
-def _write_encoded(table: "pa.Table", path: str, compression: str) -> None:
+def _write_encoded(table: "pa.Table", path: str, compression: str,
+                   tracer=None) -> None:
     from adam_tpu.utils import faults
     from adam_tpu.utils import instrumentation as ins
     from adam_tpu.utils import telemetry as tele
 
+    # part/byte counters land on ``tracer`` when given (the streamed
+    # run tracer — in the multi-job service each job's heartbeat must
+    # see only ITS parts, not the pool-wide total); the global TRACE
+    # still gets them at end of run via the tracer absorb
+    tr = tracer if tracer is not None else tele.TRACE
     tmp = _staging_path(path)
     with ins.TIMERS.time(ins.PARQUET_WRITE), tele.TRACE.span(
         tele.SPAN_PART_WRITE, path=os.path.basename(path)
@@ -368,10 +374,10 @@ def _write_encoded(table: "pa.Table", path: str, compression: str) -> None:
         os.rmdir(os.path.dirname(tmp))
     except OSError:
         pass
-    if tele.TRACE.recording:
-        tele.TRACE.count(tele.C_PARTS_WRITTEN)
+    if tr.recording:
+        tr.count(tele.C_PARTS_WRITTEN)
         try:
-            tele.TRACE.count(tele.C_BYTES_WRITTEN, os.path.getsize(path))
+            tr.count(tele.C_BYTES_WRITTEN, os.path.getsize(path))
         except OSError:
             pass
 
@@ -410,7 +416,8 @@ class PartWriterPool:
     """
 
     def __init__(self, n_encoders: int = 2, inflight_parts: int = 3,
-                 compression: str = "zstd", on_published=None):
+                 compression: str = "zstd", on_published=None,
+                 tracer=None):
         import threading
         from concurrent.futures import ThreadPoolExecutor
 
@@ -418,6 +425,12 @@ class PartWriterPool:
         self._io = ThreadPoolExecutor(1)
         self._gate = threading.BoundedSemaphore(max(1, inflight_parts))
         self._compression = compression
+        # byte/part counters, queue-depth gauge and submit-wait samples
+        # go to ``tracer`` when given (the streamed run tracer: a
+        # multi-job service runs one pool per job, and each job's
+        # heartbeat must count only its own parts); None keeps the
+        # global-TRACE behavior for standalone use
+        self._tracer = tracer
         # durable-completion hook, called as on_published(path) on the
         # write thread AFTER a part's atomic+fsync'd publish (the
         # streamed run journal records "window complete" here — by
@@ -452,13 +465,19 @@ class PartWriterPool:
         with self._fail_lock:
             return self._failed
 
+    def _metric_tracer(self):
+        from adam_tpu.utils import telemetry as tele
+
+        return self._tracer if self._tracer is not None else tele.TRACE
+
     def _sample_depth(self, delta: int) -> None:
         from adam_tpu.utils import telemetry as tele
 
         with self._depth_lock:
             self._depth += delta
             d = self._depth
-        tele.TRACE.gauge(tele.G_POOL_DEPTH, d)
+        tr = self._metric_tracer()
+        tr.gauge(tele.G_POOL_DEPTH, d)
 
     def submit(self, path: str, batch: ReadBatch, side: ReadSidecar,
                header: SamHeader) -> None:
@@ -494,8 +513,9 @@ class PartWriterPool:
                     tele.SPAN_PART_ENCODE, rows=int(batch.n_rows)
                 ):
                     table = to_arrow_alignments(batch, side, header)
-                if tele.TRACE.recording:
-                    tele.TRACE.count(
+                tr = self._metric_tracer()
+                if tr.recording:
+                    tr.count(
                         tele.C_BYTES_ENCODED, int(table.nbytes)
                     )
                 return self._io.submit(write, table)
@@ -509,7 +529,8 @@ class PartWriterPool:
 
         def write(table):
             try:
-                _write_encoded(table, path, self._compression)
+                _write_encoded(table, path, self._compression,
+                               tracer=self._tracer)
                 if self._on_published is not None:
                     self._on_published(path)
             except BaseException as e:
@@ -523,11 +544,12 @@ class PartWriterPool:
         # a histogram (not a scalar) because one slow flush stalling a
         # single submit looks identical to chronic starvation in a
         # total, but not in the p99
-        rec = tele.TRACE.recording
+        tr = self._metric_tracer()
+        rec = tr.recording
         t_gate = time.monotonic() if rec else 0.0
         self._gate.acquire()
         if rec:
-            tele.TRACE.observe(
+            tr.observe(
                 tele.H_POOL_SUBMIT_WAIT, time.monotonic() - t_gate
             )
         self._sample_depth(+1)

@@ -253,7 +253,30 @@ class DevicePool:
         # per-device replicas like pass C's dev_tables keep stable
         # indices); round-robin placement runs over the survivors
         self._dead: set = set()
+        # live leases (the multi-job scheduler's per-job handles);
+        # shares the eviction lock — both are rare-path bookkeeping
+        self._leases: set = set()
         self._evict_lock = threading.Lock()
+
+    # ---- multi-tenant leasing (adam_tpu/serve) -------------------------
+    def lease(self, job: Optional[str] = None) -> "PoolLease":
+        """A job-scoped handle onto this shared pool (see
+        :class:`PoolLease`).  The pool tracks live leases only so the
+        scheduler can PROVE a finished or quarantined job holds no
+        devices — placement itself stays stateless."""
+        lease = PoolLease(self, job=job)
+        with self._evict_lock:
+            self._leases.add(lease)
+        return lease
+
+    def _drop_lease(self, lease: "PoolLease") -> None:
+        with self._evict_lock:
+            self._leases.discard(lease)
+
+    def active_leases(self) -> list:
+        """Live leases, for the scheduler's status view."""
+        with self._evict_lock:
+            return list(self._leases)
 
     @property
     def n(self) -> int:
@@ -407,6 +430,82 @@ class DevicePool:
         # instead of serializing into an n * 30 s stall
         with ThreadPoolExecutor(max_workers=self.n) as ex:
             return sum(ex.map(_one, todo))
+
+
+class PoolLease:
+    """One job's handle onto a shared :class:`DevicePool`.
+
+    The multi-job transform service (``adam_tpu/serve``) runs N
+    concurrent streamed jobs against ONE pool; each job receives a
+    lease instead of the pool itself.  The lease is interface-identical
+    to the pool for everything the streamed pipeline touches
+    (``device``/``device_index``/``put``/``prewarm``/``evict``/
+    ``alive_devices``/``devices``/``n``) and adds exactly two things:
+
+    * **attribution** — ``job`` labels eviction log lines, so a shared
+      chip dying under tenant A's dispatch is debuggable;
+    * **release bookkeeping** — :meth:`release` returns the lease to
+      the pool (idempotent; called by the scheduler when the job
+      reaches any terminal state, quarantine included), so
+      ``DevicePool.active_leases`` can prove a quarantined job holds
+      no devices.
+
+    Eviction itself stays SHARED: a chip that spent one tenant's retry
+    budget is dead hardware for every tenant, and each job replays only
+    its own in-flight windows through its own recovery paths — the
+    fault-isolation contract (docs/ROBUSTNESS.md) needs no per-lease
+    device state for that, precisely because placement is stateless.
+    """
+
+    def __init__(self, pool: DevicePool, job: Optional[str] = None):
+        self._pool = pool
+        self.job = job
+        self._released = threading.Event()
+
+    # ---- pool interface (duck-typed by pipelines/streamed.py) ----------
+    @property
+    def devices(self) -> list:
+        return self._pool.devices
+
+    @property
+    def n(self) -> int:
+        return self._pool.n
+
+    def alive_devices(self) -> list:
+        return self._pool.alive_devices()
+
+    def device(self, window: int):
+        return self._pool.device(window)
+
+    def device_index(self, window: int) -> int:
+        return self._pool.device_index(window)
+
+    def device_id(self, window: int):
+        return self._pool.device_id(window)
+
+    def put(self, tree, window: int):
+        return self._pool.put(tree, window)
+
+    def prewarm(self, entries: Sequence[tuple], tracer=None) -> int:
+        return self._pool.prewarm(entries, tracer=tracer)
+
+    def evict(self, device, reason: str = "", tracer=None) -> bool:
+        if self.job and device is not None:
+            reason = f"job {self.job}: {reason}" if reason else (
+                f"job {self.job}"
+            )
+        return self._pool.evict(device, reason=reason, tracer=tracer)
+
+    # ---- lease lifecycle ----------------------------------------------
+    @property
+    def released(self) -> bool:
+        return self._released.is_set()
+
+    def release(self) -> None:
+        """Return this lease to the pool (idempotent)."""
+        if not self._released.is_set():
+            self._released.set()
+            self._pool._drop_lease(self)
 
 
 def _device_key(dev) -> str:

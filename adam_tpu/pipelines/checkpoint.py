@@ -316,6 +316,29 @@ class RunJournal:
             with self._lock:
                 self._start_fresh_locked()
 
+    @classmethod
+    def peek(cls, run_dir: str) -> Optional[dict]:
+        """Read-only summary of a run dir's journal — the multi-job
+        scheduler's crash-recovery scan (``adam_tpu/serve``) uses it to
+        report how much of an incomplete job survived.  Returns
+        ``{"fingerprint", "n_windows", "completed"}`` or ``None`` when
+        absent/unreadable/not-a-journal.  No side effects and no
+        validation authority: the resume decision itself stays with
+        ``__init__``'s fingerprint/refusal rules."""
+        path = os.path.join(run_dir, cls.JOURNAL_NAME)
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(doc, dict) or doc.get("schema") != cls.SCHEMA:
+            return None
+        return {
+            "fingerprint": doc.get("fingerprint"),
+            "n_windows": doc.get("n_windows"),
+            "completed": len(doc.get("windows") or {}),
+        }
+
     # ---- paths ---------------------------------------------------------
     @property
     def _journal_path(self) -> str:

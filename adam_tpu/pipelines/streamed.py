@@ -88,6 +88,18 @@ _SENTINEL = object()
 _HOST = object()
 
 
+class RunCancelled(BaseException):
+    """Cooperative stop at a window boundary (the multi-job service's
+    graceful drain, docs/ROBUSTNESS.md "Fault-isolated multi-job
+    scheduling"): raised out of the per-window ``pacer`` hook.  In
+    pass C the pipeline closes the writer pool GRACEFULLY first — every
+    part already submitted publishes durably and journals — then
+    re-raises, so a drained job's journal resumes exactly where the
+    drain stopped it.  A ``BaseException`` on purpose: the device
+    recovery paths catch ``Exception`` broadly, and a drain request
+    must never be mistaken for a chip failure."""
+
+
 def _ingest_windows(path: str, window_reads: int, out_q: queue.Queue,
                     abort: threading.Event, tr: tele.Tracer):
     """Ingest thread body: tokenize windows, push (batch, side, header).
@@ -153,22 +165,31 @@ def _write_part(out_dir: str, part_idx: int, ds: AlignmentDataset,
     )
 
 
-def _start_heartbeat(tr: tele.Tracer, progress: Optional[str]):
+def _start_heartbeat(tr: tele.Tracer, progress: Optional[str],
+                     include_global: bool = True):
     """Build+start the live progress heartbeat, or None (the default —
     zero construction, the spans' disabled-overhead contract).
 
-    Samples the run tracer AND the global TRACE (parquet's byte/part
-    counters land on the latter); when no other observability sink
-    already enabled global recording, it is flipped on for the
-    heartbeat's lifetime and :func:`_stop_heartbeat` restores the flag
-    AND resets the tracer — a ``--progress``-only run neither exports
-    nor accumulates global telemetry, so back-to-back library runs in
-    one process can't sum each other's counters into the beat."""
+    Samples the run tracer AND the global TRACE (retry/fault counters
+    and the transfer ledger land on the latter); when no other
+    observability sink already enabled global recording, it is flipped
+    on for the heartbeat's lifetime and :func:`_stop_heartbeat`
+    restores the flag AND resets the tracer — a ``--progress``-only run
+    neither exports nor accumulates global telemetry, so back-to-back
+    library runs in one process can't sum each other's counters into
+    the beat.
+
+    ``include_global=False`` (the multi-job service) samples the run
+    tracer alone: concurrent jobs absorb their tracers into the global
+    TRACE as they finish, and a survivor's beat summing that shared
+    state would count its neighbors' work as its own."""
     sink = progress if progress is not None else tele.progress_sink_from_env()
     if not sink:
         return None
-    hb = tele.Heartbeat([tr, tele.TRACE], sink)
-    hb._hb_restore_recording = not tele.TRACE.recording
+    hb = tele.Heartbeat(
+        [tr, tele.TRACE] if include_global else [tr], sink
+    )
+    hb._hb_restore_recording = include_global and not tele.TRACE.recording
     if hb._hb_restore_recording:
         tele.TRACE.recording = True
     hb.start()
@@ -235,6 +256,8 @@ def transform_streamed(
     progress: Optional[str] = None,
     run_dir: Optional[str] = None,
     resume: bool = False,
+    pacer=None,
+    device_pool=None,
 ) -> dict:
     """Run the flagship transform as a streamed, overlapped pipeline.
 
@@ -273,6 +296,19 @@ def transform_streamed(
     resume whose input content, flag composition or window plan differs
     from the journal's fingerprint is refused with a clean restart
     (stale parts discarded), never mixed output.
+
+    ``pacer`` and ``device_pool`` are the multi-job service's seams
+    (``adam_tpu/serve``): ``pacer(phase, index)`` is called once per
+    window at the pass-A and pass-C boundaries — the scheduler's
+    fairness interleaver blocks there to weight windows across
+    concurrent jobs, and raises :class:`RunCancelled` to stop the run
+    gracefully at that boundary (parts already submitted still publish
+    and journal).  ``device_pool`` (a
+    :class:`~adam_tpu.parallel.device_pool.DevicePool` or
+    :class:`~adam_tpu.parallel.device_pool.PoolLease`) substitutes a
+    shared pool for the run's own, so concurrent jobs place windows on
+    the same chips; pacing and pool sharing change only where and when
+    work runs, never the output bytes.
     """
     # Per-run tracer, ALWAYS recording: the returned stats dict is a
     # derived view of its span data (telemetry.streamed_stats_view), so
@@ -280,7 +316,9 @@ def transform_streamed(
     # records per run is negligible next to the work; it folds into the
     # global TRACE at the end when telemetry is enabled.
     tr = tele.Tracer(recording=True)
-    hb = _start_heartbeat(tr, progress)
+    # a paced run is a multi-job service job: its heartbeat must carry
+    # job-scoped counters only (see _start_heartbeat's include_global)
+    hb = _start_heartbeat(tr, progress, include_global=pacer is None)
     try:
         return _transform_streamed_impl(
             path, out_path, tr, hb,
@@ -293,6 +331,7 @@ def transform_streamed(
             lod_threshold=lod_threshold, max_target_size=max_target_size,
             dump_observations=dump_observations, devices=devices,
             partitioner=partitioner, run_dir=run_dir, resume=resume,
+            pacer=pacer, device_pool=device_pool,
         )
     except BaseException:
         # crashed run: the final heartbeat line must carry ok=false —
@@ -330,6 +369,8 @@ def _transform_streamed_impl(
     partitioner: Optional[str],
     run_dir: Optional[str],
     resume: bool,
+    pacer=None,
+    device_pool=None,
 ) -> dict:
     from adam_tpu.parallel import partitioner as part_mod
     from adam_tpu.pipelines import bqsr as bqsr_mod
@@ -352,7 +393,12 @@ def _transform_streamed_impl(
     # i % n; None means single-device (the pre-pool path, bit-for-bit)
     dpool = None
     if use_device:
-        dpool = dp_mod.make_pool(devices)
+        # a shared pool (the multi-job service's lease) substitutes for
+        # the run's own — same duck-typed surface, shared eviction state
+        dpool = (
+            device_pool if device_pool is not None
+            else dp_mod.make_pool(devices)
+        )
     stats["n_devices"] = dpool.n if dpool is not None else (
         1 if use_device else 0
     )
@@ -368,10 +414,15 @@ def _transform_streamed_impl(
         try:
             import jax
 
-            n_mesh = dp_mod.resolve_device_count(devices)
-            mesh_part = part_mod.MeshPartitioner(
-                jax.local_devices()[:n_mesh]
-            )
+            if device_pool is not None:
+                # a shared-pool job's mesh spans exactly the leased
+                # device set, so collectives never touch chips outside
+                # the scheduler's pool
+                mesh_devs = list(device_pool.devices)
+            else:
+                n_mesh = dp_mod.resolve_device_count(devices)
+                mesh_devs = jax.local_devices()[:n_mesh]
+            mesh_part = part_mod.MeshPartitioner(mesh_devs)
         except Exception as e:
             log.warning(
                 "mesh partitioner unavailable (%s); using the pool path",
@@ -734,6 +785,12 @@ def _transform_streamed_impl(
                 tr.count(tele.C_WINDOWS_INGESTED)
                 # chaos-harness kill point: one arrival per pass-A window
                 faults.point("proc.kill", device="pass_a")
+                # multi-job fairness / graceful drain: the scheduler's
+                # interleaver grants this job one window (or raises
+                # RunCancelled at this boundary — nothing is in flight
+                # for this window yet, so the resume re-runs it)
+                if pacer is not None:
+                    pacer("pass_a", win)
                 # compile the grid-quantized kernel set for this
                 # window's grid shape BEFORE its device work — a
                 # 20-40 s cold remote compile must never serialize
@@ -1232,9 +1289,17 @@ def _transform_streamed_impl(
         n_encoders=max(1, n_writers - 1), inflight_parts=3,
         compression=compression,
         on_published=_on_published if journal is not None else None,
+        tracer=tr,
     )
 
     def _submit(idx, ds):
+        # multi-job fairness / graceful drain: one grant per output
+        # part.  A RunCancelled here is caught by the pass-C wrapper
+        # below, which closes the writer pool GRACEFULLY — this part is
+        # lost (it re-executes on resume) but every previously
+        # submitted part still publishes and journals.
+        if pacer is not None:
+            pacer("pass_c", idx)
         # chaos-harness kill point: one arrival per fresh part submit
         faults.point("proc.kill", device="pass_c")
         pool.submit(_part_path(out_path, idx), ds.batch, ds.sidecar,
@@ -1510,6 +1575,16 @@ def _transform_streamed_impl(
                     if idx < len(windows):
                         windows[idx] = None  # free as we go
                     _submit(idx, w)
+    except RunCancelled:
+        # graceful drain at a pass-C boundary: close the pool NON-abort
+        # so every part already submitted encodes, publishes durably
+        # and journals via on_published — the drained job's resume
+        # starts exactly past them.  A worker error surfacing from the
+        # drain-time close replaces the cancellation (it is a real
+        # output failure, not a drain artifact).
+        with tr.span(tele.SPAN_WRITE_WAIT):
+            pool.close()
+        raise
     except BaseException:
         try:  # drain the pool + discard its unpublished temp parts,
             # but surface the apply-path error

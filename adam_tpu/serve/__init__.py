@@ -1,0 +1,41 @@
+"""Multi-job transform service: shared-pool scheduling for the
+streamed flagship (docs/ROBUSTNESS.md "Fault-isolated multi-job
+scheduling").
+
+* :mod:`adam_tpu.serve.job` — the JSON-roundtrip job model and the
+  typed admission results (:class:`Admitted` / :class:`Busy`).
+* :mod:`adam_tpu.serve.fairness` — per-tenant weighted window
+  interleaving (virtual-time fair queuing over the shared pool).
+* :mod:`adam_tpu.serve.scheduler` — admission control, job quarantine,
+  graceful drain and whole-process crash recovery.
+
+The thin front-ends live next door: ``adam_tpu/api/transform_service``
+is the library submission seam, ``adam-tpu serve`` the CLI one.
+"""
+
+from adam_tpu.serve.fairness import WeightedInterleaver
+from adam_tpu.serve.job import (
+    DONE,
+    INTERRUPTED,
+    PENDING,
+    QUARANTINED,
+    RUNNING,
+    Admitted,
+    Busy,
+    JobSpec,
+)
+from adam_tpu.serve.scheduler import JobScheduler, default_job_retries
+
+__all__ = [
+    "Admitted",
+    "Busy",
+    "DONE",
+    "INTERRUPTED",
+    "JobScheduler",
+    "JobSpec",
+    "PENDING",
+    "QUARANTINED",
+    "RUNNING",
+    "WeightedInterleaver",
+    "default_job_retries",
+]

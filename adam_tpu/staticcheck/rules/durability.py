@@ -31,6 +31,10 @@ SCOPE_FILES = frozenset({
     "adam_tpu/pipelines/checkpoint.py",
     "adam_tpu/io/parquet.py",
     "adam_tpu/pipelines/streamed.py",
+    # the multi-job scheduler's JOB.json records gate crash recovery:
+    # they must publish through utils/durability like every other
+    # resume-bearing artifact
+    "adam_tpu/serve/scheduler.py",
 })
 
 _STAGING_MARKERS = ("tmp", "temp", "staging")

@@ -76,6 +76,21 @@ KNOWN_POINTS = frozenset({
     # process at a chosen (or ``p=F,seed=N`` randomized-but-seeded)
     # point without any cooperation from the code under test.
     "proc.kill",
+    # multi-job transform service (adam_tpu/serve; docs/ROBUSTNESS.md
+    # "Fault-isolated multi-job scheduling").  The ``device``
+    # attribution slot carries the JOB ID, so a clause can target one
+    # tenant's job without touching its neighbors:
+    #   sched.admit      each submission's arrival at admission control
+    #   sched.dispatch   each window grant the fairness interleaver
+    #                    hands a job (the scheduler's hot path)
+    #   sched.drain      entry into the graceful-drain sequence
+    #   sched.job_crash  the top of every job run attempt — a
+    #                    ``permanent`` clause keyed to one job id is the
+    #                    canonical quarantine driver
+    "sched.admit",
+    "sched.dispatch",
+    "sched.drain",
+    "sched.job_crash",
 })
 
 

@@ -28,14 +28,26 @@ default with a warning, the house rule for every ``ADAM_TPU_*`` var):
 * ``ADAM_TPU_RETRY_BACKOFF_S`` — first backoff sleep (default 0.05 s,
   doubling per retry).
 * ``ADAM_TPU_RETRY_MAX_BACKOFF_S`` — backoff ceiling (default 2 s).
+* ``ADAM_TPU_RETRY_JITTER`` — optional backoff jitter fraction
+  (default 0 = off) with ``ADAM_TPU_RETRY_JITTER_SEED`` (default 0):
+  each retry sleep stretches by up to this fraction, derived
+  **deterministically** from (seed, site, attempt) via
+  :func:`jitter_factor`.
 
-The backoff is deterministic (no jitter): the recovery paths must be
+The default backoff is jitter-free: the recovery paths must be
 reproducible under the fault-injection matrix, and the call sites are
-per-window (tens per run), not contended.
+per-window (tens per run), not contended.  The jitter knob exists for
+the multi-job service (``adam_tpu/serve``): N quarantine-retrying jobs
+sharing one device pool would otherwise back off in lock-step and
+re-collide on every retry wave.  Because the jitter is a pure function
+of (seed, site, attempt) — no RNG state, no wall clock — a jittered
+run is still bit-reproducible end to end: only sleep durations change,
+never the retry decisions or the computed bytes.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import threading
@@ -66,6 +78,19 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def _env_seed(name: str, default: int) -> int:
+    """Any-int env var (seeds may legitimately be 0 or negative)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        log.warning("%s=%r is not an int; using default %s", name, raw,
+                    default)
+        return default
+
+
 def _env_int(name: str, default: int) -> int:
     raw = os.environ.get(name, "").strip()
     if not raw:
@@ -79,16 +104,41 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def jitter_factor(site: str, attempt: int, *, seed: int = 0,
+                  amount: float = 0.0) -> float:
+    """Deterministic backoff stretch for one (site, attempt) pair.
+
+    Returns a multiplier in ``[1, 1 + amount)`` derived from a sha256 of
+    ``seed:site:attempt`` — a pure function, so a fixed seed reproduces
+    the exact sleep schedule run after run (the recovery-path
+    bit-reproducibility contract survives), while different sites (and
+    different seeds, e.g. one per job in the multi-job service)
+    decorrelate so concurrent retry waves don't re-collide in
+    lock-step.  ``amount=0`` (the default) is exactly 1.0 — the
+    jitter-free documented behavior."""
+    if amount <= 0:
+        return 1.0
+    digest = hashlib.sha256(
+        f"{seed}:{site}:{attempt}".encode()
+    ).digest()
+    unit = int.from_bytes(digest[:8], "big") / float(1 << 64)
+    return 1.0 + amount * unit
+
+
 class RetryPolicy:
     """Attempt/backoff tuning for one family of call sites."""
 
-    __slots__ = ("attempts", "backoff_s", "max_backoff_s")
+    __slots__ = ("attempts", "backoff_s", "max_backoff_s", "jitter",
+                 "jitter_seed")
 
     def __init__(self, attempts: int = 3, backoff_s: float = 0.05,
-                 max_backoff_s: float = 2.0):
+                 max_backoff_s: float = 2.0, jitter: float = 0.0,
+                 jitter_seed: int = 0):
         self.attempts = max(1, attempts)
         self.backoff_s = max(0.0, backoff_s)
         self.max_backoff_s = max(0.0, max_backoff_s)
+        self.jitter = max(0.0, jitter)
+        self.jitter_seed = jitter_seed
 
     @classmethod
     def from_env(cls) -> "RetryPolicy":
@@ -96,6 +146,8 @@ class RetryPolicy:
             attempts=_env_int("ADAM_TPU_RETRY_ATTEMPTS", 3),
             backoff_s=env_float("ADAM_TPU_RETRY_BACKOFF_S", 0.05),
             max_backoff_s=env_float("ADAM_TPU_RETRY_MAX_BACKOFF_S", 2.0),
+            jitter=env_float("ADAM_TPU_RETRY_JITTER", 0.0),
+            jitter_seed=_env_seed("ADAM_TPU_RETRY_JITTER_SEED", 0),
         )
 
 
@@ -153,12 +205,19 @@ def retry_call(
             from adam_tpu.utils import telemetry as tele
 
             tele.TRACE.count(tele.C_RETRY_ATTEMPTS)
+            # deterministic per-site jitter (off by default): stretches
+            # the SLEEP only — attempt counts and outcomes are
+            # untouched, so recovery stays bit-reproducible
+            sleep_s = backoff * jitter_factor(
+                site, attempt, seed=policy.jitter_seed,
+                amount=policy.jitter,
+            )
             log.warning(
                 "%s failed (attempt %d/%d): %s — retrying in %.3fs",
-                site, attempt, policy.attempts, e, backoff,
+                site, attempt, policy.attempts, e, sleep_s,
             )
-            if backoff > 0:
-                time.sleep(backoff)
+            if sleep_s > 0:
+                time.sleep(sleep_s)
             backoff = min(backoff * 2, policy.max_backoff_s)
             attempt += 1
 

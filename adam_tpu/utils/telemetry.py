@@ -128,6 +128,12 @@ SPAN_POOL_PREWARM_COMPILE = _span("device.pool.prewarm.compile")
 # failure, with ``device=<k>`` naming the chip that FAILED. ----
 SPAN_POOL_REPLAY = _span("device.pool.replay")
 
+# ---- multi-job transform service (adam_tpu/serve): one umbrella span
+# per job run attempt on the global TRACE, ``job=<id>`` + ``tenant=``
+# attributed — the SLO view of how long each tenant's job actually held
+# a slot, resumed attempts included. ----
+SPAN_SCHED_JOB = _span("sched.job.run")
+
 # ---- barrier-2 per-fetch spans (pipelines/bqsr.merge_observations):
 # one per device-resident observe histogram fetched at the merge
 # barrier, ``device=<k>`` + ``window=<i>`` attributed — whether the n
@@ -185,6 +191,17 @@ C_RESUME_REFUSED = _metric("resume.refused")
 # replay through the pool/host observe, bit-identically)
 C_MESH_DISPATCHED = _metric("device.mesh.dispatched")
 C_MESH_DEGRADED = _metric("device.mesh.degraded")
+# multi-job transform service (adam_tpu/serve; docs/ROBUSTNESS.md
+# "Fault-isolated multi-job scheduling"): admissions accepted, typed
+# Busy rejections (capacity / draining — never an exception, never an
+# unbounded queue), jobs quarantined after a spent job-retry budget,
+# jobs interrupted at a window boundary by a graceful drain, and
+# incomplete jobs resumed by the whole-process crash-recovery scan.
+C_SCHED_ADMITTED = _metric("sched.jobs.admitted")
+C_SCHED_REJECTED = _metric("sched.jobs.rejected")
+C_SCHED_QUARANTINED = _metric("sched.jobs.quarantined")
+C_SCHED_INTERRUPTED = _metric("sched.jobs.interrupted")
+C_SCHED_RECOVERED = _metric("sched.jobs.recovered")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
@@ -195,6 +212,8 @@ G_POOL_DEVICES = _metric("device.pool.devices")
 # of the packed summary keys (parallel/dist.device_lexsort), 0 when it
 # ran host-side — `adam-tpu analyze` labels the resolve stage with it
 G_RESOLVE_DEVICE_SORT = _metric("streamed.resolve.device_sort")
+# live job-slot occupancy of the multi-job scheduler (adam_tpu/serve)
+G_SCHED_ACTIVE = _metric("sched.jobs.active")
 
 # ---- device ledger: tunnel byte accounting (utils/transfer.py +
 # parallel/device_pool.py).  Counters carry the run totals; the

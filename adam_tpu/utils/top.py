@@ -1,4 +1,4 @@
-"""``adam-tpu top`` — live terminal dashboard over a heartbeat stream.
+"""``adam-tpu top`` — live terminal dashboard over heartbeat streams.
 
 The streamed pipeline's ``--progress PATH`` heartbeat
 (utils/telemetry.Heartbeat) emits one NDJSON line per sample; this
@@ -13,9 +13,20 @@ parsed; the line-buffered writer makes tears transient), accepts both
 ``adam_tpu.heartbeat/1``, ``/2`` and ``/3`` lines, and exits 0 when the stream
 carries ``done=true`` (non-zero when that final line says ``ok=false``).
 
+**Multi-job mode**: pointed at a *directory* (a ``adam-tpu serve``
+run-root), top discovers every ``<job>/heartbeat.ndjson`` under it and
+renders one aggregated dashboard — a per-job state/progress/ETA row
+plus pool-wide totals.  Jobs appearing mid-watch join the board on the
+next poll; finished jobs stay on it with their final state.  Job-scoped
+fields (windows, parts, reads, bytes written, per-job eviction counts)
+SUM across jobs; nothing process-global rides in a paced job's stream
+(see ``pipelines/streamed._start_heartbeat``), so the totals never
+double-count.
+
 Split renderer/follower so the dashboard is unit-testable without a
-terminal: :func:`render_frame` is a pure ``dict -> str`` and
-:func:`follow` owns the tail-loop/TTY behavior.
+terminal: :func:`render_frame` / :func:`render_multi_frame` are pure
+``dict -> str`` and :func:`follow` / :func:`follow_root` own the
+tail-loop/TTY behavior.
 """
 
 from __future__ import annotations
@@ -139,6 +150,249 @@ def render_frame(line: dict, source: str = "") -> str:
             "RUN FAILED — the final heartbeat carries ok=false"
         )
     return "\n".join(out)
+
+
+class _StreamTail:
+    """Incremental reader for one heartbeat NDJSON file: remembers the
+    byte position and the torn tail, survives rotation (shrink = reread
+    from the top) and disappearance (a job dir mid-creation)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._buf = ""
+        self.last: Optional[dict] = None
+
+    def poll(self) -> bool:
+        """Read any new bytes; True when a newer complete line landed."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return False
+        if size < self._pos:
+            self._pos = 0  # rotated/truncated
+            self._buf = ""
+        if size <= self._pos:
+            return False
+        with open(self.path, "rb") as fh:
+            fh.seek(self._pos)
+            chunk = fh.read()
+            self._pos = fh.tell()
+        self._buf += chunk.decode("utf-8", errors="replace")
+        lines = parse_heartbeat_text(self._buf)
+        nl = self._buf.rfind("\n")
+        self._buf = self._buf[nl + 1:] if nl >= 0 else self._buf
+        if lines:
+            self.last = lines[-1]
+            return True
+        return False
+
+
+def discover_streams(root: str) -> dict:
+    """Job heartbeat streams under a serve run-root:
+    ``{job name: <root>/<job>/heartbeat.ndjson}`` for every job
+    subdirectory that has one (the scheduler's layout).  The service's
+    own pool-wide stream (``<root>/heartbeat.ndjson``) is deliberately
+    not a job."""
+    out = {}
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for name in names:
+        p = os.path.join(root, name, "heartbeat.ndjson")
+        if os.path.isfile(p):
+            out[name] = p
+    return out
+
+
+def _job_disk_state(root: str, job: str) -> Optional[str]:
+    """The scheduler's durable per-job state (JOB.json), when present —
+    it distinguishes ``interrupted``/``quarantined`` from plain failure,
+    which the heartbeat alone cannot."""
+    try:
+        with open(os.path.join(root, job, "JOB.json")) as fh:
+            doc = json.load(fh)
+        if isinstance(doc, dict):
+            state = doc.get("state")
+            return str(state) if state else None
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def render_multi_frame(jobs: dict, root: str = "",
+                       pool: Optional[dict] = None,
+                       states: Optional[dict] = None) -> str:
+    """One aggregated dashboard frame (pure function).
+
+    ``jobs`` maps job name -> its newest heartbeat line; ``pool`` is
+    the service stream's newest line (process-global counters: tunnel
+    bytes, retries, faults), ``states`` maps job name -> the JOB.json
+    state string when known.  Job-scoped numbers SUM across jobs;
+    nothing global rides in a paced job's stream, so the totals cannot
+    double-count."""
+    states = states or {}
+    rows = [
+        f"adam-tpu top — multi-job {root or 'run-root'}   "
+        f"{len(jobs)} job(s)",
+        f"{'JOB':<16} {'STATE':<12} {'WINDOWS':<34} {'PARTS':>5} "
+        f"{'READS/S':>9} {'ETA':>7}",
+    ]
+    tot = {"reads": 0, "bytes": 0, "parts": 0, "inflight": 0,
+           "rps": 0.0, "evicted": 0, "running": 0, "done": 0,
+           "failed": 0}
+    hbm_per_dev: dict = {}
+    for name in sorted(jobs):
+        line = jobs[name]
+        done = bool(line.get("done"))
+        ok = line.get("ok", True)
+        state = states.get(name)
+        if state is None:
+            state = ("RUNNING" if not done
+                     else ("DONE" if ok else "FAILED"))
+        else:
+            state = state.upper()
+        wt = line.get("windows_total")
+        wi = line.get("windows_ingested", 0)
+        frac = (wi / wt) if wt else None
+        rows.append(
+            f"{name[:16]:<16} {state[:12]:<12} "
+            f"{_bar(frac)} {wi}/{wt if wt is not None else '?':<4} "
+            f"{line.get('parts_written', 0):>5} "
+            f"{line.get('reads_per_s', 0) or 0:>9,.0f} "
+            f"{_fmt_s(line.get('eta_s')):>7}"
+        )
+        tot["reads"] += line.get("reads_ingested", 0) or 0
+        tot["bytes"] += line.get("bytes_written", 0) or 0
+        tot["parts"] += line.get("parts_written", 0) or 0
+        tot["inflight"] += line.get("inflight", 0) or 0
+        tot["evicted"] += line.get("devices_evicted", 0) or 0
+        if not done:
+            tot["running"] += 1
+            tot["rps"] += line.get("reads_per_s", 0) or 0
+        elif ok:
+            tot["done"] += 1
+        else:
+            tot["failed"] += 1
+        for dev, b in (line.get("hbm_bytes_in_use") or {}).items():
+            if isinstance(b, (int, float)):
+                hbm_per_dev[dev] = max(hbm_per_dev.get(dev, 0), b)
+    rows.append(
+        f"jobs     {tot['running']} running  {tot['done']} done  "
+        f"{tot['failed']} stopped/failed   parts {tot['parts']}   "
+        f"reads {tot['reads']:,} ({tot['rps']:,.0f}/s)"
+    )
+    rows.append(
+        f"pool     written {_fmt_bytes(tot['bytes'])}   "
+        f"inflight {tot['inflight']}   evicted {tot['evicted']}"
+    )
+    if hbm_per_dev:
+        devs = "  ".join(
+            f"{d}:{_fmt_bytes(b)}" for d, b in sorted(hbm_per_dev.items())
+        )
+        rows.append(f"hbm      {devs}")
+    if pool:
+        rows.append(
+            f"global   h2d {_fmt_bytes(pool.get('h2d_bytes'))}   "
+            f"d2h {_fmt_bytes(pool.get('d2h_bytes'))}   "
+            f"retries {pool.get('retries', 0)}   "
+            f"faults {pool.get('faults', 0)}"
+        )
+    if jobs and all(j.get("done") for j in jobs.values()):
+        rows.append(
+            "all jobs finished" if not tot["failed"] else
+            f"all jobs finished — {tot['failed']} stopped or failed"
+        )
+    return "\n".join(rows)
+
+
+def follow_root(root: str, interval: float = 0.5, out=None,
+                once: bool = False, clear: Optional[bool] = None,
+                max_wait_s: Optional[float] = None) -> int:
+    """Aggregate every job heartbeat under a serve run-root into one
+    refreshing dashboard (module doc).  Jobs appearing mid-watch join
+    on the next poll; the watch ends when every discovered job stream
+    carries ``done=true``.
+
+    Exit codes mirror :func:`follow`: 0 when all jobs finished ok (or
+    ``once`` with at least one line), 1 when all finished but some
+    FAILED, 2 when no heartbeat lines appear within the wait bound.
+    Two service-layer refinements: a job whose durable ``JOB.json``
+    says ``interrupted`` is a clean graceful-drain stop, not a failure
+    (its final heartbeat line carries ``ok=false``, which alone cannot
+    tell a drain from a crash), and while the service's own pool
+    stream is still live the watch continues — the scheduler may yet
+    admit manifest jobs whose heartbeat files don't exist, so
+    "every discovered stream is done" is not "the service is done"."""
+    out = out if out is not None else sys.stdout
+    if clear is None:
+        clear = hasattr(out, "isatty") and out.isatty() and not once
+    t0 = time.monotonic()
+    tails: dict = {}
+    service: Optional[_StreamTail] = None
+
+    def expired() -> bool:
+        return (
+            max_wait_s is not None
+            and time.monotonic() - t0 > max_wait_s
+        )
+
+    while True:
+        for name, path in discover_streams(root).items():
+            if name not in tails:
+                tails[name] = _StreamTail(path)
+        if service is None:
+            sp = os.path.join(root, "heartbeat.ndjson")
+            if os.path.isfile(sp):
+                service = _StreamTail(sp)
+        changed = False
+        for tail in tails.values():
+            changed = tail.poll() or changed
+        if service is not None:
+            changed = service.poll() or changed
+        jobs = {n: t.last for n, t in tails.items() if t.last is not None}
+        if jobs and (changed or once):
+            frame = render_multi_frame(
+                jobs, root=root,
+                pool=service.last if service is not None else None,
+                states={n: _job_disk_state(root, n) for n in jobs},
+            )
+            if clear:
+                out.write(_CLEAR)
+            out.write(frame + "\n")
+            if not clear:
+                out.write("\n")
+            out.flush()
+        if jobs:
+            all_done = all(j.get("done") for j in jobs.values())
+            # the service stream still live = more jobs may be coming
+            # (capacity-queued manifest entries have no stream yet)
+            service_live = (
+                service is not None and service.last is not None
+                and not service.last.get("done")
+            )
+            if all_done and not service_live:
+                states = {n: _job_disk_state(root, n) for n in jobs}
+                failed = [
+                    n for n, j in jobs.items()
+                    if not j.get("ok", True)
+                    and states.get(n) != "interrupted"
+                ]
+                return 1 if failed else 0
+            if once:
+                return 0
+        elif once:
+            print(f"top: no job heartbeat lines under {root}",
+                  file=sys.stderr)
+            return 2
+        if expired():
+            print(
+                f"top: jobs still live after {max_wait_s:.0f}s "
+                f"(or no streams under {root})", file=sys.stderr,
+            )
+            return 2
+        time.sleep(interval)
 
 
 def follow(path: str, interval: float = 0.5, out=None,

@@ -219,6 +219,69 @@ def test_retry_call_permanent_not_retried_and_budget_exhausts():
     assert calls[0] == 5  # the full budget, then the original error
 
 
+def test_retry_jitter_deterministic_per_site():
+    """ISSUE-10 satellite: seeded, per-site deterministic backoff
+    jitter — a pure function of (seed, site, attempt), so a fixed seed
+    reproduces the exact sleep schedule while sites decorrelate; the
+    default stays jitter-free (factor exactly 1.0)."""
+    # default: off
+    assert retry_mod.jitter_factor("a", 1) == 1.0
+    assert retry_mod.jitter_factor("a", 1, seed=7, amount=0.0) == 1.0
+    # deterministic: same (seed, site, attempt) -> same factor
+    f1 = retry_mod.jitter_factor("device.fetch", 1, seed=7, amount=0.5)
+    f2 = retry_mod.jitter_factor("device.fetch", 1, seed=7, amount=0.5)
+    assert f1 == f2 and 1.0 <= f1 < 1.5
+    # decorrelated: different sites / seeds / attempts differ
+    others = {
+        retry_mod.jitter_factor("device.dispatch", 1, seed=7, amount=0.5),
+        retry_mod.jitter_factor("device.fetch", 2, seed=7, amount=0.5),
+        retry_mod.jitter_factor("device.fetch", 1, seed=8, amount=0.5),
+    }
+    assert f1 not in others and len(others) == 3
+
+
+def test_retry_jitter_sleeps_scaled_and_decisions_unchanged(monkeypatch):
+    """Jitter stretches the SLEEP only: attempt counts and outcomes
+    are identical to the jitter-free run, and the slept durations are
+    exactly backoff * jitter_factor for the fixed seed."""
+    sleeps = []
+    monkeypatch.setattr(retry_mod.time, "sleep", sleeps.append)
+    calls = [0]
+
+    def always_transient():
+        calls[0] += 1
+        raise faults.TransientFault("down")
+
+    policy = retry_mod.RetryPolicy(attempts=3, backoff_s=0.1,
+                                   jitter=0.5, jitter_seed=7)
+    with pytest.raises(faults.TransientFault):
+        retry_mod.retry_call(always_transient, site="s", policy=policy)
+    assert calls[0] == 3  # same decisions as jitter-free
+    expected = [
+        0.1 * retry_mod.jitter_factor("s", 1, seed=7, amount=0.5),
+        0.2 * retry_mod.jitter_factor("s", 2, seed=7, amount=0.5),
+    ]
+    assert sleeps == pytest.approx(expected)
+    # and the whole schedule reproduces for the same seed
+    sleeps2 = []
+    monkeypatch.setattr(retry_mod.time, "sleep", sleeps2.append)
+    calls[0] = 0
+    with pytest.raises(faults.TransientFault):
+        retry_mod.retry_call(always_transient, site="s", policy=policy)
+    assert sleeps2 == sleeps
+
+
+def test_retry_jitter_env_knobs(monkeypatch):
+    monkeypatch.setenv("ADAM_TPU_RETRY_JITTER", "0.25")
+    monkeypatch.setenv("ADAM_TPU_RETRY_JITTER_SEED", "42")
+    p = retry_mod.RetryPolicy.from_env()
+    assert p.jitter == 0.25 and p.jitter_seed == 42
+    monkeypatch.setenv("ADAM_TPU_RETRY_JITTER", "nope")
+    monkeypatch.setenv("ADAM_TPU_RETRY_JITTER_SEED", "also-nope")
+    p = retry_mod.RetryPolicy.from_env()  # typo degrades to default
+    assert p.jitter == 0.0 and p.jitter_seed == 0
+
+
 def test_call_with_deadline_timeout_and_passthrough():
     assert retry_mod.call_with_deadline(lambda: 7, 5.0, site="t") == 7
     with pytest.raises(retry_mod.DeadlineExceeded):

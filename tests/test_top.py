@@ -4,6 +4,8 @@ exit contract (0 on done+ok, 1 on done+!ok, 2 on no stream)."""
 
 import io
 import json
+import os
+import time
 
 from adam_tpu.cli.main import main
 from adam_tpu.utils import telemetry as tele
@@ -122,3 +124,102 @@ def test_top_cli_subcommand(tmp_path, capsys):
     assert main(["top", p, "-once"]) == 0
     assert "adam-tpu top" in capsys.readouterr().out
     assert main(["top", str(tmp_path / "missing"), "-once"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Multi-job view (serve run-root aggregation)
+# ---------------------------------------------------------------------------
+def _job_stream(root, job, *lines):
+    d = root / job
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / "heartbeat.ndjson", "w") as fh:
+        for ln in lines:
+            fh.write(json.dumps(ln) + "\n")
+
+
+def test_render_multi_frame_sums_and_states(tmp_path):
+    """Per-job rows + summed job-scoped totals; JOB.json states win
+    over the heartbeat's done/ok heuristic."""
+    jobs = {
+        "a": _line(done=True, ok=True, parts_written=3,
+                   bytes_written=100, reads_ingested=10),
+        "b": _line(done=False, parts_written=2, bytes_written=50,
+                   reads_ingested=5, reads_per_s=7.0),
+        "c": _line(done=True, ok=False, parts_written=0),
+    }
+    frame = top_mod.render_multi_frame(
+        jobs, root="R", states={"c": "interrupted"},
+        pool={"h2d_bytes": 9 << 20, "d2h_bytes": 1 << 20,
+              "retries": 4, "faults": 2},
+    )
+    assert "multi-job R" in frame and "3 job(s)" in frame
+    assert "DONE" in frame and "RUNNING" in frame
+    assert "INTERRUPTED" in frame and "FAILED" not in frame
+    assert "parts 5" in frame  # 3 + 2 + 0 summed
+    assert "1 running  1 done  1 stopped/failed" in frame
+    assert "retries 4" in frame and "faults 2" in frame
+
+
+def test_follow_root_exit_codes_and_midwatch_join(tmp_path):
+    root = tmp_path / "run-root"
+    root.mkdir()
+    # no streams yet: bounded wait exits 2
+    assert top_mod.follow_root(
+        str(root), interval=0.01, once=True, out=io.StringIO()
+    ) == 2
+    # two jobs, all done+ok -> 0 (the service's own stream at the root
+    # is pool totals, not a job; done=true = the scheduler closed)
+    _job_stream(root, "jobA", _line(seq=0), _line(seq=1, done=True))
+    _job_stream(root, "jobB", _line(seq=0, done=True))
+    with open(root / "heartbeat.ndjson", "w") as fh:
+        fh.write(json.dumps(_line(seq=0, done=True)) + "\n")
+    out = io.StringIO()
+    assert top_mod.follow_root(str(root), interval=0.01, out=out) == 0
+    txt = out.getvalue()
+    assert "jobA" in txt and "jobB" in txt
+    assert "2 job(s)" in txt
+    # one job ends ok=false -> 1 (a genuine failure)
+    _job_stream(root, "jobB", _line(seq=0, done=True, ok=False))
+    assert top_mod.follow_root(
+        str(root), interval=0.01, out=io.StringIO()
+    ) == 1
+    # ...but ok=false from a graceful drain (durable JOB.json says
+    # interrupted) is a clean stop, not a failure -> 0
+    (root / "jobB").mkdir(exist_ok=True)
+    with open(root / "jobB" / "JOB.json", "w") as fh:
+        json.dump({"state": "interrupted"}, fh)
+    assert top_mod.follow_root(
+        str(root), interval=0.01, out=io.StringIO()
+    ) == 0
+    os.unlink(root / "jobB" / "JOB.json")
+    # a job appearing mid-watch joins the board before exit; a LIVE
+    # service stream keeps the watch open even with every discovered
+    # job done (capacity-queued jobs may have no stream yet)
+    import threading
+
+    with open(root / "heartbeat.ndjson", "w") as fh:
+        fh.write(json.dumps(_line(seq=0)) + "\n")  # service live again
+
+    def late_join():
+        time.sleep(0.15)
+        _job_stream(root, "jobC", _line(seq=0, done=True))
+        _job_stream(root, "jobB", _line(seq=1, done=True))
+        with open(root / "heartbeat.ndjson", "a") as fh:
+            fh.write(json.dumps(_line(seq=1, done=True)) + "\n")
+
+    _job_stream(root, "jobB", _line(seq=0))  # live again
+    t = threading.Thread(target=late_join)
+    t.start()
+    out = io.StringIO()
+    assert top_mod.follow_root(
+        str(root), interval=0.02, out=out, max_wait_s=30
+    ) == 0
+    t.join()
+    assert "jobC" in out.getvalue()
+
+
+def test_top_cli_multi_job_directory(tmp_path, capsys):
+    root = tmp_path / "serve-root"
+    _job_stream(root, "j1", _line(done=True))
+    assert main(["top", str(root), "-once"]) == 0
+    assert "multi-job" in capsys.readouterr().out
