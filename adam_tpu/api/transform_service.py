@@ -22,11 +22,13 @@ typo'd flag cannot silently no-op.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Optional, Union
 
 from adam_tpu.serve.job import Admitted, Busy, JobSpec
 from adam_tpu.serve.scheduler import JobScheduler
+from adam_tpu.utils.retry import DeadlineExceeded, call_with_deadline
 
 
 def load_jobs_manifest(path: str) -> list:
@@ -88,26 +90,91 @@ class TransformService:
         return self.scheduler.submit(spec)
 
     def submit_blocking(self, spec: JobSpec,
+                        deadline_s: Optional[float] = None,
+                        poll_s: float = 0.1, *,
                         timeout: Optional[float] = None,
-                        poll_s: float = 0.1) -> Union[Admitted, Busy]:
+                        ) -> Union[Admitted, Busy]:
         """Submit, politely waiting out ``capacity`` rejections until a
         slot frees (the well-behaved client loop: `has_capacity` gates
         each attempt, so waiting does not spam the admission counters
         or the ``sched.admit`` fault point).  ``draining`` and
         ``duplicate`` rejections return immediately — retrying those
-        would spin forever."""
-        deadline = (
-            time.monotonic() + timeout if timeout is not None else None
-        )
-        last = None
-        while True:
-            if last is None or self.scheduler.has_capacity():
-                last = self.scheduler.submit(spec)
-                if isinstance(last, Admitted) or last.kind != "capacity":
-                    return last
-            if deadline is not None and time.monotonic() >= deadline:
-                return last
-            self.scheduler.wait(timeout=poll_s)
+        would spin forever.
+
+        ``deadline_s`` bounds the wait through
+        :func:`~adam_tpu.utils.retry.call_with_deadline` — the bound
+        holds even when the scheduler itself is WEDGED (a stuck
+        ``wait`` under a hung job, not merely slow slot turnover), in
+        which case a typed ``Busy(kind="capacity")`` surfaces instead
+        of the caller spinning at ``poll_s`` forever.  ``timeout`` is
+        the deprecated alias.  ``deadline_s=None`` waits indefinitely
+        (the embedding caller owns its own bound)."""
+        if deadline_s is None:
+            deadline_s = timeout
+        if deadline_s is not None and deadline_s <= 0:
+            # zero budget = exactly one attempt (call_with_deadline
+            # treats <=0 as "no deadline", which would invert this
+            # into an unbounded wait)
+            return self.scheduler.submit(spec)
+        gave_up = threading.Event()
+        attempted = threading.Event()
+        # terminal submit results the worker reached, deadline or not:
+        # an Admitted that lands as the deadline expires must reach
+        # the caller — returning Busy for a job that IS running would
+        # leak a slot the caller believes was refused
+        outcome: list = []
+
+        def wait_for_slot() -> Union[Admitted, Busy]:
+            last: Optional[Busy] = None
+            while not gave_up.is_set():
+                # first pass always submits (duplicate/draining must
+                # surface even with zero capacity); later passes gate
+                # on has_capacity so the poll doesn't spam rejections
+                if last is None or self.scheduler.has_capacity():
+                    got = self.scheduler.submit(spec)
+                    attempted.set()
+                    if isinstance(got, Admitted) or got.kind != "capacity":
+                        outcome.append(got)
+                        return got
+                    last = got
+                self.scheduler.wait(timeout=poll_s)
+            return last if last is not None else Busy(
+                "submission abandoned", kind="capacity",
+            )
+
+        if deadline_s is None:
+            return wait_for_slot()
+        try:
+            return call_with_deadline(
+                wait_for_slot, deadline_s, site="service.submit_blocking"
+            )
+        except DeadlineExceeded:
+            gave_up.set()
+            # grace window: the worker may be INSIDE submit() right
+            # now; a short wait collects a just-landed admission.  A
+            # genuinely wedged scheduler never reaches outcome, and
+            # the residual race (submit outliving the grace) is
+            # recoverable by design — re-submitting the same spec
+            # surfaces Busy(kind=duplicate), the idempotency signal.
+            grace = time.monotonic() + max(poll_s, 0.1)
+            while time.monotonic() < grace:
+                if outcome:
+                    return outcome[0]
+                time.sleep(0.005)
+            return Busy(
+                f"no job slot freed within {deadline_s:.1f}s"
+                + ("" if attempted.is_set()
+                   else " (scheduler wedged: the admission check never "
+                        "completed)"),
+                kind="capacity",
+            )
+        finally:
+            # unblock the watchdog's worker so an abandoned attempt
+            # stops polling the scheduler instead of leaking a spinner
+            gave_up.set()
+
+    def cancel(self, job_id: str) -> bool:
+        return self.scheduler.cancel(job_id)
 
     # ---- lifecycle ------------------------------------------------------
     def recover(self) -> list:

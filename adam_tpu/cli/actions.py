@@ -680,6 +680,17 @@ class Serve(Command):
             "quarantine frees the job's slot and devices, its journal "
             "stays resumable, surviving jobs are untouched)",
         )
+        p.add_argument(
+            "--listen", dest="listen", default=None, metavar="HOST:PORT",
+            help="serve the HTTP gateway on HOST:PORT (port 0 = OS-"
+            "assigned; the bound address publishes durably to "
+            "RUN_ROOT/gateway.json): idempotency-keyed PUT submission, "
+            "typed 429/503 back-pressure with Retry-After, NDJSON "
+            "heartbeat streaming, Range-resumable part fetch "
+            "(docs/SERVING.md).  The process then runs until SIGTERM, "
+            "which drains gracefully: stop accepting -> 503 -> "
+            "scheduler drain -> every journal settled -> exit 0",
+        )
 
     @classmethod
     def run(cls, args):
@@ -701,6 +712,15 @@ class Serve(Command):
             except (OSError, ValueError) as e:
                 print(f"serve: {e}", file=sys.stderr)
                 return 2
+        listen = None
+        if args.listen:
+            from adam_tpu.gateway.protocol import parse_listen
+
+            try:
+                listen = parse_listen(args.listen)
+            except ValueError as e:
+                print(f"serve: {e}", file=sys.stderr)
+                return 2
         svc = TransformService(
             args.run_root,
             max_jobs=args.max_jobs,
@@ -708,6 +728,14 @@ class Serve(Command):
             partitioner=getattr(args, "partitioner", None),
             job_retries=args.job_retries,
         )
+        gw = None
+        if listen is not None:
+            from adam_tpu.gateway.server import GatewayServer
+
+            gw = GatewayServer(svc, *listen)
+            gw.start()
+            print(f"serve: gateway listening on {gw.url} "
+                  f"(discovery: {args.run_root}/gateway.json)")
         # SIGTERM/SIGINT = graceful drain: the handler only flips an
         # event (signal-safe); the submission loop below performs the
         # actual drain — admissions stop, every job finishes its
@@ -736,6 +764,13 @@ class Serve(Command):
                       "tracked in the run root; not resubmitting")
             while True:
                 if drain_req.is_set() and not drained:
+                    # drain ordering (docs/SERVING.md): the gateway
+                    # stops accepting FIRST (new submissions bounce
+                    # with a typed 503 while live event streams and
+                    # part fetches keep flowing), then the scheduler
+                    # drains every lane to a window boundary
+                    if gw is not None:
+                        gw.stop_accepting()
                     svc.request_drain()
                     drained = True
                     pending.clear()
@@ -754,7 +789,15 @@ class Serve(Command):
                         continue
                     # lost a capacity race: poll for a freed slot below
                 if not pending and svc.wait(timeout=0.25):
-                    break
+                    # a gateway keeps the service alive for remote
+                    # submissions until a drain is requested — idle is
+                    # the steady state, not the exit condition, and it
+                    # must BLOCK (on the drain signal, for a prompt
+                    # SIGTERM response), not spin through instant
+                    # wait() returns
+                    if gw is None or drained:
+                        break
+                    drain_req.wait(timeout=0.25)
                 if pending:
                     time_mod.sleep(0.1)
         finally:
@@ -763,6 +806,10 @@ class Serve(Command):
                     signal.signal(sig, h)
                 except (ValueError, OSError):
                     pass
+            # settled before the listener dies: close() ends event
+            # streams only after every JOB.json above is durable
+            if gw is not None:
+                gw.close()
             svc.close()
         status = svc.status()
         bad = 0
@@ -879,12 +926,221 @@ class Flatten(Command):
         return 0
 
 
+class _GatewayCommand(Command):
+    """Shared plumbing for the remote-client verbs: URL resolution
+    (a gateway URL or a serve run-root with gateway.json) and the
+    connection-error -> exit-2 convention."""
+
+    @staticmethod
+    def client(args):
+        from adam_tpu.gateway.client import GatewayClient, resolve_url
+
+        return GatewayClient(resolve_url(args.url))
+
+    @staticmethod
+    def add_url(p):
+        p.add_argument(
+            "url", metavar="URL|RUN_ROOT",
+            help="gateway address (http://host:port) or a serve "
+            "run-root directory carrying gateway.json (written by "
+            "'adam-tpu serve --listen')",
+        )
+
+
+class Submit(_GatewayCommand):
+    """Remote job submission over the HTTP gateway (adam_tpu/gateway;
+    docs/SERVING.md): idempotency-keyed PUTs, duplicate-safe across
+    client retries and gateway restarts, honoring 429/503 Retry-After
+    with the retry policy's seeded-jitter backoff."""
+
+    name = "submit"
+    description = ("Submit transform jobs to a running adam-tpu "
+                   "gateway over HTTP (idempotent, back-pressure "
+                   "aware)")
+
+    @classmethod
+    def configure(cls, p):
+        cls.add_url(p)
+        p.add_argument(
+            "--jobs", dest="jobs", required=True, metavar="FILE",
+            help="JSON jobs manifest (the 'adam-tpu serve --jobs' "
+            "format; see adam_tpu/api/transform_service.py)",
+        )
+        p.add_argument(
+            "--deadline", dest="deadline", type=float, default=None,
+            metavar="S",
+            help="give up on back-pressured submissions after S "
+            "seconds (default: wait as long as the gateway says to)",
+        )
+        p.add_argument(
+            "--wait", dest="wait", action="store_true",
+            help="after submitting, poll until every job reaches a "
+            "terminal state (exit 1 if any quarantined)",
+        )
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.api.transform_service import load_jobs_manifest
+        from adam_tpu.gateway.client import GatewayBusy, GatewayError
+
+        try:
+            specs = load_jobs_manifest(args.jobs)
+        except (OSError, ValueError) as e:
+            print(f"submit: {e}", file=sys.stderr)
+            return 2
+        try:
+            client = cls.client(args)
+        except ValueError as e:
+            print(f"submit: {e}", file=sys.stderr)
+            return 2
+        try:
+            for spec in specs:
+                got = client.submit_with_retry(
+                    spec.job_id, spec.to_doc(),
+                    deadline_s=args.deadline,
+                )
+                state = got.get("state", "?")
+                dup = " (already submitted)" if got.get("duplicate") \
+                    else ""
+                print(f"submit: {spec.job_id}: {state}{dup}")
+        except GatewayBusy as e:
+            print(f"submit: {e}", file=sys.stderr)
+            return 1
+        except (GatewayError, OSError) as e:
+            print(f"submit: {e}", file=sys.stderr)
+            return 2
+        if not args.wait:
+            return 0
+        bad = 0
+        try:
+            for spec in specs:
+                view = client.wait(spec.job_id)
+                print(f"submit: {spec.job_id} -> {view['state']}")
+                if view["state"] == "quarantined":
+                    bad += 1
+        except (GatewayError, OSError) as e:
+            print(f"submit: {e}", file=sys.stderr)
+            return 2
+        return 1 if bad else 0
+
+
+class ServiceStatus(_GatewayCommand):
+    """Point-in-time service (or per-job) status over the gateway."""
+
+    name = "status"
+    description = ("Print a running adam-tpu gateway's service status "
+                   "(or one job's) as JSON")
+
+    @classmethod
+    def configure(cls, p):
+        cls.add_url(p)
+        p.add_argument("job", metavar="JOB", nargs="?", default=None,
+                       help="one job id (default: the whole service)")
+
+    @classmethod
+    def run(cls, args):
+        import json
+
+        from adam_tpu.gateway.client import GatewayError
+
+        try:
+            doc = cls.client(args).status(args.job)
+        except ValueError as e:
+            print(f"status: {e}", file=sys.stderr)
+            return 2
+        except (GatewayError, OSError) as e:
+            print(f"status: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(doc, indent=1, default=str))
+        return 0
+
+
+class FetchResults(_GatewayCommand):
+    """Byte-exact result download over the gateway: every published
+    part of the job, sha256-verified, Range-resumable — a fetch
+    SIGKILLed mid-download reruns and completes from where it died
+    (docs/SERVING.md resumable-fetch semantics)."""
+
+    name = "fetch"
+    description = ("Download a job's output parts from a gateway "
+                   "(sha256-verified, Range-resumable)")
+
+    @classmethod
+    def configure(cls, p):
+        cls.add_url(p)
+        p.add_argument("job", metavar="JOB")
+        p.add_argument("dest", metavar="DEST_DIR",
+                       help="local directory the parts land in")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.gateway.client import GatewayError
+
+        try:
+            client = cls.client(args)
+            fetched = client.fetch(args.job, args.dest)
+        except ValueError as e:
+            print(f"fetch: {e}", file=sys.stderr)
+            return 2
+        except GatewayError as e:
+            print(f"fetch: {e}", file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"fetch: {e}", file=sys.stderr)
+            return 2
+        for name in sorted(fetched):
+            print(f"fetch: {name} -> {fetched[name]} (sha256 verified)")
+        if not fetched:
+            print(f"fetch: job {args.job!r} has no published parts yet",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+
+class CancelJob(_GatewayCommand):
+    """Cancel one running job at its next window boundary: in-flight
+    parts publish, the journal stays durable and resumable, the job
+    lands 'interrupted' (a re-submission resumes it)."""
+
+    name = "cancel"
+    description = ("Cancel a running job on a gateway at its next "
+                   "window boundary (journal stays resumable)")
+
+    @classmethod
+    def configure(cls, p):
+        cls.add_url(p)
+        p.add_argument("job", metavar="JOB")
+
+    @classmethod
+    def run(cls, args):
+        from adam_tpu.gateway.client import GatewayError
+
+        try:
+            doc = cls.client(args).cancel(args.job)
+        except ValueError as e:
+            print(f"cancel: {e}", file=sys.stderr)
+            return 2
+        except GatewayError as e:
+            print(f"cancel: {e}", file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"cancel: {e}", file=sys.stderr)
+            return 2
+        print(f"cancel: {doc.get('job_id')} cancelling (stops at its "
+              "next window boundary; journal stays resumable)")
+        return 0
+
+
 COMMANDS = [
     CalculateDepth,
     CountReadKmers,
     CountContigKmers,
     Transform,
     Serve,
+    Submit,
+    ServiceStatus,
+    FetchResults,
+    CancelJob,
     Adam2Fastq,
     PluginExecutor,
     Flatten,

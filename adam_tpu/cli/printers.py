@@ -367,6 +367,7 @@ class Top(Command):
     def configure(cls, p):
         p.add_argument(
             "heartbeat", metavar="HEARTBEAT.ndjson|RUN_ROOT",
+            nargs="?", default=None,
             help="the NDJSON file a streamed transform is writing via "
             "--progress PATH (or ADAM_TPU_PROGRESS=PATH); may not "
             "exist yet — top waits for the first line.  A DIRECTORY "
@@ -374,6 +375,14 @@ class Top(Command):
             "view: every <job>/heartbeat.ndjson under it aggregates "
             "into one dashboard with per-job rows + pool totals, "
             "tolerating jobs appearing and finishing mid-watch",
+        )
+        p.add_argument(
+            "--url", dest="url", default=None, metavar="URL",
+            help="tail a REMOTE serve run-root through its HTTP "
+            "gateway (http://host:port, from 'adam-tpu serve "
+            "--listen'): the same multi-job dashboard, fed by the "
+            "gateway's cursor-resumable NDJSON event streams instead "
+            "of local files; exit codes keep the 0/1/2 contract",
         )
         p.add_argument(
             "-interval", type=float, default=0.5,
@@ -396,6 +405,15 @@ class Top(Command):
 
         from adam_tpu.utils import top as top_mod
 
+        if (args.heartbeat is None) == (args.url is None):
+            print("top: give exactly one of HEARTBEAT.ndjson|RUN_ROOT "
+                  "or --url", file=sys.stderr)
+            return 2
+        if args.url is not None:
+            return top_mod.follow_url(
+                args.url, interval=max(0.05, args.interval),
+                once=args.once, max_wait_s=args.max_wait,
+            )
         if os.path.isdir(args.heartbeat):
             return top_mod.follow_root(
                 args.heartbeat, interval=max(0.05, args.interval),

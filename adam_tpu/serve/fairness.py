@@ -27,6 +27,7 @@ journal stays resumable (docs/ROBUSTNESS.md).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Optional
 
@@ -120,7 +121,18 @@ class WeightedInterleaver:
     def grant_history(self) -> list:
         """Recent grants as job ids, oldest first (bounded ring)."""
         with self._lock:
-            return list(self._grants)
+            return [job for job, _ in self._grants]
+
+    def grant_times(self, last: Optional[int] = None) -> list:
+        """Monotonic timestamps of recent grants, oldest first (the
+        newest ``last`` when given).  The gateway derives its
+        ``Retry-After`` hint from the inter-grant cadence here: when
+        windows are flowing at one grant every t seconds, "come back
+        after a batch of windows has drained" is the honest estimate
+        of when a slot could free (docs/SERVING.md back-pressure)."""
+        with self._lock:
+            times = [t for _, t in self._grants]
+        return times if last is None else times[-last:]
 
     # ---- the pacing hot path -------------------------------------------
     def pacer(self, job: str):
@@ -176,7 +188,7 @@ class WeightedInterleaver:
                     if self._next_waiter_locked() is lane:
                         self._vtime = t.vt
                         t.vt += 1.0 / t.weight
-                        self._grants.append(job)
+                        self._grants.append((job, time.monotonic()))
                         self._cond.notify_all()
                         return
                     self._cond.wait(_WAIT_S)

@@ -432,6 +432,26 @@ class JobScheduler:
                 if r.state in (PENDING, RUNNING)
             ]
 
+    def cancel(self, job_id: str) -> bool:
+        """Cancel one running/pending job at its next window boundary
+        (the single-job twin of :meth:`request_drain`): its pacer turn
+        raises ``RunCancelled``, in-flight parts publish, the journal
+        stays durable and resumable, and the job lands ``interrupted``
+        — a re-submission resumes it.  False when the job is unknown
+        or already terminal (nothing to cancel)."""
+        with self._lock:
+            rec = self._jobs.get(job_id)
+            active = rec is not None and rec.state in (PENDING, RUNNING)
+        if not active:
+            return False
+        self._interleaver.cancel(job_id)
+        return True
+
+    def grant_times(self, last: Optional[int] = None) -> list:
+        """The fairness interleaver's recent grant timestamps (the
+        gateway's Retry-After signal; serve/fairness.py)."""
+        return self._interleaver.grant_times(last)
+
     def has_capacity(self) -> bool:
         """True when a submission would not be refused for capacity or
         draining — the polite client's pre-check, so a capacity poll
@@ -544,6 +564,11 @@ class JobScheduler:
                     "weight": r.spec.weight,
                     "attempts": r.attempts,
                     "error": r.error,
+                    # the full spec rides along: the gateway's
+                    # idempotent-PUT comparison and its part-fetch
+                    # routes (spec["output"] is the part directory)
+                    # both read it from here
+                    "spec": r.spec.to_doc(),
                 }
                 for jid, r in self._jobs.items()
             }

@@ -35,6 +35,12 @@ SCOPE_FILES = frozenset({
     # they must publish through utils/durability like every other
     # resume-bearing artifact
     "adam_tpu/serve/scheduler.py",
+    # the gateway's discovery document (gateway.json) and the client's
+    # verified part downloads are resume-bearing too: a fetched part
+    # must publish exactly like a written one (staging name + durable
+    # publish), or a crash mid-download could leave a torn final file
+    "adam_tpu/gateway/server.py",
+    "adam_tpu/gateway/client.py",
 })
 
 _STAGING_MARKERS = ("tmp", "temp", "staging")

@@ -45,7 +45,8 @@ def _is_warmup_fn(fn) -> bool:
     return any(fnmatch.fnmatchcase(fn.name, p) for p in WARMUP_FN_PATTERNS)
 
 SCOPE_PREFIXES = ("adam_tpu/pipelines/", "adam_tpu/parallel/",
-                  "adam_tpu/ops/", "adam_tpu/serve/")
+                  "adam_tpu/ops/", "adam_tpu/serve/",
+                  "adam_tpu/gateway/")
 
 #: Callable-name patterns whose results are device-resident (or may
 #: be): kernels, jit factories, the mesh per-window collectives, the

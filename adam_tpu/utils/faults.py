@@ -91,6 +91,24 @@ KNOWN_POINTS = frozenset({
     "sched.dispatch",
     "sched.drain",
     "sched.job_crash",
+    # HTTP gateway (adam_tpu/gateway; docs/SERVING.md).  The ``device``
+    # attribution slot carries the JOB ID the request targets (or the
+    # request path for non-job routes), so a clause can flake one
+    # tenant's wire traffic without touching its neighbors:
+    #   gateway.accept   every request's arrival at the router, before
+    #                    any work — a ``transient`` clause surfaces as
+    #                    a 503 with Retry-After (the client policy
+    #                    absorbs it), ``permanent`` as a 500
+    #   gateway.stream   each poll iteration of a live
+    #                    /v1/jobs/<job>/events NDJSON stream
+    #   gateway.fetch    before each chunk of part bytes a
+    #                    /v1/jobs/<job>/parts/<part> response writes —
+    #                    a ``kill`` clause here is the chaos harness's
+    #                    gateway-dies-mid-download weapon (the client
+    #                    resumes via Range)
+    "gateway.accept",
+    "gateway.stream",
+    "gateway.fetch",
 })
 
 

@@ -202,6 +202,16 @@ C_SCHED_REJECTED = _metric("sched.jobs.rejected")
 C_SCHED_QUARANTINED = _metric("sched.jobs.quarantined")
 C_SCHED_INTERRUPTED = _metric("sched.jobs.interrupted")
 C_SCHED_RECOVERED = _metric("sched.jobs.recovered")
+# HTTP gateway (adam_tpu/gateway; docs/SERVING.md): requests served
+# (every method/route, errors included), typed back-pressure responses
+# actually sent (429 capacity / 503 draining-or-transient — the wire
+# twin of sched.jobs.rejected), and response payload bytes that left
+# the process (part-fetch chunks + event-stream lines; headers
+# excluded).  The per-request wall lands in the
+# ``gateway.request.seconds`` histogram below.
+C_GW_REQUESTS = _metric("gateway.requests")
+C_GW_BUSY = _metric("gateway.busy")
+C_GW_BYTES_OUT = _metric("gateway.bytes_out")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
@@ -249,6 +259,9 @@ G_HBM_IN_USE = _metric("device.hbm.bytes_in_use")
 # an automatic duration histogram under its own name, in seconds) ----
 H_FETCH_SECONDS = _metric("device.fetch.seconds")
 H_POOL_SUBMIT_WAIT = _metric("parquet.pool.submit_wait")
+# end-to-end gateway request wall (accept -> last byte written),
+# streaming requests included — the service-side latency SLO view
+H_GW_REQUEST_SECONDS = _metric("gateway.request.seconds")
 
 #: Device-only metrics: the paired-CPU bench baseline zeroes these
 #: instead of omitting them so round-over-round diffs are key-stable.

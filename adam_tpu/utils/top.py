@@ -395,6 +395,130 @@ def follow_root(root: str, interval: float = 0.5, out=None,
         time.sleep(interval)
 
 
+def follow_url(url: str, interval: float = 0.5, out=None,
+               once: bool = False, clear: Optional[bool] = None,
+               max_wait_s: Optional[float] = None) -> int:
+    """Tail a REMOTE serve run-root through its HTTP gateway
+    (``adam-tpu top --url http://host:port``): the same aggregated
+    multi-job dashboard as :func:`follow_root`, fed by the gateway's
+    resumable NDJSON event streams instead of local files.  Each job's
+    stream is polled incrementally from a line cursor
+    (``GET /v1/jobs/<job>/events?cursor=N&follow=0``), so a network
+    blip or a bounced gateway costs a re-poll, not a restart; jobs
+    joining mid-watch appear on the next status poll; heartbeat-file
+    rotation server-side resets the cursor (re-delivery, never loss)
+    exactly like a local shrink does in :func:`follow`.
+
+    Exit codes keep the 0/1/2 contract: 0 when every job finished ok
+    (a JOB.json ``interrupted`` is a clean drain stop, not a failure),
+    1 when any finished failed/quarantined, 2 when no heartbeat lines
+    arrive within the wait bound (or the gateway is unreachable and
+    nothing terminal was seen)."""
+    from adam_tpu.gateway.client import (
+        TERMINAL_STATES,
+        GatewayClient,
+        GatewayError,
+    )
+
+    out = out if out is not None else sys.stdout
+    if clear is None:
+        clear = hasattr(out, "isatty") and out.isatty() and not once
+    t0 = time.monotonic()
+    try:
+        client = GatewayClient(url)
+    except ValueError as e:
+        print(f"top: {e}", file=sys.stderr)
+        return 2
+
+    def expired() -> bool:
+        return (
+            max_wait_s is not None
+            and time.monotonic() - t0 > max_wait_s
+        )
+
+    cursors: dict = {}
+    last: dict = {}
+    states: dict = {}
+
+    def verdict() -> int:
+        # judged over STATES, not just heartbeat lines: a job that
+        # quarantined before its first heartbeat (bad input path) has
+        # no line at all, and must still fail the watch
+        failed = {
+            n for n, s in states.items() if s == "quarantined"
+        }
+        failed.update(
+            n for n, line in last.items()
+            if (line.get("ok", True) is False
+                and states.get(n) != "interrupted")
+        )
+        return 1 if failed else 0
+
+    while True:
+        try:
+            status = client.status()
+        except (GatewayError, OSError):
+            # gateway gone: clean end iff everything we saw finished
+            if last and all(l.get("done") for l in last.values()):
+                return verdict()
+            if once or expired():
+                print(f"top: gateway at {url} unreachable",
+                      file=sys.stderr)
+                return 2
+            time.sleep(interval)
+            continue
+        jobs_view = status.get("jobs", {})
+        changed = False
+        for name, view in jobs_view.items():
+            states[name] = view.get("state")
+            try:
+                cur, lines = client.poll_events(
+                    name, cursors.get(name, 0)
+                )
+            except (GatewayError, OSError):
+                continue
+            if lines:
+                cursors[name] = cur
+                last[name] = lines[-1]
+                changed = True
+        if last and (changed or once):
+            frame = render_multi_frame(
+                last, root=url,
+                states={n: states.get(n) for n in last},
+            )
+            if clear:
+                out.write(_CLEAR)
+            out.write(frame + "\n")
+            if not clear:
+                out.write("\n")
+            out.flush()
+        all_term = bool(jobs_view) and all(
+            v.get("state") in TERMINAL_STATES
+            for v in jobs_view.values()
+        )
+        if last:
+            if all_term and all(l.get("done") for l in last.values()):
+                return verdict()
+            if once:
+                return 0
+        elif all_term:
+            # every job terminal yet none ever emitted a heartbeat
+            # line (e.g. all quarantined before their first window):
+            # the watch is over — judge on states alone
+            return verdict()
+        elif once:
+            print(f"top: no job heartbeat lines from {url}",
+                  file=sys.stderr)
+            return 2
+        if expired():
+            print(
+                f"top: jobs still live after {max_wait_s:.0f}s "
+                f"(or no streams at {url})", file=sys.stderr,
+            )
+            return 2
+        time.sleep(interval)
+
+
 def follow(path: str, interval: float = 0.5, out=None,
            once: bool = False, clear: Optional[bool] = None,
            max_wait_s: Optional[float] = None) -> int:

@@ -19,12 +19,15 @@ def test_registry_matches_reference():
     observability extensions (``analyze`` — the post-hoc run report —
     and ``top`` — the live heartbeat dashboard), the contract
     tooling (``check`` — the static analyzer, docs/STATIC_ANALYSIS.md)
-    and the multi-job service front (``serve`` — adam_tpu/serve);
-    none has a reference analog."""
+    the multi-job service front (``serve`` — adam_tpu/serve) and the
+    HTTP gateway's client verbs (``submit``/``status``/``fetch``/
+    ``cancel`` — adam_tpu/gateway, docs/SERVING.md); none has a
+    reference analog."""
     names = {c.name for _, cmds in command_groups() for c in cmds}
     assert names == {
         "depth", "count_kmers", "count_contig_kmers", "transform",
-        "serve", "adam2fastq", "plugin", "flatten",
+        "serve", "submit", "status", "fetch", "cancel",
+        "adam2fastq", "plugin", "flatten",
         "bam2adam", "vcf2adam", "anno2adam", "adam2vcf", "fasta2adam",
         "features2adam", "wigfix2bed",
         "print", "print_genes", "flagstat", "print_tags", "listdict",

@@ -226,6 +226,40 @@ def test_admission_rejects_while_draining(tmp_path, stub_transform):
         sched.close()
 
 
+def test_submit_blocking_deadline_surfaces_busy(
+    tmp_path, stub_transform,
+):
+    """ISSUE-11 satellite: a full scheduler no longer spins
+    `submit_blocking` forever — `deadline_s` bounds the wait through
+    retry.call_with_deadline and a typed Busy(capacity) surfaces."""
+    from adam_tpu.api.transform_service import TransformService
+
+    svc = TransformService(str(tmp_path / "root"), max_jobs=1)
+    try:
+        mk = lambda jid: JobSpec(job_id=jid, input="in", output="out")
+        assert isinstance(svc.submit(mk("hold")), Admitted)
+        t0 = time.monotonic()
+        got = svc.submit_blocking(mk("waiter"), deadline_s=0.5,
+                                  poll_s=0.05)
+        took = time.monotonic() - t0
+        assert isinstance(got, Busy) and got.kind == "capacity"
+        assert 0.4 <= took < 5.0, took
+        # non-capacity rejections return immediately, deadline unused
+        t0 = time.monotonic()
+        dup = svc.submit_blocking(mk("hold"), deadline_s=30.0)
+        assert isinstance(dup, Busy) and dup.kind == "duplicate"
+        assert time.monotonic() - t0 < 5.0
+        # a freed slot admits within the deadline
+        stub_transform["release"].set()
+        assert svc.wait(timeout=30)
+        got = svc.submit_blocking(mk("waiter"), deadline_s=30.0)
+        assert isinstance(got, Admitted)
+        assert svc.wait(timeout=30)
+    finally:
+        stub_transform["release"].set()
+        svc.close()
+
+
 def test_spec_validation_and_manifest(tmp_path):
     with pytest.raises(ValueError):
         JobSpec(job_id="../evil", input="a", output="b").validate()
