@@ -1,0 +1,149 @@
+"""Zero-copy Arrow column assembly from packed (offset + data) buffers.
+
+The encode half of the pass-C tail rebuild (ROADMAP "kill the
+apply/encode/write tail"): where the device hands back an
+already-packed column payload (:mod:`adam_tpu.ops.colpack` — flat
+SANGER qual bytes in row order), the Arrow column is built **directly
+over that memory** with ``pa.Array.from_buffers`` — no per-row
+materialization, no LUT re-walk, no second copy of the fat column.
+The low-cardinality name columns (contig / mateContig /
+recordGroupName — the ones ``io/parquet`` already dictionary-encodes
+at write time) assemble from their small-integer index arrays by
+gathering the dictionary's *byte spans*, never materializing a Python
+string per row.
+
+Every builder is byte-compatible with the column the legacy path
+produced (same Arrow type, same values, same validity), which is what
+keeps the packed and legacy Parquet parts bit-identical —
+tests/test_arrow_pack.py proves it across compressions and backends.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import pyarrow as pa
+
+from adam_tpu.formats.strings import StringColumn, _span_gather_indices
+
+
+@dataclass(frozen=True)
+class PackedQuals:
+    """A device-packed qual column payload: ``buf`` holds the
+    concatenated in-read SANGER bytes of every row (in row order,
+    zero-length rows contributing nothing) and ``lens`` the per-row
+    byte counts (0 for invalid / qual-less rows).  ``buf`` is exactly
+    the Arrow data buffer; offsets rebuild host-side with one cumsum —
+    they never crossed the device link."""
+
+    buf: np.ndarray   # u8[sum(lens)]
+    lens: np.ndarray  # i64[N]
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "buf", np.ascontiguousarray(self.buf, np.uint8)
+        )
+        object.__setattr__(
+            self, "lens", np.asarray(self.lens, np.int64)
+        )
+
+    def offsets(self) -> np.ndarray:
+        out = np.zeros(len(self.lens) + 1, np.int64)
+        np.cumsum(self.lens, out=out[1:])
+        return out
+
+    def take(self, rows: np.ndarray) -> "PackedQuals":
+        """Row subset.  The common case — dropping rows that carry no
+        bytes (the invalid-row compaction in ``to_arrow_alignments``) —
+        is free: the data stream is untouched, only the length entries
+        go.  An order-preserving selection that drops byte-bearing rows
+        falls back to a vectorized span gather."""
+        rows = np.asarray(rows, np.int64)
+        keep = np.zeros(len(self.lens), bool)
+        keep[rows] = True
+        in_order = bool((np.diff(rows) > 0).all()) if len(rows) > 1 else True
+        if in_order and not self.lens[~keep].any():
+            return PackedQuals(self.buf, self.lens[rows])
+        starts = self.offsets()[:-1][rows]
+        lens = self.lens[rows]
+        return PackedQuals(
+            self.buf[_span_gather_indices(starts, lens)], lens
+        )
+
+
+def packed_qual_array(packed: PackedQuals, valid: np.ndarray) -> "pa.Array":
+    """Packed qual payload -> the Arrow ``large_string`` column, built
+    over the fetched buffer with zero copies (``valid`` = the rows that
+    actually carry a qual; their ``lens`` are 0 and they become
+    nulls — the legacy ``decoded_col`` semantics exactly)."""
+    return StringColumn(
+        packed.buf, packed.offsets(), np.asarray(valid, bool)
+    ).to_arrow()
+
+
+def index_name_array(idx: np.ndarray, names: list[str]) -> "pa.Array":
+    """Dictionary-index column -> Arrow ``string`` array (nulls for
+    idx < 0), assembled by gathering the dictionary's byte spans — the
+    zero-materialization replacement for the legacy object-array LUT
+    (``pa.array`` over N Python objects, the last per-row interpreter
+    walk in the encode path).  Byte-identical output: same Arrow type
+    (``pa.string()``, i32 offsets), same values, same validity."""
+    idx = np.asarray(idx)
+    n = len(idx)
+    enc = [s.encode("utf-8") for s in names]
+    dict_lens = np.array([len(b) for b in enc] + [0], np.int64)
+    total_dict = int(dict_lens.sum())
+    dict_buf = (
+        np.frombuffer(b"".join(enc), np.uint8)
+        if total_dict
+        else np.zeros(0, np.uint8)
+    )
+    dict_off = np.zeros(len(enc) + 2, np.int64)
+    np.cumsum(dict_lens, out=dict_off[1:])
+    safe = np.where(idx >= 0, idx, len(enc)).astype(np.int64)
+    lens = dict_lens[safe]
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    total = int(offsets[-1])
+    if total > np.iinfo(np.int32).max:  # i32 offset overflow: impossible
+        # for window-scale batches, but never silently corrupt
+        lut = np.array(names + [None], dtype=object)
+        return pa.array(lut[safe], pa.string())
+    buf = (
+        dict_buf[_span_gather_indices(dict_off[safe], lens)]
+        if total
+        else np.zeros(0, np.uint8)
+    )
+    valid = idx >= 0
+    validity = None if valid.all() else pa.array(valid).buffers()[1]
+    return pa.Array.from_buffers(
+        pa.string(),
+        n,
+        [
+            validity,
+            pa.py_buffer(np.ascontiguousarray(offsets.astype(np.int32))),
+            pa.py_buffer(buf),
+        ],
+    )
+
+
+def pack_matrix_host(mat: np.ndarray, lens: np.ndarray,
+                     lut256: np.ndarray | None = None) -> PackedQuals:
+    """Host-side packing twin (the fallback when the window applied on
+    the host backend, and the bases half of the packed layout — the
+    host already holds the base matrix, so shipping it d2h would buy
+    nothing): native fused LUT+compact when available, else the
+    vectorized numpy mask-select."""
+    from adam_tpu import native
+    from adam_tpu.ops.colpack import pack_rows_np
+
+    lens = np.asarray(lens, np.int64)
+    if lut256 is not None:
+        nat = native.lut_compact_rows(
+            np.ascontiguousarray(mat, np.uint8), lens, lut256
+        )
+        if nat is not None:
+            return PackedQuals(nat[0], np.diff(nat[1]))
+        mat = lut256[np.asarray(mat)]
+    return PackedQuals(pack_rows_np(mat, lens), lens)

@@ -17,6 +17,7 @@ import json
 import os
 import re
 import time
+from collections import deque
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -215,13 +216,17 @@ def _cigar_string_array(ops: np.ndarray, lens: np.ndarray,
 
 
 def _index_name_array(idx: np.ndarray, names: list[str]) -> "pa.Array":
-    """Small-dictionary index column -> arrow string column (None for <0)."""
-    lut = np.array(names + [None], dtype=object)
-    return pa.array(lut[np.where(idx >= 0, idx, len(names))], pa.string())
+    """Small-dictionary index column -> arrow string column (None for <0):
+    zero-materialization dictionary-span gather (io/arrow_pack) — same
+    Arrow type and values as the old per-row object-array LUT."""
+    from adam_tpu.io.arrow_pack import index_name_array
+
+    return index_name_array(np.asarray(idx), names)
 
 
 def to_arrow_alignments(
     batch: ReadBatch, side: ReadSidecar, header: SamHeader,
+    packed=None,
 ) -> "pa.Table":
     """Columnar batch -> arrow Table in the AlignmentRecord field layout.
 
@@ -229,6 +234,12 @@ def to_arrow_alignments(
     RecordBatches can cross a py4j/mapPartitions boundary, and
     :func:`from_arrow_alignments` reconstructs the batch on the other
     side.  Header dictionaries ride along as schema metadata.
+
+    ``packed``: an optional :class:`~adam_tpu.io.arrow_pack.PackedQuals`
+    — the device-packed encode-ready qual payload from the streamed
+    pass C.  When given, the ``qual`` column is built zero-copy over
+    that buffer and the batch's qual matrix is never touched; output is
+    byte-identical to the matrix path (tests/test_arrow_pack.py).
     """
     from adam_tpu.formats.strings import StringColumn
 
@@ -241,6 +252,9 @@ def to_arrow_alignments(
 
         b = jax.tree.map(lambda x: np.asarray(x)[rows], b)
         side = side.take(rows)
+        if packed is not None:
+            # invalid rows carry no packed bytes, so this is offsets-only
+            packed = packed.take(rows)
     n = b.n_rows
 
     def masked_int(vals, dtype):
@@ -268,12 +282,16 @@ def to_arrow_alignments(
                 ],
                 np.ones(n, bool),
             ),
-            "qual": decoded_col(
-                b.quals, schema.QUAL_SANGER_LUT256,
-                lambda m: (
-                    np.minimum(m, 93) + schema.SANGER_OFFSET
-                ).astype(np.uint8),
-                np.asarray(b.has_qual),
+            "qual": (
+                _packed_qual_col(packed, b)
+                if packed is not None
+                else decoded_col(
+                    b.quals, schema.QUAL_SANGER_LUT256,
+                    lambda m: (
+                        np.minimum(m, 93) + schema.SANGER_OFFSET
+                    ).astype(np.uint8),
+                    np.asarray(b.has_qual),
+                )
             ),
             "flags": pa.array(np.asarray(b.flags, np.int32), pa.int32()),
             "contig": _index_name_array(b.contig_idx, header.seq_dict.names),
@@ -303,6 +321,44 @@ def to_arrow_alignments(
         }
     )
     return table.replace_schema_metadata(_header_meta(header))
+
+
+def _packed_qual_col(packed, b) -> "pa.Array":
+    """Device-packed payload -> the arrow qual column (zero-copy)."""
+    from adam_tpu.io.arrow_pack import packed_qual_array
+
+    return packed_qual_array(packed, np.asarray(b.has_qual))
+
+
+def _encode_bytes_in(batch, side, packed=None) -> int:
+    """Decoded column-payload bytes entering a part encode — the
+    [N, L]/[N, C] batch matrices plus the sidecar's flat string
+    buffers (with the qual matrix replaced by the packed payload when
+    the device already compacted it).  The ``parquet.encode.bytes_in``
+    counter; against ``bytes_out`` (the assembled arrow table) it makes
+    the packed-column encode shrink directly visible in
+    ``--metrics-json`` snapshots and ``adam-tpu analyze``."""
+    total = 0
+    for name in ("bases", "quals", "cigar_ops", "cigar_lens"):
+        arr = getattr(batch, name, None)
+        if name == "quals" and packed is not None:
+            total += int(getattr(packed.buf, "nbytes", 0))
+            continue
+        total += int(getattr(arr, "nbytes", 0) or 0)
+    for name in ("names", "attrs", "md", "orig_quals"):
+        col = getattr(side, name, None)
+        buf = getattr(col, "buf", None)
+        total += int(getattr(buf, "nbytes", 0) or 0)
+    return total
+
+
+def _count_encode_bytes(tr, batch, side, table, packed=None) -> None:
+    from adam_tpu.utils import telemetry as tele
+
+    if not tr.recording:
+        return
+    tr.count(tele.C_ENCODE_BYTES_IN, _encode_bytes_in(batch, side, packed))
+    tr.count(tele.C_ENCODE_BYTES_OUT, int(table.nbytes))
 
 
 def _write_encoded(table: "pa.Table", path: str, compression: str,
@@ -395,35 +451,136 @@ def save_alignments(
         table = to_arrow_alignments(batch, side, header)
     if tele.TRACE.recording:
         tele.TRACE.count(tele.C_BYTES_ENCODED, int(table.nbytes))
+    _count_encode_bytes(tele.TRACE, batch, side, table)
     _write_encoded(table, path, compression)
 
 
+def _affinity_cap(floor: int = 1, ceil: int = 8) -> int:
+    """Cores this process may actually run on, clamped to [floor, ceil]
+    — the bound on every adaptive writer-pool growth decision."""
+    try:
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux fallback
+        n = os.cpu_count() or 1
+    return max(floor, min(ceil, n))
+
+
+def resolve_writer_shards(requested: Optional[int] = None) -> int:
+    """Number of independent write threads (``ADAM_TPU_WRITER_SHARDS``
+    override, clamped to [1, 8]): parts shard across them by part
+    index, so K compress+fsync streams run concurrently — the
+    per-process writer shape the multi-host ROADMAP item needs.
+    Default: 2 when the affinity allows it (compression releases the
+    GIL, and one flushing part must not stall the next), 1 on
+    single-core hosts."""
+    if requested is not None:
+        return max(1, min(8, int(requested)))
+    raw = os.environ.get("ADAM_TPU_WRITER_SHARDS", "").strip()
+    if raw:
+        try:
+            return max(1, min(8, int(raw)))
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ADAM_TPU_WRITER_SHARDS=%r is not an int; using the "
+                "affinity-derived default", raw,
+            )
+    return min(2, _affinity_cap())
+
+
+def writer_adaptive_enabled(default: bool = True) -> bool:
+    """``ADAM_TPU_WRITER_ADAPTIVE`` toggle for the submit-gate growth
+    (``0/off/false`` pins the pool at its construction bounds — the
+    legacy fixed-width behavior the A/B perf gates compare against);
+    parsed by the shared ``utils/retry.env_toggle`` contract."""
+    from adam_tpu.utils.retry import env_toggle
+
+    return env_toggle("ADAM_TPU_WRITER_ADAPTIVE", default)
+
+
+#: A submit that waited longer than this on the gate counts as GATED —
+#: the writer pool is back-pressuring the apply loop — and feeds the
+#: adaptive growth decision (the same samples land in the
+#: ``parquet.pool.submit_wait`` histogram).
+_GATED_WAIT_S = 0.02
+#: Grow when at least this many of the last ``_GATE_WINDOW`` submits
+#: gated: one slow flush is noise, repeated gating is a sizing signal.
+_GATE_WINDOW = 4
+_GATE_TRIP = 2
+
+
 class PartWriterPool:
-    """Double-buffered part-file writer (the streamed pipeline's pass C
-    sink).
+    """Adaptive, sharded part-file writer (the streamed pipeline's pass
+    C sink).
 
     Two stages per part: **encode** (columnar batch -> arrow table; CPU
-    work, ``n_encoders`` threads) hands off to a **single write thread**
-    (compression + disk; releases the GIL), with at most
-    ``inflight_parts`` parts alive inside the pool at once.  Encode of
-    part i+1 runs while part i's bytes compress/flush — the flat
-    ThreadPoolExecutor it replaces serialized both halves inside one
-    task, so a slow flush stalled the next encode.  The gate is taken in
-    :meth:`submit` (the producer blocks) and released after the part's
-    bytes hit disk, so peak memory is ``inflight_parts`` decoded parts —
-    a gate taken any later would let submits queue every pending part's
-    decoded batch behind the encoder threads.
+    work, encoder threads) hands off to one of ``n_io`` **independent
+    write threads** (compression + disk; releases the GIL), parts
+    sharded across them by part index so one part's flush never stalls
+    another's — the per-process writer shape the multi-host mesh needs.
+    At most ``inflight_parts`` parts are alive inside the pool at once;
+    the gate is taken in :meth:`submit` (the producer blocks) and
+    released after the part's bytes hit disk, so peak memory is the
+    inflight bound in decoded parts.
+
+    **Adaptive sizing** (``adaptive=True``): when submits repeatedly
+    gate — the producer measurably blocked, the signal the
+    ``parquet.pool.submit_wait`` histogram records — the pool widens
+    its admission bound one part at a time (letting another encoder
+    thread run concurrently), bounded by the scheduling affinity: the
+    pool grows only while the writer tail is the measured ceiling and
+    never past the cores that could serve it.  The live bound lands in
+    the ``parquet.pool.inflight_bound`` gauge.  Crash consistency is
+    per part and unchanged on every width: staging write + durable
+    publish (``utils/durability``), first-failure fail-fast, staging
+    discarded on abort.
     """
 
     def __init__(self, n_encoders: int = 2, inflight_parts: int = 3,
                  compression: str = "zstd", on_published=None,
-                 tracer=None):
+                 tracer=None, n_io: Optional[int] = None,
+                 adaptive: Optional[bool] = None):
         import threading
         from concurrent.futures import ThreadPoolExecutor
 
-        self._enc = ThreadPoolExecutor(max(1, n_encoders))
-        self._io = ThreadPoolExecutor(1)
-        self._gate = threading.BoundedSemaphore(max(1, inflight_parts))
+        self._adaptive = (
+            writer_adaptive_enabled() if adaptive is None else adaptive
+        )
+        n_io = resolve_writer_shards(n_io)
+        # admission bound (parts alive in the pool).  Every admitted
+        # part pins one DECODED window, so the adaptive cap bounds
+        # memory as well as concurrency: at most one slot per
+        # plausibly-useful encoder (affinity) plus one per write
+        # thread, and never more than 2x the construction bound — the
+        # caller sized ``inflight_parts`` to its memory budget, and
+        # adaptive growth may stretch that budget, not ignore it.
+        self._bound = max(1, inflight_parts)
+        self._bound_cap = (
+            max(
+                self._bound,
+                min(_affinity_cap() + n_io, 2 * self._bound),
+            )
+            if self._adaptive else self._bound
+        )
+        enc_cap = max(1, n_encoders)
+        if self._adaptive:
+            enc_cap = max(enc_cap, _affinity_cap())
+        # ThreadPoolExecutor spawns workers lazily: idle capacity above
+        # the admission bound costs nothing until growth admits work
+        self._enc = ThreadPoolExecutor(enc_cap)
+        # K independent single-thread write executors; part i lands on
+        # shard i % K, so shard-local write order stays submission
+        # order (the journal's publish hook needs no further ordering)
+        self._io = [ThreadPoolExecutor(1) for _ in range(n_io)]
+        # atomic round-robin fallback for non-canonical part names:
+        # _io_shard runs concurrently on encoder threads
+        import itertools
+
+        self._io_rr = itertools.count()
+        self._gate = threading.Semaphore(self._bound)
+        self._gate_lock = threading.Lock()
+        self._gated_recent: deque = deque(maxlen=_GATE_WINDOW)
         self._compression = compression
         # byte/part counters, queue-depth gauge and submit-wait samples
         # go to ``tracer`` when given (the streamed run tracer: a
@@ -473,14 +630,63 @@ class PartWriterPool:
     def _sample_depth(self, delta: int) -> None:
         from adam_tpu.utils import telemetry as tele
 
+        # the gauge write happens INSIDE the depth lock: with K write
+        # threads releasing concurrently, an outside-the-lock write
+        # could publish a stale sample after a fresher one (thread A
+        # reads depth 2, thread B reads 1 and writes the gauge, THEN A
+        # writes 2) — the gauge would read high/stale until the next
+        # sample.  Ordering the gauge with the counter makes the last
+        # write always the true current depth, and the depth itself is
+        # incremented before submit enqueues / decremented before the
+        # gate reopens, so it can never read negative or exceed the
+        # admission bound.
+        tr = self._metric_tracer()
         with self._depth_lock:
             self._depth += delta
-            d = self._depth
+            assert self._depth >= 0, "writer-pool depth underflow"
+            tr.gauge(tele.G_POOL_DEPTH, self._depth)
+
+    def _io_shard(self, path: str):
+        """The write executor for a part: sharded by part index so a
+        window sequence stripes across the K write threads; non-part
+        names (standalone use) round-robin."""
+        idx = part_index(path)
+        if idx is None:
+            idx = next(self._io_rr)  # itertools.count: atomic under GIL
+        return self._io[idx % len(self._io)]
+
+    def _maybe_grow(self, gated: bool) -> None:
+        """Adaptive admission: widen the gate one part when submits
+        repeatedly gate (the live submit-wait signal), up to the
+        affinity-derived cap.  One extra slot admits one more part —
+        and with it one more concurrent encoder thread."""
+        from adam_tpu.utils import telemetry as tele
+
+        if not self._adaptive:
+            return
+        with self._gate_lock:
+            self._gated_recent.append(gated)
+            if (
+                sum(self._gated_recent) < _GATE_TRIP
+                or self._bound >= self._bound_cap
+            ):
+                return
+            self._bound += 1
+            self._gated_recent.clear()
+            bound = self._bound
+        self._gate.release()
         tr = self._metric_tracer()
-        tr.gauge(tele.G_POOL_DEPTH, d)
+        if tr.recording:
+            tr.gauge(tele.G_POOL_BOUND, bound)
+
+    @property
+    def inflight_bound(self) -> int:
+        """The live admission bound (grows under adaptive sizing)."""
+        with self._gate_lock:
+            return self._bound
 
     def submit(self, path: str, batch: ReadBatch, side: ReadSidecar,
-               header: SamHeader) -> None:
+               header: SamHeader, packed=None) -> None:
         from adam_tpu.utils import faults
         from adam_tpu.utils import instrumentation as ins
         from adam_tpu.utils import telemetry as tele
@@ -502,7 +708,7 @@ class PartWriterPool:
         def release():
             # decrement BEFORE releasing the gate: a submitter unblocked
             # by the release must never observe a depth above the
-            # inflight_parts bound the gauge exists to monitor
+            # admission bound the gauge exists to monitor
             self._sample_depth(-1)
             self._gate.release()
 
@@ -512,13 +718,16 @@ class PartWriterPool:
                 with ins.TIMERS.time(ins.PARQUET_ENCODE), tele.TRACE.span(
                     tele.SPAN_PART_ENCODE, rows=int(batch.n_rows)
                 ):
-                    table = to_arrow_alignments(batch, side, header)
+                    table = to_arrow_alignments(
+                        batch, side, header, packed=packed
+                    )
                 tr = self._metric_tracer()
                 if tr.recording:
                     tr.count(
                         tele.C_BYTES_ENCODED, int(table.nbytes)
                     )
-                return self._io.submit(write, table)
+                _count_encode_bytes(tr, batch, side, table, packed)
+                return self._io_shard(path).submit(write, table)
             except BaseException as e:
                 # the gate MUST release on the error path: the producer
                 # may be blocked in submit() on a full gate, and an
@@ -543,15 +752,16 @@ class PartWriterPool:
         # producer blocks here IS the writer-pool backpressure signal —
         # a histogram (not a scalar) because one slow flush stalling a
         # single submit looks identical to chronic starvation in a
-        # total, but not in the p99
+        # total, but not in the p99.  The same samples drive the
+        # adaptive admission growth in _maybe_grow.
         tr = self._metric_tracer()
         rec = tr.recording
-        t_gate = time.monotonic() if rec else 0.0
+        t_gate = time.monotonic()
         self._gate.acquire()
+        wait_s = time.monotonic() - t_gate
         if rec:
-            tr.observe(
-                tele.H_POOL_SUBMIT_WAIT, time.monotonic() - t_gate
-            )
+            tr.observe(tele.H_POOL_SUBMIT_WAIT, wait_s)
+        self._maybe_grow(wait_s > _GATED_WAIT_S)
         self._sample_depth(+1)
         try:
             self._futures.append(self._enc.submit(encode))
@@ -593,7 +803,8 @@ class PartWriterPool:
             if err is not None:
                 errs.append(err)
         self._enc.shutdown()
-        self._io.shutdown()
+        for ex in self._io:
+            ex.shutdown()
         first = self.failed
         if first is None and errs:
             first = errs[0]

@@ -593,6 +593,7 @@ def apply_dummy_args(b, g: int, gl: int) -> tuple:
 
 def streamed_prewarm_entries(
     b, n_rg: int, *, mark_duplicates: bool = True, recalibrate: bool = True,
+    packed_apply: bool = False,
 ) -> list[tuple]:
     """The grid-quantized kernel set the streamed device path dispatches,
     as prewarm entries derived from the first window's numpy view ``b``
@@ -638,41 +639,59 @@ def streamed_prewarm_entries(
         # grid width; pass C re-warms with the REAL merged width via
         # apply_prewarm_entry (same key space, so uniform-lmax inputs
         # dedupe it to a no-op)
+        if packed_apply:
+            entries.append(
+                _apply_entry(b, n_rg, g, gl, 2 * gl + 1, pack=True)
+            )
+        # the plain gather stays warm even on packed runs: the
+        # eviction replay path re-applies with pack=False on a
+        # survivor, and that dispatch must never cold-compile inside
+        # the window it is rescuing
         entries.append(_apply_entry(b, n_rg, g, gl, 2 * gl + 1))
     return entries
 
 
-def _apply_entry(b, n_rg: int, g: int, gl: int, n_cyc: int) -> tuple:
+def _apply_entry(b, n_rg: int, g: int, gl: int, n_cyc: int,
+                 pack: bool = False) -> tuple:
     import jax
 
     def warm_apply(dev):
         from adam_tpu.pipelines.bqsr import (
-            N_DINUC, N_QUAL, apply_table_kernel,
+            N_DINUC, N_QUAL, apply_pack_kernel, apply_table_kernel,
         )
 
         args = apply_dummy_args(b, g, gl) + (
             np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8),
         )
-        out = apply_table_kernel(
-            *(jax.device_put(a, dev) for a in args), gl
-        )
+        placed = tuple(jax.device_put(a, dev) for a in args)
+        if pack:
+            out = apply_pack_kernel(*placed, gl, g * gl)
+        else:
+            out = apply_table_kernel(*placed, gl)
         jax.block_until_ready(out)
 
+    # two literal key tuples (not one with a computed kernel name): the
+    # dispatch-ledger rule's prewarm cross-check parses these literals
+    if pack:
+        return (("bqsr.apply_pack", g, gl, n_rg, n_cyc), warm_apply)
     return (("bqsr.apply", g, gl, n_rg, n_cyc), warm_apply)
 
 
-def apply_prewarm_entry(b, n_rg: int, table_n_cyc: int) -> tuple:
+def apply_prewarm_entry(b, n_rg: int, table_n_cyc: int,
+                        pack: bool = False) -> tuple:
     """Pass-C re-warm entry: the apply table-gather keyed by the SOLVED
     table's real cycle width.  ``merge_observations`` widens the table
     to the maximum window grid, which can exceed the window-0 width the
     pass-A prewarm assumed — without this, every device would pay the
     apply compile inside pass C on variable-length inputs.  Shares the
     pass-A entry's key space, so the uniform-lmax common case dedupes
-    to a no-op against the process-wide cache."""
+    to a no-op against the process-wide cache.  ``pack=True`` warms the
+    fused apply+pack kernel (the packed-column pass-C dispatch)."""
     from adam_tpu.formats.batch import grid_cols, grid_rows
 
     return _apply_entry(
-        b, n_rg, grid_rows(b.n_rows), grid_cols(b.lmax), table_n_cyc
+        b, n_rg, grid_rows(b.n_rows), grid_cols(b.lmax), table_n_cyc,
+        pack=pack,
     )
 
 

@@ -297,6 +297,41 @@ def _mesh_apply_jit_builder(donate: bool):
     return partial(jax.jit, **kw)(run)
 
 
+def _mesh_apply_pack_jit_builder(donate: bool):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from adam_tpu.parallel.mesh import BATCH_AXIS, shard_map
+
+    def run(bases, quals, lengths, flags, rg, has_qual, valid, table,
+            lmax, mesh):
+        from adam_tpu.pipelines.bqsr import apply_pack_body
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=_mesh_specs(7) + (P(),),
+            out_specs=P(BATCH_AXIS), check_vma=False,
+        )
+        def body(b, q, le, fl, r, hq, v, tbl):
+            # each shard fuses the gather with the column pack over its
+            # own row block (size static at trace: local rows x lanes);
+            # the global flat output is shard payloads in shard order —
+            # which IS row order, so the host-side concat of the
+            # per-shard payload slices is the single-device pack
+            return apply_pack_body(
+                b, q, le, fl, r, hq, v, tbl, lmax,
+                b.shape[0] * b.shape[1],
+            )
+
+        return body(bases, quals, lengths, flags, rg, has_qual, valid, table)
+
+    kw = {"static_argnames": ("lmax", "mesh")}
+    if donate:
+        # the flat packed output matches the donated quals buffer's
+        # byte size exactly ([g*gl] u8 vs [g, gl] u8)
+        kw["donate_argnums"] = (1,)
+    return partial(jax.jit, **kw)(run)
+
+
 def _mesh_markdup_jit_builder():
     import jax
     from jax.sharding import PartitionSpec as P
@@ -340,6 +375,8 @@ def _mesh_jit(kind: str, donate: bool = False):
                 }.get(kind)
                 if builder is not None:
                     fn = builder()
+                elif kind == "apply_pack":
+                    fn = _mesh_apply_pack_jit_builder(donate)
                 else:
                     fn = _mesh_apply_jit_builder(donate)
                 _MESH_JITS[key] = fn
@@ -508,6 +545,41 @@ class MeshPartitioner:
             *placed, table_dev, lmax=gl, mesh=self.mesh
         )
 
+    def apply_pack_window(self, arrays: tuple, table_dev, gl: int):
+        """Fused apply + column pack across the mesh -> lazy flat
+        u8[g*gl], row-sharded: shard k's segment starts with exactly
+        its rows' packed SANGER qual bytes (``ops/colpack``).  Pair
+        with :meth:`packed_payload_slices` to fetch only the real
+        column payload — the pass-C d2h shrink."""
+        placed = tuple(self.put_rows(a) for a in arrays)
+        # adam-tpu: noqa[dispatch-ledger] reason=every caller (bqsr apply_pack mesh branch and the mesh prewarm) wraps this dispatch in its own track keyed mesh.apply_pack
+        return _mesh_jit(
+            "apply_pack", donate=self.apply_supports_donation()
+        )(*placed, table_dev, lmax=gl, mesh=self.mesh)
+
+    def packed_payload_slices(self, packed, lens_gm: np.ndarray,
+                              gl: int) -> list:
+        """Lazy ``(device slice, true bytes)`` pairs covering each
+        shard's real packed payload (``lens_gm``: per-row packed byte
+        counts padded to the mesh row grid — host-resident, so the
+        split needs no device round trip).  Slice lengths are
+        bucket-quantized (``colpack.fetch_grid``) so a run compiles a
+        handful of slice programs, not one per window; the fetch side
+        trims each bucket to its true size.  Empty shards contribute
+        no slice; concatenating the trimmed payloads in order
+        reproduces the single-device pack."""
+        from adam_tpu.ops.colpack import fetch_grid
+
+        rows_local = len(lens_gm) // self.n
+        seg = rows_local * gl
+        out = []
+        for k in range(self.n):
+            t_k = int(lens_gm[k * rows_local:(k + 1) * rows_local].sum())
+            if t_k:
+                cut = min(seg, fetch_grid(t_k))
+                out.append((packed[k * seg: k * seg + cut], t_k))
+        return out
+
     # ---- compile prewarm ----------------------------------------------
     def prewarm(self, entries: Sequence[tuple], tracer=None) -> int:
         """Compile the mesh kernel set before the first window's
@@ -593,10 +665,13 @@ def mesh_markdup_prewarm_entry(b, part: MeshPartitioner) -> tuple:
 
 
 def mesh_apply_prewarm_entry(b, n_rg: int, n_cyc: int,
-                             part: MeshPartitioner) -> tuple:
+                             part: MeshPartitioner,
+                             pack: bool = False) -> tuple:
     """Prewarm entry for the mesh apply jit keyed by the SOLVED table's
     real cycle width (the pass-C re-warm, device_pool.apply_prewarm_entry
-    semantics; ``device_pool.apply_dummy_args``)."""
+    semantics; ``device_pool.apply_dummy_args``).  ``pack=True`` warms
+    the fused apply+pack variant instead (its own executable — the key
+    carries the kernel name, so both can coexist warm)."""
     import jax
 
     from adam_tpu.formats.batch import grid_cols, grid_rows
@@ -610,8 +685,13 @@ def mesh_apply_prewarm_entry(b, n_rg: int, n_cyc: int,
         tbl = part.put_replicated(
             np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8)
         )
+        runner = part.apply_pack_window if pack else part.apply_window
         jax.block_until_ready(
-            part.apply_window(apply_dummy_args(b, g, gl), tbl, gl)
+            runner(apply_dummy_args(b, g, gl), tbl, gl)
         )
 
+    # two literal key tuples (not one with a computed kernel name): the
+    # dispatch-ledger rule's prewarm cross-check parses these literals
+    if pack:
+        return (("mesh.apply_pack", g, gl, n_rg, n_cyc), warm)
     return (("mesh.apply", g, gl, n_rg, n_cyc), warm)

@@ -699,19 +699,14 @@ def recalibrate_kernel(
     return jnp.where(apply_mask, new_q, quals).astype(jnp.uint8)
 
 
-@partial(jax.jit, static_argnames=("lmax",))
-def apply_table_kernel(
+def apply_table_body(
     bases, quals, lengths, flags, read_group_idx, has_qual, valid,
     phred_table, lmax: int,
 ):
-    """Apply a pre-solved u8 recalibration table on device -> u8[N, L].
-
-    The per-residue work is one 4-d gather keyed on (rg, reported qual,
-    cycle, dinuc) plus the Q5-floor apply mask — the device half of the
-    streamed pipeline's pass C (the table itself was solved at the merge
-    barrier).  The table's cycle axis spans [-gl, gl] with
-    gl = (n_cyc - 1) // 2 >= lmax, so smaller windows gather from the
-    middle of a wider merged table."""
+    """Traceable body of the table application (shared by the plain
+    kernel, the fused apply+pack kernel below, and the mesh shard_map
+    bodies — ONE copy of the math, so every path is bitwise the same
+    gather)."""
     n_rg = phred_table.shape[0]
     gl = (phred_table.shape[2] - 1) // 2
     rg = jnp.where(read_group_idx >= 0, read_group_idx, n_rg - 1).astype(jnp.int32)
@@ -728,6 +723,61 @@ def apply_table_kernel(
         & valid[:, None]
     )
     return jnp.where(apply_mask, new_q, quals).astype(jnp.uint8)
+
+
+@partial(jax.jit, static_argnames=("lmax",))
+def apply_table_kernel(
+    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+    phred_table, lmax: int,
+):
+    """Apply a pre-solved u8 recalibration table on device -> u8[N, L].
+
+    The per-residue work is one 4-d gather keyed on (rg, reported qual,
+    cycle, dinuc) plus the Q5-floor apply mask — the device half of the
+    streamed pipeline's pass C (the table itself was solved at the merge
+    barrier).  The table's cycle axis spans [-gl, gl] with
+    gl = (n_cyc - 1) // 2 >= lmax, so smaller windows gather from the
+    middle of a wider merged table."""
+    return apply_table_body(
+        bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+        phred_table, lmax,
+    )
+
+
+def apply_pack_body(
+    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+    phred_table, lmax: int, size: int,
+):
+    """Traceable fused apply + column pack: the table gather of
+    :func:`apply_table_body` followed by the on-device SANGER encode
+    and row-prefix pack (:mod:`adam_tpu.ops.colpack`) — the encode-ready
+    payload the pass-C fetch ships instead of the [N, L] matrix."""
+    from adam_tpu.ops.colpack import pack_rows_body, sanger_body
+
+    new_q = apply_table_body(
+        bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+        phred_table, lmax,
+    )
+    pack_lens = jnp.where(
+        valid & has_qual, lengths.astype(jnp.int64), 0
+    )
+    return pack_rows_body(sanger_body(new_q), pack_lens, size)
+
+
+@partial(jax.jit, static_argnames=("lmax", "size"))
+def apply_pack_kernel(
+    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+    phred_table, lmax: int, size: int,
+):
+    """Jit entry point over :func:`apply_pack_body` (the pool path's
+    pass-C dispatch when packed columns are on; the mesh path fuses the
+    same body per shard in ``parallel/partitioner``).  ``size`` is the
+    window's dense grid area — static per (g, gl), so the packed
+    variant adds no compile-cache shapes."""
+    return apply_pack_body(
+        bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+        phred_table, lmax, size,
+    )
 
 
 def merge_observations(parts: list[tuple], replays=None,
@@ -853,6 +903,7 @@ def recalibrate_base_qualities(
 def apply_recalibration_dispatch(
     ds: AlignmentDataset, phred_table: np.ndarray, gl: int,
     backend: Optional[str] = None, device=None, mesh=None,
+    pack: bool = False,
 ):
     """Start the per-residue table application for one window -> opaque
     handle for :func:`apply_recalibration_finish`.
@@ -867,7 +918,14 @@ def apply_recalibration_dispatch(
     window; under ``mesh`` it is the replicated placement from
     ``MeshPartitioner.put_replicated`` — placed once, resident for the
     whole pass).  The other backends compute eagerly and the handle is
-    just the result."""
+    just the result.
+
+    ``pack=True`` (device/mesh backends only) dispatches the fused
+    apply+pack kernel instead: the handle's payload is the window's
+    flat SANGER-encoded qual column (``ops/colpack``), fetched by
+    :func:`apply_recalibration_finish_packed` as ``sum(lengths)``
+    bytes — the pass-C d2h fetch ships the encode-ready column, never
+    the [N, L] matrix."""
     backend = bqsr_backend(backend)
     from adam_tpu.parallel.device_pool import span_attrs
 
@@ -876,13 +934,21 @@ def apply_recalibration_dispatch(
         _tele.SPAN_BQSR_APPLY_DISPATCH, backend=backend, **attrs,
     ):
         return _apply_dispatch_impl(
-            ds, phred_table, gl, backend, device, mesh
+            ds, phred_table, gl, backend, device, mesh, pack
         )
+
+
+def _apply_pack_lens(b) -> np.ndarray:
+    """Host copy of the fused kernel's per-row packed byte counts (the
+    offsets side of the Arrow layout — derived here, never fetched)."""
+    from adam_tpu.ops.colpack import pack_lengths
+
+    return pack_lengths(b.lengths, b.valid, b.has_qual)
 
 
 def _apply_dispatch_impl(
     ds: AlignmentDataset, phred_table, gl: int, backend: str, device=None,
-    mesh=None,
+    mesh=None, pack: bool = False,
 ):
     b = ds.batch.to_numpy()
     if backend == "device" and mesh is not None:
@@ -896,18 +962,41 @@ def _apply_dispatch_impl(
         glc = grid_cols(L)
         n_rg = phred_table.shape[0]
         n_cyc = phred_table.shape[2]
+        args = (
+            pad_rows_np(b.bases, gm, schema.BASE_PAD, cols=glc),
+            pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=glc),
+            pad_rows_np(b.lengths, gm, 0),
+            pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
+            pad_rows_np(b.read_group_idx, gm, -1),
+            pad_rows_np(b.has_qual, gm, False),
+            pad_rows_np(b.valid, gm, False),
+        )
+        if pack:
+            pack_lens = _apply_pack_lens(b)
+
+            def dispatch_mesh_pack():
+                faults.point("device.dispatch")
+                packed = mesh.apply_pack_window(args, phred_table, glc)
+                # per-shard exact payload slices: shard k's segment of
+                # the flat output holds exactly its rows' packed bytes
+                # at the segment start (host-known lengths -> host-known
+                # split; nothing but real column bytes ever fetches)
+                return mesh.packed_payload_slices(
+                    packed, pad_rows_np(pack_lens, gm, 0), glc
+                )
+
+            with compile_ledger.track(
+                ("mesh.apply_pack", gm, glc, n_rg, n_cyc),
+                mesh.ledger_key(),
+            ):
+                slices = _retry.retry_call(
+                    dispatch_mesh_pack, site="bqsr.apply.dispatch"
+                )
+            return ds, b, ("packed", slices, pack_lens)
 
         def dispatch_mesh():
             faults.point("device.dispatch")
-            return mesh.apply_window((
-                pad_rows_np(b.bases, gm, schema.BASE_PAD, cols=glc),
-                pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=glc),
-                pad_rows_np(b.lengths, gm, 0),
-                pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
-                pad_rows_np(b.read_group_idx, gm, -1),
-                pad_rows_np(b.has_qual, gm, False),
-                pad_rows_np(b.valid, gm, False),
-            ), phred_table, glc)[:n, :L]
+            return mesh.apply_window(args, phred_table, glc)[:n, :L]
 
         with compile_ledger.track(
             ("mesh.apply", gm, glc, n_rg, n_cyc), mesh.ledger_key()
@@ -929,13 +1018,12 @@ def _apply_dispatch_impl(
 
         _put = putter(device)
 
-        def dispatch():
-            faults.point("device.dispatch", device=device)
+        def _placed_args():
             if isinstance(phred_table, np.ndarray):
                 tbl = _put(np.ascontiguousarray(phred_table, np.uint8))
             else:
                 tbl = phred_table  # device-resident (pool-replicated)
-            return apply_table_kernel(
+            return (
                 _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=glc)),
                 _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=glc)),
                 _put(pad_rows_np(b.lengths, g, 0)),
@@ -944,13 +1032,43 @@ def _apply_dispatch_impl(
                 _put(pad_rows_np(b.has_qual, g, False)),
                 _put(pad_rows_np(b.valid, g, False)),
                 tbl,
-                glc,
-            )[:n, :L]  # device-side slice: fetch only real rows/lanes
+            )
 
         from adam_tpu.utils import compile_ledger
 
         n_rg = phred_table.shape[0]
         n_cyc = phred_table.shape[2]
+        if pack:
+            from adam_tpu.ops.colpack import fetch_grid
+
+            pack_lens = _apply_pack_lens(b)
+            total = int(pack_lens.sum())
+            # bucketed device-side slice (over-fetch < 6.25%, host
+            # trims): an exact per-window size would compile one slice
+            # program per window
+            cut = min(g * glc, fetch_grid(total))
+
+            def dispatch_pack():
+                faults.point("device.dispatch", device=device)
+                packed = apply_pack_kernel(*_placed_args(), glc, g * glc)
+                return packed[:cut]
+
+            # ledger key == apply_pack_prewarm_entry's key (the pass-C
+            # re-warm compiles the fused kernel at the solved width)
+            with compile_ledger.track(
+                ("bqsr.apply_pack", g, glc, n_rg, n_cyc), device
+            ):
+                packed_dev = _retry.retry_call(
+                    dispatch_pack, site="bqsr.apply.dispatch"
+                )
+            return ds, b, ("packed", [(packed_dev, total)], pack_lens)
+
+        def dispatch():
+            faults.point("device.dispatch", device=device)
+            return apply_table_kernel(
+                *_placed_args(), glc,
+            )[:n, :L]  # device-side slice: fetch only real rows/lanes
+
         # ledger key == the prewarm/apply_prewarm_entry key: the pass-C
         # re-warm compiles against the SOLVED table's width, and an
         # in-window miss here is exactly the "wider merged table"
@@ -982,15 +1100,54 @@ def apply_handle_dataset(handle) -> AlignmentDataset:
     return handle[0]
 
 
+def _handle_is_packed(handle) -> bool:
+    payload = handle[2]
+    return isinstance(payload, tuple) and payload[0] == "packed"
+
+
 def apply_recalibration_finish(handle) -> AlignmentDataset:
     """Fetch a dispatched window (chunked transfer for device results)
     and finish the host half: stash pre-recalibration quals as OQ."""
     from adam_tpu.utils.transfer import device_fetch
 
+    if _handle_is_packed(handle):
+        return apply_recalibration_finish_packed(handle)[0]
     ds, b, new_quals = handle
     with _tele.TRACE.span(_tele.SPAN_BQSR_APPLY_FETCH):
         new_quals = device_fetch(new_quals)
     return _stash_orig_quals(ds, b, new_quals)
+
+
+def apply_recalibration_finish_packed(handle):
+    """Finish one dispatched window -> ``(dataset, PackedQuals | None)``.
+
+    A ``pack=True`` handle fetches the flat encode-ready qual payload —
+    ``sum(lengths)`` bytes, one slice per resident shard — and returns
+    it beside the dataset (whose batch keeps its PRE-recalibration
+    quals: the OQ stash is the only remaining consumer of the matrix,
+    and the writer encodes the qual column straight off the packed
+    buffer).  A plain handle behaves exactly like
+    :func:`apply_recalibration_finish` and returns ``packed=None``."""
+    from adam_tpu.io.arrow_pack import PackedQuals
+    from adam_tpu.utils.transfer import device_fetch
+
+    if not _handle_is_packed(handle):
+        return apply_recalibration_finish(handle), None
+    ds, b, (_tag, slices, pack_lens) = handle
+    with _tele.TRACE.span(_tele.SPAN_BQSR_APPLY_FETCH):
+        # each slice is bucket-quantized (colpack.fetch_grid) so slice
+        # programs stay few; the true payload size rides alongside and
+        # the host trims the bucket tail here
+        parts = [
+            np.asarray(device_fetch(s))[:t] for s, t in slices
+        ]
+    if len(parts) == 1:
+        buf = parts[0]
+    elif parts:
+        buf = np.concatenate(parts)
+    else:  # every row qual-less: a valid, all-null column
+        buf = np.zeros(0, np.uint8)
+    return _stash_orig_quals(ds, b), PackedQuals(buf, pack_lens)
 
 
 def apply_recalibration(
@@ -1045,12 +1202,14 @@ def _apply_table_np(b, phred_table: np.ndarray, gl: int) -> np.ndarray:
 
 
 def _stash_orig_quals(
-    ds: AlignmentDataset, b, new_quals: np.ndarray
+    ds: AlignmentDataset, b, new_quals: np.ndarray | None = None
 ) -> AlignmentDataset:
     """Install recalibrated quals and stash the pre-recalibration matrix
     as OQ (setOrigQual, Recalibrator.scala:36-40) — vectorized: encode
     the old qual matrix as a string column and merge it into rows that
-    had no OQ yet."""
+    had no OQ yet.  ``new_quals=None`` (the packed pass-C path) stashes
+    OQ only and keeps the batch's quals untouched: the recalibrated
+    column travels as the packed payload, never as a matrix."""
     from dataclasses import replace as dc_replace
 
     from adam_tpu import native
@@ -1077,6 +1236,8 @@ def _stash_orig_quals(
     else:
         merged = StringColumn.where(set_mask, stashed, old_oq)
     new_side = dc_replace(side, orig_quals=merged)
+    if new_quals is None:
+        return ds.with_batch(b, new_side)
     return ds.with_batch(
         b.replace(quals=np.asarray(new_quals)), new_side
     )

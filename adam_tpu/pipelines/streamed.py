@@ -433,6 +433,17 @@ def _transform_streamed_impl(
         "mode": "mesh" if mesh_part is not None else "pool",
     }
     stats["partitioner"] = exec_state["mode"]
+    # pass-C packed-column fetch (ADAM_TPU_PACKED_COLS, default on for
+    # the device backend): the apply kernel emits the flat encode-ready
+    # SANGER qual payload on device and the d2h fetch ships
+    # sum(lengths) bytes instead of the [N, L] matrix; the writer pool
+    # assembles the arrow column zero-copy over the fetched buffer
+    # (io/arrow_pack).  Host/degraded windows fall back to the matrix
+    # path, byte-identically.
+    from adam_tpu.ops.colpack import packed_columns_enabled
+
+    use_packed = use_device and packed_columns_enabled()
+    stats["packed_columns"] = use_packed
     # pass-B windows folded into the mesh's device-resident observe
     # accumulator, kept referenced so a degrade can replay them through
     # the pool/host path; the host-side merge lists live up here too so
@@ -721,6 +732,7 @@ def _transform_streamed_impl(
                     streamed_prewarm_entries(
                         b, n_rg, mark_duplicates=mark_duplicates,
                         recalibrate=recalibrate,
+                        packed_apply=use_packed,
                     ),
                     tracer=tr,
                 )
@@ -1282,9 +1294,12 @@ def _transform_streamed_impl(
         if idx is not None:
             journal.record_window(idx, os.path.basename(p))
 
-    # 3 parts in flight: one writing, one encoding, one being applied/
-    # submitted — each stage's resource stays busy without the pool
-    # pinning more than 3 decoded windows
+    # 3 parts in flight to start: one writing, one encoding, one being
+    # applied/submitted — each stage's resource stays busy.  Under
+    # adaptive sizing the pool may widen admission while submits gate,
+    # but never past 2x this bound (each admitted part pins a decoded
+    # window, so the cap is a memory bound as much as a concurrency
+    # one — io/parquet.PartWriterPool).
     pool = PartWriterPool(
         n_encoders=max(1, n_writers - 1), inflight_parts=3,
         compression=compression,
@@ -1292,7 +1307,7 @@ def _transform_streamed_impl(
         tracer=tr,
     )
 
-    def _submit(idx, ds):
+    def _submit(idx, ds, packed=None):
         # multi-job fairness / graceful drain: one grant per output
         # part.  A RunCancelled here is caught by the pass-C wrapper
         # below, which closes the writer pool GRACEFULLY — this part is
@@ -1303,7 +1318,7 @@ def _transform_streamed_impl(
         # chaos-harness kill point: one arrival per fresh part submit
         faults.point("proc.kill", device="pass_c")
         pool.submit(_part_path(out_path, idx), ds.batch, ds.sidecar,
-                    ds.header)
+                    ds.header, packed=packed)
 
     def _apply_parts_mesh(plist):
         """Mesh pass C: the solved table places ONCE, replicated, and
@@ -1329,7 +1344,7 @@ def _transform_streamed_impl(
                 [
                     part_mod.mesh_apply_prewarm_entry(
                         w.batch.to_numpy(), table.shape[0],
-                        table.shape[2], mp,
+                        table.shape[2], mp, pack=use_packed,
                     )
                     for w in seen_dims.values()
                 ],
@@ -1378,7 +1393,8 @@ def _transform_streamed_impl(
                         device="mesh",
                     ):
                         handle = bqsr_mod.apply_recalibration_dispatch(
-                            w, tbl_dev, gl, backend, mesh=mp
+                            w, tbl_dev, gl, backend, mesh=mp,
+                            pack=use_packed,
                         )
                 except Exception as e:
                     return _remainder(e, "pass-C apply dispatch")
@@ -1394,7 +1410,11 @@ def _transform_streamed_impl(
                 with tr.span(
                     tele.SPAN_APPLY_FETCH, window=p_idx, device="mesh",
                 ):
-                    done = bqsr_mod.apply_recalibration_finish(p_handle)
+                    done, p_packed = (
+                        bqsr_mod.apply_recalibration_finish_packed(
+                            p_handle
+                        )
+                    )
             except Exception as e:
                 return _remainder(e, "pass-C apply fetch")
             pend.popleft()
@@ -1403,7 +1423,7 @@ def _transform_streamed_impl(
             # pool fail-fast error is an output failure, not a mesh
             # failure — it must abort the run with its own attribution,
             # never trigger a degrade-and-replay
-            _submit(p_idx, done)
+            _submit(p_idx, done, p_packed)
             if p_idx < len(windows):
                 windows[p_idx] = None  # free as we go
         return []
@@ -1442,16 +1462,20 @@ def _transform_streamed_impl(
                     (bw.n_rows, bw.lmax), item[1]
                 )
             t_pwc = time.monotonic_ns()
-            dpool.prewarm(
-                [
-                    apply_prewarm_entry(
-                        w.batch.to_numpy(), table.shape[0],
-                        table.shape[2],
-                    )
-                    for w in seen_dims.values()
-                ],
-                tracer=tr,
-            )
+            pw_entries = []
+            for w in seen_dims.values():
+                bw = w.batch.to_numpy()
+                pw_entries.append(apply_prewarm_entry(
+                    bw, table.shape[0], table.shape[2],
+                    pack=use_packed,
+                ))
+                if use_packed:
+                    # eviction replays re-apply with pack=False on a
+                    # survivor: the plain gather must be warm too
+                    pw_entries.append(apply_prewarm_entry(
+                        bw, table.shape[0], table.shape[2],
+                    ))
+            dpool.prewarm(pw_entries, tracer=tr)
             # umbrella wall for the re-warm: the stats view
             # folds it into prewarm_s and subtracts it from
             # apply_split_s, so compile time never shows up as
@@ -1501,20 +1525,27 @@ def _transform_streamed_impl(
         def _fetch_one():
             p_idx, p_dev, p_handle = pend_q.popleft()
             attrs = dp_mod.span_attrs(p_dev)
+            p_packed = None
             try:
                 with tr.span(
                     tele.SPAN_APPLY_FETCH, window=p_idx, **attrs
                 ):
-                    done = bqsr_mod.apply_recalibration_finish(
-                        p_handle
+                    done, p_packed = (
+                        bqsr_mod.apply_recalibration_finish_packed(
+                            p_handle
+                        )
                     )
                 tr.count(tele.C_DEVICE_FETCHED)
             except Exception as e:
+                # the replay re-applies synchronously (survivor chip or
+                # host backend) and returns a matrix-path dataset —
+                # its part encodes through the legacy column builders
                 done = _replay_apply(
                     p_idx, p_dev,
                     bqsr_mod.apply_handle_dataset(p_handle), e,
                 )
-            _submit(p_idx, done)
+                p_packed = None
+            _submit(p_idx, done, p_packed)
 
         for j in range(len(plist)):
             idx, w = plist[j]
@@ -1527,7 +1558,7 @@ def _transform_streamed_impl(
                 ):
                     handle = bqsr_mod.apply_recalibration_dispatch(
                         w, _device_table(dev), gl, backend,
-                        device=dev,
+                        device=dev, pack=use_packed,
                     )
                 tr.count(tele.C_DEVICE_DISPATCHED)
                 return dev, handle

@@ -30,6 +30,11 @@ from adam_tpu.staticcheck.rules._astutil import dotted_name
 SCOPE_FILES = frozenset({
     "adam_tpu/pipelines/checkpoint.py",
     "adam_tpu/io/parquet.py",
+    # the zero-copy column assembly feeds the part writer's encode
+    # stage: it must never open/publish files of its own — any write
+    # it grew would bypass the staging + durable-publish protocol the
+    # sharded writer pool guarantees per part
+    "adam_tpu/io/arrow_pack.py",
     "adam_tpu/pipelines/streamed.py",
     # the multi-job scheduler's JOB.json records gate crash recovery:
     # they must publish through utils/durability like every other

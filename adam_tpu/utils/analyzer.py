@@ -288,6 +288,30 @@ def _stage_decomposition(span_totals: dict, wall: Optional[float],
     return out
 
 
+def _write_tail_report(counters: dict) -> dict:
+    """Write-tail byte decomposition: decoded column payload entering
+    the part encodes (``parquet.encode.bytes_in``), assembled arrow
+    bytes handed to the writers (``parquet.encode.bytes_out``), and
+    compressed bytes on disk (``parquet.bytes.written``) — with the
+    encode shrink and the codec's compression ratio, so the packed-
+    column path's effect on the tail is a one-line read."""
+    bytes_in = counters.get(tele.C_ENCODE_BYTES_IN)
+    bytes_out = counters.get(tele.C_ENCODE_BYTES_OUT)
+    written = counters.get(tele.C_BYTES_WRITTEN)
+    if not bytes_in and not bytes_out:
+        return {}
+    out = {
+        "encode_bytes_in": bytes_in or 0,
+        "encode_bytes_out": bytes_out or 0,
+        "bytes_written": written or 0,
+    }
+    if bytes_in and bytes_out:
+        out["encode_ratio"] = round(bytes_in / bytes_out, 3)
+    if bytes_out and written:
+        out["compression_ratio"] = round(bytes_out / written, 3)
+    return out
+
+
 def _partitioner_mode(counters: dict, devices: dict) -> Optional[str]:
     """The run's execution partitioner, derived from the ledger: mesh
     collective dispatches present -> "mesh" ("mesh->pool" when the run
@@ -513,11 +537,15 @@ def analyze(doc: dict) -> dict:
         "transfers": _transfer_report(doc, counters),
         "compiles": _compile_report(doc, counters),
         "hbm": _hbm_report(doc, devices),
+        # the write-tail byte decomposition (encode in -> arrow out ->
+        # parquet on disk) beside the stage walls it explains
+        "write_tail": _write_tail_report(counters),
         "counters": {
             k: counters[k]
             for k in (
                 tele.C_READS_INGESTED, tele.C_WINDOWS_INGESTED,
                 tele.C_PARTS_WRITTEN, tele.C_BYTES_WRITTEN,
+                tele.C_ENCODE_BYTES_IN, tele.C_ENCODE_BYTES_OUT,
                 tele.C_H2D_BYTES, tele.C_D2H_BYTES,
                 tele.C_COMPILE_HITS, tele.C_COMPILE_MISSES,
                 tele.C_COMPILE_IN_WINDOW,
@@ -683,6 +711,18 @@ def render_report(report: dict) -> str:
             tag = f"  [{sort} sort]" if sort else ""
             out.append(
                 f"  {key.ljust(w)}  {_fmt_s(row['total_s']):>9} s{pct}{tag}"
+            )
+        wt = report.get("write_tail") or {}
+        if wt:
+            enc_r = wt.get("encode_ratio")
+            comp_r = wt.get("compression_ratio")
+            out.append(
+                "  write-tail bytes: encode in "
+                f"{_fmt_bytes(wt['encode_bytes_in'])} -> arrow "
+                f"{_fmt_bytes(wt['encode_bytes_out'])}"
+                + (f" ({enc_r:g}x in/out)" if enc_r else "")
+                + f" -> parquet {_fmt_bytes(wt['bytes_written'])}"
+                + (f" ({comp_r:g}x compression)" if comp_r else "")
             )
     cpath = report.get("critical_path")
     if cpath:

@@ -78,6 +78,26 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def env_toggle(name: str, default: bool) -> bool:
+    """Tolerantly parsed boolean env toggle — THE shared parser for
+    ``ADAM_TPU_*`` on/off knobs (packed columns, writer adaptivity, …):
+    ``auto``/unset -> ``default``; ``1/on/true`` and ``0/off/false``
+    force; anything else warns (naming the full accepted set) and keeps
+    the default."""
+    raw = os.environ.get(name, "").strip().lower()
+    if raw in ("", "auto"):
+        return default
+    if raw in ("1", "on", "true"):
+        return True
+    if raw in ("0", "off", "false"):
+        return False
+    log.warning(
+        "%s=%r is not one of (auto, 0/off/false, 1/on/true); using the "
+        "default", name, raw,
+    )
+    return default
+
+
 def _env_seed(name: str, default: int) -> int:
     """Any-int env var (seeds may legitimately be 0 or negative)."""
     raw = os.environ.get(name, "").strip()

@@ -166,6 +166,16 @@ C_DEVICE_FETCHED = _metric("device.windows.fetched")
 C_BYTES_ENCODED = _metric("parquet.bytes.encoded")
 C_BYTES_WRITTEN = _metric("parquet.bytes.written")
 C_PARTS_WRITTEN = _metric("parquet.parts.written")
+# part-encode byte accounting (io/parquet._count_encode_bytes):
+# bytes_in = the decoded column payload entering a part encode (batch
+# matrices + sidecar string buffers, the qual matrix replaced by the
+# device-packed payload when pass C shipped one), bytes_out = the
+# assembled arrow table handed to the writer.  Together they make the
+# packed-column encode shrink directly visible in --metrics-json
+# snapshots, and `adam-tpu analyze` prints the in->out->disk ratio in
+# its write-tail decomposition.
+C_ENCODE_BYTES_IN = _metric("parquet.encode.bytes_in")
+C_ENCODE_BYTES_OUT = _metric("parquet.encode.bytes_out")
 C_CANDIDATE_ROWS = _metric("realign.candidate_rows")
 C_POOL_PREWARM_COMPILES = _metric("device.pool.prewarm.compiles")
 # resilience counters: injected faults (utils/faults.point), retry
@@ -215,6 +225,12 @@ C_GW_BYTES_OUT = _metric("gateway.bytes_out")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
+# the writer pool's LIVE admission bound (parts allowed in flight):
+# starts at the construction inflight_parts and grows one part at a
+# time while submits measurably gate (adaptive sizing, bounded by the
+# scheduling affinity) — a run whose last value exceeds its first was
+# writer-bound long enough for the pool to widen itself
+G_POOL_BOUND = _metric("parquet.pool.inflight_bound")
 G_DEVICE_INFLIGHT = _metric("device.dispatch.in_flight")
 G_OBSERVE_HIDDEN = _metric("streamed.observe_overlap_hidden")
 G_POOL_DEVICES = _metric("device.pool.devices")

@@ -65,6 +65,9 @@ def make_wgs(
     dup_frac: float = 0.10,
     clip_frac: float = 0.05,
     unmapped_frac: float = 0.01,
+    trimmed_frac: float = 0.0,
+    trimmed_min: int = 22,
+    trimmed_max: int = 32,
 ) -> None:
     rng = np.random.default_rng(seed)
     contigs = [f"chr{i + 17}" for i in range(n_contigs)]
@@ -140,6 +143,18 @@ def make_wgs(
         read_len,
         rng.integers(int(read_len * 0.6), read_len, n_reads),
     ).astype(np.int32)
+    if trimmed_frac > 0.0:
+        # trimmed-library shape (adapter-trimmed short-insert runs,
+        # small-RNA 22-30 nt reads): a large fraction of reads carry a
+        # small fraction of the instrument read length, while the
+        # occasional untrimmed read keeps the window's Lmax at
+        # read_len — the regime where dense [N, L] matrices carry
+        # mostly padding and packed columns pay (docs/PERF.md)
+        lens = np.where(
+            rng.random(n_reads) < trimmed_frac,
+            rng.integers(trimmed_min, trimmed_max + 1, n_reads),
+            lens,
+        ).astype(np.int32)
     clip = np.where(
         rng.random(n_reads) < clip_frac, rng.integers(3, 12, n_reads), 0
     ).astype(np.int32)
@@ -330,7 +345,19 @@ if __name__ == "__main__":
     ap.add_argument("--reads", type=int, default=1_000_000)
     ap.add_argument("--len", type=int, default=100, dest="read_len")
     ap.add_argument("--known-sites", default=None)
+    ap.add_argument(
+        "--trimmed-frac", type=float, default=0.0,
+        help="fraction of reads hard-trimmed to a small-RNA-like "
+             "length (default 0 = classic WGS length mix)",
+    )
+    ap.add_argument("--trimmed-min", type=int, default=22)
+    ap.add_argument("--trimmed-max", type=int, default=32)
     args = ap.parse_args()
     make_wgs(args.path, args.reads, args.read_len,
-             known_sites_out=args.known_sites)
-    print(f"wrote {args.path}: {args.reads} reads x {args.read_len}bp")
+             known_sites_out=args.known_sites,
+             trimmed_frac=args.trimmed_frac,
+             trimmed_min=args.trimmed_min, trimmed_max=args.trimmed_max)
+    print(f"wrote {args.path}: {args.reads} reads x {args.read_len}bp"
+          + (f" ({args.trimmed_frac:.0%} trimmed to "
+             f"{args.trimmed_min}-{args.trimmed_max}bp)"
+             if args.trimmed_frac else ""))
