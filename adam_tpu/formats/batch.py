@@ -64,6 +64,19 @@ def grid_cols(n: int, mult: int = 32) -> int:
     return _round_up(max(int(n), 1), mult)
 
 
+def grid_cigar_cols(width: int) -> int:
+    """Cigar-op grid: multiples of 8 instead of :func:`grid_cols`'s 32.
+
+    Op counts are small (typically < 16 on short-read libraries) while
+    the [N, C] i32 ``cigar_lens`` matrix ships host->device with every
+    pass-A markdup window — at the 32-floor, 3/4 of those tunnel bytes
+    were pure padding zeros.  Multiples of 8 stay sublane-aligned for
+    the i32 lens (and trivially for the u8 ops) and keep the compile-
+    cache shape set bounded; the streamed first-sight re-prewarm covers
+    the extra gc values a long-cigar window can introduce."""
+    return grid_cols(width, mult=8)
+
+
 def pad_rows_np(arr, n: int, fill=0, cols: int | None = None):
     """Pad a numpy array's leading axis up to ``n`` rows (and, for 2-d
     arrays when ``cols`` is given, the second axis up to ``cols``) with

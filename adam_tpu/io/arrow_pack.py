@@ -72,6 +72,23 @@ class PackedQuals:
         )
 
 
+@dataclass(frozen=True)
+class PackedColumns:
+    """The pass-C packed payload pair of a device-resident window: the
+    qual column AND the base column (the bases half of the packed
+    tail), each a :class:`PackedQuals`-shaped (buf, lens) payload.
+    ``bases`` may be None (quals-only packing, the PR 12 layout)."""
+
+    quals: PackedQuals
+    bases: "PackedQuals | None" = None
+
+    def take(self, rows: np.ndarray) -> "PackedColumns":
+        return PackedColumns(
+            self.quals.take(rows),
+            self.bases.take(rows) if self.bases is not None else None,
+        )
+
+
 def packed_qual_array(packed: PackedQuals, valid: np.ndarray) -> "pa.Array":
     """Packed qual payload -> the Arrow ``large_string`` column, built
     over the fetched buffer with zero copies (``valid`` = the rows that
@@ -79,6 +96,18 @@ def packed_qual_array(packed: PackedQuals, valid: np.ndarray) -> "pa.Array":
     nulls — the legacy ``decoded_col`` semantics exactly)."""
     return StringColumn(
         packed.buf, packed.offsets(), np.asarray(valid, bool)
+    ).to_arrow()
+
+
+def packed_base_array(packed: PackedQuals) -> "pa.Array":
+    """Packed base payload -> the Arrow ``sequence`` column, zero-copy
+    over the fetched buffer.  Every kept row carries its sequence (the
+    legacy path builds the column with an all-true validity), so the
+    validity is all-valid by construction — byte-identical to the host
+    LUT-walk column."""
+    n = len(packed.lens)
+    return StringColumn(
+        packed.buf, packed.offsets(), np.ones(n, bool)
     ).to_arrow()
 
 

@@ -237,11 +237,20 @@ def to_arrow_alignments(
 
     ``packed``: an optional :class:`~adam_tpu.io.arrow_pack.PackedQuals`
     — the device-packed encode-ready qual payload from the streamed
-    pass C.  When given, the ``qual`` column is built zero-copy over
-    that buffer and the batch's qual matrix is never touched; output is
-    byte-identical to the matrix path (tests/test_arrow_pack.py).
+    pass C — or a :class:`~adam_tpu.io.arrow_pack.PackedColumns`
+    carrying the base column too (the resident-window bases half).
+    When given, the ``qual`` (and ``sequence``) columns are built
+    zero-copy over those buffers and the batch's matrices are never
+    touched; output is byte-identical to the matrix path
+    (tests/test_arrow_pack.py, tests/test_resident.py).
     """
     from adam_tpu.formats.strings import StringColumn
+    from adam_tpu.io.arrow_pack import PackedColumns
+
+    packed_bases = None
+    if isinstance(packed, PackedColumns):
+        packed_bases = packed.bases
+        packed = packed.quals
 
     b = batch.to_numpy()
     valid = np.asarray(b.valid)
@@ -255,6 +264,8 @@ def to_arrow_alignments(
         if packed is not None:
             # invalid rows carry no packed bytes, so this is offsets-only
             packed = packed.take(rows)
+        if packed_bases is not None:
+            packed_bases = packed_bases.take(rows)
     n = b.n_rows
 
     def masked_int(vals, dtype):
@@ -275,12 +286,16 @@ def to_arrow_alignments(
     table = pa.table(
         {
             "readName": StringColumn.of(side.names).to_arrow(),
-            "sequence": decoded_col(
-                b.bases, schema.BASE_DECODE_LUT256,
-                lambda m: schema.BASE_DECODE_LUT[
-                    np.minimum(m, schema.BASE_PAD)
-                ],
-                np.ones(n, bool),
+            "sequence": (
+                _packed_base_col(packed_bases)
+                if packed_bases is not None
+                else decoded_col(
+                    b.bases, schema.BASE_DECODE_LUT256,
+                    lambda m: schema.BASE_DECODE_LUT[
+                        np.minimum(m, schema.BASE_PAD)
+                    ],
+                    np.ones(n, bool),
+                )
             ),
             "qual": (
                 _packed_qual_col(packed, b)
@@ -330,6 +345,13 @@ def _packed_qual_col(packed, b) -> "pa.Array":
     return packed_qual_array(packed, np.asarray(b.has_qual))
 
 
+def _packed_base_col(packed) -> "pa.Array":
+    """Device-packed payload -> the arrow sequence column (zero-copy)."""
+    from adam_tpu.io.arrow_pack import packed_base_array
+
+    return packed_base_array(packed)
+
+
 def _encode_bytes_in(batch, side, packed=None) -> int:
     """Decoded column-payload bytes entering a part encode — the
     [N, L]/[N, C] batch matrices plus the sidecar's flat string
@@ -338,11 +360,20 @@ def _encode_bytes_in(batch, side, packed=None) -> int:
     counter; against ``bytes_out`` (the assembled arrow table) it makes
     the packed-column encode shrink directly visible in
     ``--metrics-json`` snapshots and ``adam-tpu analyze``."""
+    from adam_tpu.io.arrow_pack import PackedColumns
+
+    packed_bases = None
+    if isinstance(packed, PackedColumns):
+        packed_bases = packed.bases
+        packed = packed.quals
     total = 0
     for name in ("bases", "quals", "cigar_ops", "cigar_lens"):
         arr = getattr(batch, name, None)
         if name == "quals" and packed is not None:
             total += int(getattr(packed.buf, "nbytes", 0))
+            continue
+        if name == "bases" and packed_bases is not None:
+            total += int(getattr(packed_bases.buf, "nbytes", 0))
             continue
         total += int(getattr(arr, "nbytes", 0) or 0)
     for name in ("names", "attrs", "md", "orig_quals"):

@@ -101,6 +101,37 @@ def sanger_body(quals):
     ).astype(jnp.uint8)
 
 
+def base_decode_body(bases):
+    """Traceable base decode of a code matrix — the device twin of
+    ``schema.BASE_DECODE_LUT256`` (code -> ACGTN. ASCII), so packed
+    base buffers come home ready to BE the Arrow ``sequence`` column
+    data (the bases half of the packed tail: with the window resident
+    on device, decoding there costs one tiny gather instead of a host
+    LUT walk per part)."""
+    return jnp.asarray(schema.BASE_DECODE_LUT256)[bases.astype(jnp.uint8)]
+
+
+def pack_mask_bits(mask: np.ndarray) -> np.ndarray:
+    """Bit-pack a host boolean [N, L] mask along its lane axis ->
+    u8[N, ceil(L/8)] (``np.packbits`` big-endian layout).
+
+    The resident-window observe dispatch ships its per-pass masks
+    (residue_ok / is_mismatch — the only per-residue inputs that are
+    genuinely host-derived, from the MD-tag walk) packed 8x, so the
+    observe pass's h2d ledger entry stays ~0 next to the one ingest
+    placement.  :func:`unpack_mask_body` is the device-side inverse."""
+    return np.packbits(np.asarray(mask, bool), axis=1)
+
+
+def unpack_mask_body(packed, n_cols: int):
+    """Traceable inverse of :func:`pack_mask_bits`: u8[N, ceil(L/8)] ->
+    bool[N, n_cols] (``n_cols`` static; trailing pad bits drop)."""
+    shifts = (7 - jnp.arange(8, dtype=jnp.uint8))[None, None, :]
+    bits = (packed[:, :, None] >> shifts) & jnp.uint8(1)
+    n = packed.shape[0]
+    return bits.reshape(n, -1)[:, :n_cols].astype(bool)
+
+
 def fetch_grid(nbytes: int, floor: int = 4096) -> int:
     """Quantize a packed-payload byte count up to a coarse fetch
     bucket: the next multiple of 1/16th of its power-of-two scale

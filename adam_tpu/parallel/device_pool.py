@@ -215,6 +215,175 @@ def putter(device=None):
     return put
 
 
+def donation_ok(device=None) -> bool:
+    """Whether jit buffer donation actually pays on ``device`` (the
+    default device when None): CPU runtimes ignore ``donate_argnums``
+    with a warning, so the donating jit variants — distinct executables
+    — are only built, warmed and dispatched on real accelerators.  The
+    ONE donation decision shared by every dispatch site AND the prewarm
+    entry builders, so the two can never pick different variants (which
+    would cold-compile the dispatched twin inside a window)."""
+    try:
+        if device is not None:
+            return getattr(device, "platform", "cpu") != "cpu"
+        import jax
+
+        return jax.devices()[0].platform != "cpu"
+    except Exception:
+        return False
+
+
+def resident_windows_enabled(default: bool = True) -> bool:
+    """Resolve the ``ADAM_TPU_RESIDENT`` toggle for device-resident
+    windows: ``auto``/unset -> ``default`` (on wherever the device
+    backend runs), ``1/on/true`` and ``0/off/false`` force; a typo
+    warns and keeps the default (``utils/retry.env_toggle``, the shared
+    tuning-var contract — same parser as ``ADAM_TPU_PACKED_COLS``).
+
+    Precedence (documented in docs/PERF.md "Device-resident windows"):
+    the backend decides first (``ADAM_TPU_BQSR_BACKEND`` — residency
+    exists only under ``device``; host backends have no device to be
+    resident on), then ``--partitioner``/``ADAM_TPU_PARTITIONER``
+    decides the placement SHAPE (pool: per-device pinned; mesh: one
+    batch-sharded placement), and this toggle last decides whether
+    windows stay resident at all — off forces the legacy
+    re-ship-per-pass path on either partitioner.  ``ADAM_TPU_PACKED_COLS``
+    composes the same way: it gates what pass C *fetches* (packed
+    columns vs the [N, L] matrix), residency gates what pass A/B/C
+    *ship*, and the bases half of the packed tail needs both on."""
+    from adam_tpu.utils.retry import env_toggle
+
+    return env_toggle("ADAM_TPU_RESIDENT", default)
+
+
+class ResidentWindow:
+    """One window's ingest-resident device payload: the five arrays
+    every per-residue pass reads (``bases``/``quals`` [g, gl] and
+    ``lengths``/``flags``/``read_group_idx`` [g], grid-padded), placed
+    host->device ONCE when the window is tokenized and dispatched
+    against by markdup keys (pass A), BQSR observe (pass B) and the
+    recalibration apply (pass C) — the ingest-once H2D contract
+    (docs/PERF.md "Device-resident windows"): the ledger's per-pass h2d
+    collapses to one ``ingest`` entry per window, and the later passes
+    ship only their genuinely per-pass inputs (bit-packed MD masks,
+    post-split validity bools).
+
+    The duplicate flags resolved at barrier 1 mutate only the HOST
+    batch — safe, because the device kernels read ``flags`` solely for
+    the orientation bits (reverse/paired/second-of-pair), which markdup
+    never changes; duplicate-dependent filtering enters through the
+    per-pass ``read_ok`` mask computed host-side from the updated
+    flags.  The same reasoning covers the pass-B candidate split: it
+    MASKS rows (geometry preserved), and the updated ``valid``/
+    ``has_qual`` bools ship per pass.
+
+    **Refcounted release**: the streamed pipeline holds ONE base
+    reference per handle and releases it after the window's pass-C
+    fetch, so HBM frees window by window instead of at run end — all
+    passes run on the single driver thread and jax pins the buffers of
+    in-flight executions internally, so no current consumer needs more
+    than that one reference.  :meth:`retain` exists for a consumer
+    that must pin the handle across a genuinely concurrent boundary
+    (none does today — wire it before adding one).  :meth:`drop` is the
+    fault path (device evicted, mesh degraded): the handle dies, every
+    later dispatch falls back to re-shipping from the host-retained
+    ingest copy (``pipelines/streamed.py`` keeps each window's decoded
+    batch until its part publishes — the replay source of truth,
+    docs/ROBUSTNESS.md)."""
+
+    FIELDS = ("bases", "quals", "lengths", "flags", "read_group_idx")
+
+    def __init__(self, window: int, device, arrays: dict, g: int,
+                 gl: int, nbytes: int):
+        self.window = window
+        self.device = device  # a jax device, None (default), or "mesh"
+        self.g = g
+        self.gl = gl
+        self.nbytes = nbytes
+        self._arrays = arrays
+        self._refs = 1
+        self._consumed = False
+        self._lock = threading.Lock()
+
+    @property
+    def alive(self) -> bool:
+        with self._lock:
+            return self._arrays is not None and not self._consumed
+
+    def get(self, name: str):
+        """The resident device array for FIELD ``name`` (raises once
+        released/dropped — callers check :attr:`alive` first)."""
+        with self._lock:
+            if self._arrays is None:
+                raise RuntimeError(
+                    f"resident window {self.window} already released"
+                )
+            return self._arrays[name]
+
+    def args(self) -> tuple:
+        """The five resident arrays in kernel-signature order."""
+        return tuple(self.get(f) for f in self.FIELDS)
+
+    def mark_consumed(self) -> None:
+        """A donating dispatch consumed the bases/quals buffers: the
+        handle stops offering them (a retry after a partial donating
+        execution must re-ship from the host copy, never re-pass a
+        deleted buffer)."""
+        with self._lock:
+            self._consumed = True
+
+    def retain(self) -> None:
+        with self._lock:
+            if self._arrays is not None:
+                self._refs += 1
+
+    def release(self) -> bool:
+        """Drop one reference; True when this call freed the arrays."""
+        with self._lock:
+            if self._arrays is None:
+                return False
+            self._refs -= 1
+            if self._refs > 0:
+                return False
+            self._arrays = None
+            return True
+
+    def drop(self) -> bool:
+        """Force-release regardless of refcount (eviction / mesh
+        degradation); True when the arrays were still held."""
+        with self._lock:
+            held = self._arrays is not None
+            self._arrays = None
+            self._refs = 0
+            return held
+
+
+def make_resident_window(b, window: int, device=None) -> ResidentWindow:
+    """Place one window's resident payload on ``device`` (the pool
+    path's pinned placement; ``None`` = the single-chip default
+    device).  ``b`` is the window batch's numpy view; arrays pad to the
+    (pow2-rows, lane-aligned) grid — exactly the pads the markdup/
+    observe/apply dispatches would have shipped per pass.  Callers wrap
+    this in ``telemetry.pass_scope("ingest")`` so the h2d ledger books
+    the one placement under the ingest bucket."""
+    from adam_tpu.formats import schema
+    from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+
+    g = grid_rows(b.n_rows)
+    gl = grid_cols(b.lmax)
+    _put = putter(device)
+    host = {
+        "bases": pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl),
+        "quals": pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl),
+        "lengths": pad_rows_np(b.lengths, g, 0),
+        "flags": pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED),
+        "read_group_idx": pad_rows_np(b.read_group_idx, g, -1),
+    }
+    nbytes = sum(int(a.nbytes) for a in host.values())
+    arrays = {k: _put(a) for k, a in host.items()}
+    return ResidentWindow(window, device, arrays, g, gl, nbytes)
+
+
 class DevicePool:
     """Round-robin window -> device placement over an explicit device set.
 
@@ -361,6 +530,8 @@ class DevicePool:
         streamed run tracer) so the telemetry snapshot proves the
         compiles happened outside the timed windows.
         """
+        from adam_tpu.utils import compile_ledger
+
         tr = tracer if tracer is not None else tele.TRACE
         todo: list[tuple] = []
         claimed: set = set()
@@ -378,6 +549,11 @@ class DevicePool:
                     if cache_key not in _PREWARMED and cache_key not in claimed:
                         claimed.add(cache_key)
                         todo.append((key, fn, dev, cache_key))
+                    else:
+                        # already warm in this process: re-seed the
+                        # compile ledger, whose claim a faulted run's
+                        # raising dispatch may have handed back
+                        compile_ledger.claim(key, dev)
             _PREWARMED.update(claimed)
         if not todo:
             return 0
@@ -593,7 +769,7 @@ def apply_dummy_args(b, g: int, gl: int) -> tuple:
 
 def streamed_prewarm_entries(
     b, n_rg: int, *, mark_duplicates: bool = True, recalibrate: bool = True,
-    packed_apply: bool = False,
+    packed_apply: bool = False, resident: bool = False,
 ) -> list[tuple]:
     """The grid-quantized kernel set the streamed device path dispatches,
     as prewarm entries derived from the first window's numpy view ``b``
@@ -602,25 +778,41 @@ def streamed_prewarm_entries(
 
     Covers: markdup [N, L] key/score reductions (pass A), the BQSR
     observe scatter-add (pass B), and the apply table-gather (pass C).
+    ``resident=True`` warms the resident-window variants the passes
+    actually dispatch — the bit-packed-mask observe, the fused
+    bases+quals pack2 apply, and (where :func:`donation_ok`) the
+    donating twins — ALONGSIDE the plain kernels, which stay warm as
+    the replay/fallback path.
     """
     import jax
 
-    from adam_tpu.formats.batch import grid_cols, grid_rows
+    from adam_tpu.formats.batch import (
+        grid_cigar_cols, grid_cols, grid_rows,
+    )
 
     g = grid_rows(b.n_rows)
     gl = grid_cols(b.lmax)
-    gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
+    gc = grid_cigar_cols(
+        b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1
+    )
 
     entries: list[tuple] = []
     if mark_duplicates:
         def warm_markdup(dev, g=g, gl=gl, gc=gc):
             from adam_tpu.pipelines.markdup import get_columns_jit
 
-            out = get_columns_jit()(*(
+            args = tuple(
                 jax.device_put(a, dev)
                 for a in markdup_dummy_args(b, g, gl, gc)
-            ))
-            jax.block_until_ready(out)
+            )
+            jax.block_until_ready(get_columns_jit()(*args))
+            if resident and donation_ok(dev):
+                # the resident dispatch donates its per-pass start/
+                # n_ops temporaries — a distinct executable
+                jax.block_until_ready(get_columns_jit(donate=True)(*(
+                    jax.device_put(a, dev)
+                    for a in markdup_dummy_args(b, g, gl, gc)
+                )))
 
         entries.append((("markdup.columns", g, gc, gl), warm_markdup))
 
@@ -635,11 +827,19 @@ def streamed_prewarm_entries(
             jax.block_until_ready(out)
 
         entries.append((("bqsr.observe", g, gl, n_rg), warm_observe))
+        if resident:
+            entries.append(observe_packed_prewarm_entry(b, n_rg))
         # pass A can only assume the solved table will match window 0's
         # grid width; pass C re-warms with the REAL merged width via
         # apply_prewarm_entry (same key space, so uniform-lmax inputs
         # dedupe it to a no-op)
+        if packed_apply and resident:
+            entries.append(_apply_entry(
+                b, n_rg, g, gl, 2 * gl + 1, pack=True, resident=True
+            ))
         if packed_apply:
+            # the quals-only pack stays warm on resident runs too: a
+            # residency miss (evicted handle) re-dispatches through it
             entries.append(
                 _apply_entry(b, n_rg, g, gl, 2 * gl + 1, pack=True)
             )
@@ -647,38 +847,99 @@ def streamed_prewarm_entries(
         # eviction replay path re-applies with pack=False on a
         # survivor, and that dispatch must never cold-compile inside
         # the window it is rescuing
-        entries.append(_apply_entry(b, n_rg, g, gl, 2 * gl + 1))
+        entries.append(_apply_entry(
+            b, n_rg, g, gl, 2 * gl + 1, resident=resident
+        ))
     return entries
 
 
 def _apply_entry(b, n_rg: int, g: int, gl: int, n_cyc: int,
-                 pack: bool = False) -> tuple:
+                 pack: bool = False, resident: bool = False) -> tuple:
     import jax
 
     def warm_apply(dev):
-        from adam_tpu.pipelines.bqsr import (
-            N_DINUC, N_QUAL, apply_pack_kernel, apply_table_kernel,
-        )
+        from adam_tpu.pipelines.bqsr import N_DINUC, N_QUAL, jit_variant
 
-        args = apply_dummy_args(b, g, gl) + (
-            np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8),
-        )
-        placed = tuple(jax.device_put(a, dev) for a in args)
-        if pack:
-            out = apply_pack_kernel(*placed, gl, g * gl)
+        def placed_args():
+            args = apply_dummy_args(b, g, gl) + (
+                np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8),
+            )
+            return tuple(jax.device_put(a, dev) for a in args)
+
+        donate = resident and donation_ok(dev)
+        if pack and resident:
+            kinds = ["apply_pack2"]
+        elif pack:
+            kinds = ["apply_pack"]
         else:
-            out = apply_table_kernel(*placed, gl)
-        jax.block_until_ready(out)
+            kinds = ["apply"]
+        for kind in kinds:
+            if kind == "apply":
+                out = jit_variant(kind, donate)(*placed_args(), gl)
+            else:
+                out = jit_variant(kind, donate)(*placed_args(), gl, g * gl)
+            jax.block_until_ready(out)
+            if donate:
+                # the non-donating twin stays warm beside it: a
+                # consumed-handle retry re-dispatches without donation
+                if kind == "apply":
+                    out = jit_variant(kind, False)(*placed_args(), gl)
+                else:
+                    out = jit_variant(kind, False)(
+                        *placed_args(), gl, g * gl
+                    )
+                jax.block_until_ready(out)
 
-    # two literal key tuples (not one with a computed kernel name): the
+    # literal key tuples (not one with a computed kernel name): the
     # dispatch-ledger rule's prewarm cross-check parses these literals
+    if pack and resident:
+        return (("bqsr.apply_pack2", g, gl, n_rg, n_cyc), warm_apply)
     if pack:
         return (("bqsr.apply_pack", g, gl, n_rg, n_cyc), warm_apply)
     return (("bqsr.apply", g, gl, n_rg, n_cyc), warm_apply)
 
 
+def observe_packed_prewarm_entry(b, n_rg: int) -> tuple:
+    """Prewarm entry for the resident-window observe variant: the
+    bit-packed-mask kernel (``bqsr.observe_packed_body``), donating its
+    mask temporaries where :func:`donation_ok`."""
+    import jax
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+
+    g = grid_rows(b.n_rows)
+    gl = grid_cols(b.lmax)
+
+    def warm_observe_packed(dev, g=g, gl=gl):
+        from adam_tpu.pipelines.bqsr import jit_variant
+
+        def placed_args():
+            base = observe_dummy_args(b, g, gl)
+            # the packed-mask signature: masks ride bit-packed u8
+            args = base[:5] + (
+                np.zeros((g, gl // 8 + (1 if gl % 8 else 0)), np.uint8),
+                np.zeros((g, gl // 8 + (1 if gl % 8 else 0)), np.uint8),
+                base[7],
+            )
+            return tuple(jax.device_put(a, dev) for a in args)
+
+        donate = donation_ok(dev)
+        out = jit_variant("observe_packed", donate)(
+            *placed_args(), n_rg, gl
+        )
+        jax.block_until_ready(out)
+        if donate:
+            out = jit_variant("observe_packed", False)(
+                *placed_args(), n_rg, gl
+            )
+            jax.block_until_ready(out)
+
+    return (("bqsr.observe_packed", g, gl, n_rg), warm_observe_packed)
+
+
 def apply_prewarm_entry(b, n_rg: int, table_n_cyc: int,
-                        pack: bool = False) -> tuple:
+                        pack: bool = False,
+                        resident: bool = False) -> tuple:
     """Pass-C re-warm entry: the apply table-gather keyed by the SOLVED
     table's real cycle width.  ``merge_observations`` widens the table
     to the maximum window grid, which can exceed the window-0 width the
@@ -686,12 +947,15 @@ def apply_prewarm_entry(b, n_rg: int, table_n_cyc: int,
     apply compile inside pass C on variable-length inputs.  Shares the
     pass-A entry's key space, so the uniform-lmax common case dedupes
     to a no-op against the process-wide cache.  ``pack=True`` warms the
-    fused apply+pack kernel (the packed-column pass-C dispatch)."""
+    fused apply+pack kernel (the packed-column pass-C dispatch);
+    ``resident=True`` selects the resident-window variants (the
+    bases+quals pack2 when packed, and the donating twins where
+    :func:`donation_ok`)."""
     from adam_tpu.formats.batch import grid_cols, grid_rows
 
     return _apply_entry(
         b, n_rg, grid_rows(b.n_rows), grid_cols(b.lmax), table_n_cyc,
-        pack=pack,
+        pack=pack, resident=resident,
     )
 
 

@@ -332,6 +332,78 @@ def _mesh_apply_pack_jit_builder(donate: bool):
     return partial(jax.jit, **kw)(run)
 
 
+def _mesh_observe_packed_jit_builder(donate: bool):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from adam_tpu.parallel.mesh import BATCH_AXIS, shard_map
+
+    def run(bases, quals, lengths, flags, rg, res_pk, mm_pk, rd_ok,
+            n_rg, lmax, mesh):
+        from adam_tpu.pipelines.bqsr import observe_packed_body
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=_mesh_specs(8),
+            out_specs=(P(), P()), check_vma=False,
+        )
+        def body(b, q, le, fl, r, rp, mp, ok):
+            # each shard unpacks its own bit-packed mask rows then runs
+            # the exact observe scatter-add; i64 psum keeps the merge
+            # bitwise order-free (the plain mesh observe's contract)
+            total, mism = observe_packed_body(
+                b, q, le, fl, r, rp, mp, ok, n_rg, lmax
+            )
+            return (
+                jax.lax.psum(total, BATCH_AXIS),
+                jax.lax.psum(mism, BATCH_AXIS),
+            )
+
+        return body(bases, quals, lengths, flags, rg, res_pk, mm_pk, rd_ok)
+
+    kw = {"static_argnames": ("n_rg", "lmax", "mesh")}
+    if donate:
+        # the bit-packed masks are per-pass temporaries: dead after the
+        # unpack, so donating them trims the observe HBM footprint
+        kw["donate_argnums"] = (5, 6)
+    return partial(jax.jit, **kw)(run)
+
+
+def _mesh_apply_pack2_jit_builder(donate: bool):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from adam_tpu.parallel.mesh import BATCH_AXIS, shard_map
+
+    def run(bases, quals, lengths, flags, rg, has_qual, valid, table,
+            lmax, mesh):
+        from adam_tpu.pipelines.bqsr import apply_pack2_body
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=_mesh_specs(7) + (P(),),
+            out_specs=(P(BATCH_AXIS), P(BATCH_AXIS)), check_vma=False,
+        )
+        def body(b, q, le, fl, r, hq, v, tbl):
+            # the bases half of the packed tail: each shard fuses the
+            # gather with BOTH column packs over its own row block; the
+            # two global flat outputs are shard payloads in shard order
+            # (== row order), so the host-side per-shard slices of each
+            # reproduce the single-device packs
+            return apply_pack2_body(
+                b, q, le, fl, r, hq, v, tbl, lmax,
+                b.shape[0] * b.shape[1],
+            )
+
+        return body(bases, quals, lengths, flags, rg, has_qual, valid, table)
+
+    kw = {"static_argnames": ("lmax", "mesh")}
+    if donate:
+        # the resident quals buffer becomes the packed qual column and
+        # the resident bases buffer the packed base column (byte sizes
+        # match exactly: [g, gl] u8 vs [g*gl] u8 each)
+        kw["donate_argnums"] = (0, 1)
+    return partial(jax.jit, **kw)(run)
+
+
 def _mesh_markdup_jit_builder():
     import jax
     from jax.sharding import PartitionSpec as P
@@ -375,8 +447,12 @@ def _mesh_jit(kind: str, donate: bool = False):
                 }.get(kind)
                 if builder is not None:
                     fn = builder()
+                elif kind == "observe_packed":
+                    fn = _mesh_observe_packed_jit_builder(donate)
                 elif kind == "apply_pack":
                     fn = _mesh_apply_pack_jit_builder(donate)
+                elif kind == "apply_pack2":
+                    fn = _mesh_apply_pack2_jit_builder(donate)
                 else:
                     fn = _mesh_apply_jit_builder(donate)
                 _MESH_JITS[key] = fn
@@ -525,6 +601,41 @@ class MeshPartitioner:
         # adam-tpu: noqa[dispatch-ledger] reason=every caller (markdup_columns_dispatch mesh branch and the mesh prewarm) wraps this dispatch in its own track keyed mesh.markdup
         return _mesh_jit("markdup")(*placed, mesh=self.mesh)
 
+    def markdup_window_resident(self, rw, fresh: tuple):
+        """Resident-window markdup dispatch: quals/lengths/flags come
+        from ``rw``'s batch-sharded placement (one ingest h2d, reused
+        by every pass) and only the markdup-specific ``fresh``
+        (start, end, cigar ops/lens/n) host arrays ship."""
+        start, end, ops, lens, n_ops = (
+            self.put_rows(a) for a in fresh
+        )
+        # adam-tpu: noqa[dispatch-ledger] reason=every caller (markdup_columns_dispatch mesh branch) wraps this dispatch in its own track keyed mesh.markdup
+        return _mesh_jit("markdup")(
+            start, end, rw.get("flags"), ops, lens, n_ops,
+            rw.get("quals"), rw.get("lengths"), mesh=self.mesh,
+        )
+
+    # ---- resident windows (ingest-once H2D) ----------------------------
+    def observe_packed_window(self, placed: tuple, n_rg: int, gl: int):
+        """Dispatch the bit-packed-mask observe collective over
+        already-placed arrays (the resident dispatch and the prewarm
+        share this seam) -> lazy replicated (total, mism)."""
+        # adam-tpu: noqa[dispatch-ledger] reason=every caller (bqsr._observe_impl mesh resident branch and the mesh prewarm) wraps this dispatch in its own track keyed mesh.observe_packed
+        return _mesh_jit(
+            "observe_packed", donate=self.apply_supports_donation()
+        )(*placed, n_rg=n_rg, lmax=gl, mesh=self.mesh)
+
+    def observe_window_resident(self, rw, res_pk, mm_pk, read_ok,
+                                n_rg: int, gl: int):
+        """Resident-window observe: bases/quals/lengths/flags/rg come
+        from ``rw``; only the bit-packed per-pass masks and the read
+        filter ship (8x + 1x small — the observe h2d ≈ 0 contract)."""
+        placed = rw.args() + (
+            self.put_rows(res_pk), self.put_rows(mm_pk),
+            self.put_rows(read_ok),
+        )
+        return self.observe_packed_window(placed, n_rg, gl)
+
     # ---- pass C: apply with the device-resident table ------------------
     def apply_supports_donation(self) -> bool:
         # buffer donation is a no-op (with a warning) on some CPU
@@ -556,6 +667,40 @@ class MeshPartitioner:
         return _mesh_jit(
             "apply_pack", donate=self.apply_supports_donation()
         )(*placed, table_dev, lmax=gl, mesh=self.mesh)
+
+    def apply_window_resident(self, rw, has_qual, valid, table_dev,
+                              gl: int):
+        """Resident-window plain apply: the five resident arrays plus
+        the post-split ``has_qual``/``valid`` bools (the only per-pass
+        h2d) -> lazy row-sharded u8[g, gl] quals."""
+        placed = rw.args() + (
+            self.put_rows(has_qual), self.put_rows(valid),
+        )
+        # adam-tpu: noqa[dispatch-ledger] reason=every caller (bqsr apply mesh resident branch) wraps this dispatch in its own track keyed mesh.apply
+        return _mesh_jit("apply", donate=self.apply_supports_donation())(
+            *placed, table_dev, lmax=gl, mesh=self.mesh
+        )
+
+    def apply_pack2_placed(self, placed: tuple, table_dev, gl: int):
+        """Dispatch the fused apply + bases+quals pack collective over
+        already-placed arrays (resident dispatch and prewarm share this
+        seam) -> lazy ``(packed_quals, packed_bases)`` flat u8[g*gl]
+        row-sharded pairs."""
+        # adam-tpu: noqa[dispatch-ledger] reason=every caller (bqsr apply_pack2 mesh branch and the mesh prewarm) wraps this dispatch in its own track keyed mesh.apply_pack2
+        return _mesh_jit(
+            "apply_pack2", donate=self.apply_supports_donation()
+        )(*placed, table_dev, lmax=gl, mesh=self.mesh)
+
+    def apply_pack2_window(self, rw, has_qual, valid, table_dev,
+                           gl: int):
+        """Resident-window fused apply + BOTH column packs (the bases
+        half of the packed tail): ships only ``has_qual``/``valid``;
+        the packed qual AND base payloads come home via
+        :meth:`packed_payload_slices` on each output."""
+        placed = rw.args() + (
+            self.put_rows(has_qual), self.put_rows(valid),
+        )
+        return self.apply_pack2_placed(placed, table_dev, gl)
 
     def packed_payload_slices(self, packed, lens_gm: np.ndarray,
                               gl: int) -> list:
@@ -600,6 +745,11 @@ class MeshPartitioner:
                 if cache_key not in dp._PREWARMED:
                     dp._PREWARMED.add(cache_key)
                     todo.append((key, fn, cache_key))
+                else:
+                    # already warm: re-seed the ledger claim a faulted
+                    # run's raising dispatch may have handed back (the
+                    # pool prewarm's dedupe-skip does the same)
+                    compile_ledger.claim(key, self.ledger_key())
         done = 0
         for key, fn, cache_key in todo:
             try:
@@ -621,6 +771,60 @@ class MeshPartitioner:
             tr.count(tele.C_POOL_PREWARM_COMPILES)
             done += 1
         return done
+
+
+def mesh_resident_window(b, window: int, part: MeshPartitioner):
+    """Place one window's resident payload as batch-sharded mesh arrays
+    (the mesh analog of ``device_pool.make_resident_window``): one
+    ``NamedSharding`` placement at ingest, reused by every shard_map
+    pass.  Rows pad to the mesh width; callers wrap this in
+    ``telemetry.pass_scope("ingest")`` for the h2d ledger."""
+    from adam_tpu.formats import schema
+    from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+    from adam_tpu.parallel.device_pool import ResidentWindow
+
+    gm = part.rows_for(grid_rows(b.n_rows))
+    gl = grid_cols(b.lmax)
+    host = {
+        "bases": pad_rows_np(b.bases, gm, schema.BASE_PAD, cols=gl),
+        "quals": pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=gl),
+        "lengths": pad_rows_np(b.lengths, gm, 0),
+        "flags": pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
+        "read_group_idx": pad_rows_np(b.read_group_idx, gm, -1),
+    }
+    nbytes = sum(int(a.nbytes) for a in host.values())
+    arrays = {k: part.put_rows(a) for k, a in host.items()}
+    return ResidentWindow(window, "mesh", arrays, gm, gl, nbytes)
+
+
+def mesh_observe_packed_prewarm_entry(b, n_rg: int,
+                                      part: MeshPartitioner) -> tuple:
+    """Prewarm entry for the mesh bit-packed-mask observe jit (the
+    resident-window pass-B dispatch variant) at one window's grid
+    shape."""
+    import jax
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+    from adam_tpu.parallel.device_pool import observe_dummy_args
+
+    g = part.rows_for(grid_rows(b.n_rows))
+    gl = grid_cols(b.lmax)
+
+    def warm(_dev, g=g, gl=gl):
+        base = observe_dummy_args(b, g, gl)
+        npk = gl // 8 + (1 if gl % 8 else 0)
+        placed = tuple(
+            part.put_rows(a) for a in base[:5] + (
+                np.zeros((g, npk), np.uint8),
+                np.zeros((g, npk), np.uint8),
+                base[7],
+            )
+        )
+        jax.block_until_ready(
+            part.observe_packed_window(placed, n_rg, gl)
+        )
+
+    return (("mesh.observe_packed", g, gl, n_rg), warm)
 
 
 def mesh_observe_prewarm_entry(b, n_rg: int, part: MeshPartitioner) -> tuple:
@@ -649,12 +853,16 @@ def mesh_markdup_prewarm_entry(b, part: MeshPartitioner) -> tuple:
     grid shape (``device_pool.markdup_dummy_args``)."""
     import jax
 
-    from adam_tpu.formats.batch import grid_cols, grid_rows
+    from adam_tpu.formats.batch import (
+        grid_cigar_cols, grid_cols, grid_rows,
+    )
     from adam_tpu.parallel.device_pool import markdup_dummy_args
 
     g = part.rows_for(grid_rows(b.n_rows))
     gl = grid_cols(b.lmax)
-    gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
+    gc = grid_cigar_cols(
+        b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1
+    )
 
     def warm(_dev, g=g, gl=gl, gc=gc):
         jax.block_until_ready(
@@ -666,12 +874,14 @@ def mesh_markdup_prewarm_entry(b, part: MeshPartitioner) -> tuple:
 
 def mesh_apply_prewarm_entry(b, n_rg: int, n_cyc: int,
                              part: MeshPartitioner,
-                             pack: bool = False) -> tuple:
+                             pack: bool = False,
+                             pack2: bool = False) -> tuple:
     """Prewarm entry for the mesh apply jit keyed by the SOLVED table's
     real cycle width (the pass-C re-warm, device_pool.apply_prewarm_entry
     semantics; ``device_pool.apply_dummy_args``).  ``pack=True`` warms
-    the fused apply+pack variant instead (its own executable — the key
-    carries the kernel name, so both can coexist warm)."""
+    the fused apply+pack variant, ``pack2=True`` the resident-window
+    bases+quals pack (each its own executable — the key carries the
+    kernel name, so all can coexist warm)."""
     import jax
 
     from adam_tpu.formats.batch import grid_cols, grid_rows
@@ -685,13 +895,23 @@ def mesh_apply_prewarm_entry(b, n_rg: int, n_cyc: int,
         tbl = part.put_replicated(
             np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8)
         )
+        if pack2:
+            placed = tuple(
+                part.put_rows(a) for a in apply_dummy_args(b, g, gl)
+            )
+            jax.block_until_ready(
+                part.apply_pack2_placed(placed, tbl, gl)
+            )
+            return
         runner = part.apply_pack_window if pack else part.apply_window
         jax.block_until_ready(
             runner(apply_dummy_args(b, g, gl), tbl, gl)
         )
 
-    # two literal key tuples (not one with a computed kernel name): the
+    # literal key tuples (not one with a computed kernel name): the
     # dispatch-ledger rule's prewarm cross-check parses these literals
+    if pack2:
+        return (("mesh.apply_pack2", g, gl, n_rg, n_cyc), warm)
     if pack:
         return (("mesh.apply_pack", g, gl, n_rg, n_cyc), warm)
     return (("mesh.apply", g, gl, n_rg, n_cyc), warm)

@@ -26,6 +26,7 @@ no strings, no hashing, one fused kernel per pass.
 from __future__ import annotations
 
 import os
+import threading
 from functools import partial
 from typing import Optional
 
@@ -226,6 +227,82 @@ def observe_kernel(
     )
 
 
+def observe_packed_body(
+    bases, quals, lengths, flags, read_group_idx,
+    res_bits, mm_bits, read_ok,
+    n_rg: int, lmax: int,
+):
+    """Traceable observe pass over **bit-packed** per-pass masks
+    (``res_bits``/``mm_bits``: u8[N, L/8] from
+    ``colpack.pack_mask_bits``) — the resident-window dispatch variant:
+    bases/quals/lengths/flags/rg come from the window's ingest-resident
+    device arrays, and the only per-residue h2d payload is the two
+    packed masks (8x smaller than the booleans the plain kernel
+    ships).  Unpacks on device, then runs the exact scatter-add of
+    :func:`observe_kernel` — bitwise the same histograms."""
+    from adam_tpu.ops.colpack import unpack_mask_body
+
+    residue_ok = unpack_mask_body(res_bits, lmax)
+    is_mismatch = unpack_mask_body(mm_bits, lmax)
+    return observe_kernel.__wrapped__(
+        bases, quals, lengths, flags, read_group_idx,
+        residue_ok, is_mismatch, read_ok, n_rg, lmax,
+    )
+
+
+#: Lazily-built jit variants keyed by (kind, donate): the donating
+#: twins are DISTINCT executables from the plain ones (donation is part
+#: of the jit wrapper), so the prewarm must warm exactly the variant a
+#: dispatch will call — both sides resolve through this one registry
+#: with the same (kind, donate) decision, which is what keeps the
+#: compile ledger's donated-signature executables deduped against the
+#: prewarm (device.compile.in_window stays 0).
+_JIT_VARIANTS: dict = {}
+_JIT_VARIANTS_LOCK = threading.Lock()
+
+
+def jit_variant(kind: str, donate: bool = False):
+    """The jit for one kernel ``kind`` (``observe_packed`` / ``apply``
+    / ``apply_pack`` / ``apply_pack2``) with or without buffer
+    donation.  Donation aliases the dead-after-apply inputs into the
+    outputs (the resident quals buffer becomes the packed qual column,
+    the resident bases buffer the packed base column; the observe
+    variant donates its per-pass mask temporaries), halving pass-C's
+    per-window HBM footprint — only offered where the runtime honors
+    it (``device_pool.donation_ok``; CPU runtimes warn and copy)."""
+    key = (kind, bool(donate))
+    fn = _JIT_VARIANTS.get(key)
+    if fn is not None:
+        return fn
+    with _JIT_VARIANTS_LOCK:
+        fn = _JIT_VARIANTS.get(key)
+        if fn is not None:
+            return fn
+        if not donate and kind == "apply":
+            fn = apply_table_kernel
+        elif not donate and kind == "apply_pack":
+            fn = apply_pack_kernel
+        elif not donate and kind == "apply_pack2":
+            fn = apply_pack2_kernel
+        else:
+            body, statics, donums = {
+                "observe_packed": (
+                    observe_packed_body, ("n_rg", "lmax"), (5, 6)
+                ),
+                "apply": (apply_table_body, ("lmax",), (1,)),
+                "apply_pack": (apply_pack_body, ("lmax", "size"), (1,)),
+                "apply_pack2": (
+                    apply_pack2_body, ("lmax", "size"), (0, 1)
+                ),
+            }[kind]
+            kw = {"static_argnames": statics}
+            if donate:
+                kw["donate_argnums"] = donums
+            fn = partial(jax.jit, **kw)(body)
+        _JIT_VARIANTS[key] = fn
+    return fn
+
+
 def observe_kernel_np(
     bases, quals, lengths, flags, read_group_idx,
     residue_ok, is_mismatch, read_ok,
@@ -304,7 +381,7 @@ class ObservationTable:
 
 def _observe_device(
     ds: AlignmentDataset, known_snps: Optional[SnpTable] = None,
-    backend: Optional[str] = None, device=None, mesh=None,
+    backend: Optional[str] = None, device=None, mesh=None, resident=None,
 ):
     """Run the observation pass -> (total, mism, rg_names, lmax).
 
@@ -330,7 +407,12 @@ def _observe_device(
     streamed pipeline folds into its device-resident accumulator
     instead of fetching per window.  Downstream consumers dispatch on
     ``isinstance(total, np.ndarray)`` so each path stays on its side of
-    the device link."""
+    the device link.  ``resident``: the window's ingest-resident device
+    payload (``device_pool.ResidentWindow``) — bases/quals/lengths/
+    flags/rg dispatch off the handle and only the bit-packed per-pass
+    masks ship (``colpack.pack_mask_bits``); a dead or mismatched
+    handle falls back to the full re-ship, bitwise the same
+    histograms."""
     backend = bqsr_backend(backend)
     from adam_tpu.parallel.device_pool import span_attrs
 
@@ -342,12 +424,13 @@ def _observe_device(
         _tele.SPAN_BQSR_OBSERVE, backend=backend,
         reads=int(ds.batch.n_rows), **attrs,
     ):
-        return _observe_impl(ds, known_snps, backend, device, mesh)
+        return _observe_impl(ds, known_snps, backend, device, mesh,
+                             resident)
 
 
 def _observe_impl(
     ds: AlignmentDataset, known_snps: Optional[SnpTable], backend: str,
-    device=None, mesh=None,
+    device=None, mesh=None, resident=None,
 ):
     b = ds.batch.to_numpy()
     lmax = b.lmax
@@ -453,13 +536,51 @@ def _observe_impl(
             from adam_tpu.utils import retry as _retry
 
             gm = mesh.rows_for(g)
+            rw = resident
+            if rw is not None and not (
+                rw.alive and rw.device == "mesh"
+                and rw.g == gm and rw.gl == gl
+            ):
+                rw = None
+            if rw is not None:
+                from adam_tpu.ops.colpack import pack_mask_bits
+
+                res_pk = pack_mask_bits(
+                    pad_rows_np(residue_ok, gm, False, cols=gl)
+                )
+                mm_pk = pack_mask_bits(
+                    pad_rows_np(is_mm, gm, False, cols=gl)
+                )
+                rd_pad = pad_rows_np(read_ok, gm, False)
+
+                def dispatch_mesh_resident():
+                    # per-attempt placement of the small per-pass
+                    # inputs keeps the retry idempotent even when the
+                    # donating variant consumed a prior attempt's masks
+                    faults.point("device.dispatch")
+                    return mesh.observe_window_resident(
+                        rw, res_pk, mm_pk, rd_pad, n_rg, gl
+                    )
+
+                with compile_ledger.track(
+                    ("mesh.observe_packed", gm, gl, n_rg),
+                    mesh.ledger_key(),
+                ):
+                    total, mism = _retry.retry_call(
+                        dispatch_mesh_resident,
+                        site="bqsr.observe.dispatch",
+                    )
+                rg_names = ds.read_groups.names + ["null"]
+                return total, mism, rg_names, gl
 
             def dispatch_mesh():
                 # the sharded placement + collective dispatch re-run as
                 # one idempotent unit, exactly like the pool path
                 faults.point("device.dispatch")
                 return mesh.observe_window((
+                    # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
                     pad_rows_np(b.bases, gm, schema.BASE_PAD, cols=gl),
+                    # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
                     pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=gl),
                     pad_rows_np(b.lengths, gm, 0),
                     pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
@@ -478,11 +599,50 @@ def _observe_impl(
                     dispatch_mesh, site="bqsr.observe.dispatch"
                 )
         else:
-            from adam_tpu.parallel.device_pool import putter
-            from adam_tpu.utils import faults
+            from adam_tpu.parallel.device_pool import donation_ok, putter
+            from adam_tpu.utils import compile_ledger, faults
             from adam_tpu.utils import retry as _retry
 
             _put = putter(device)
+            rw = resident
+            if rw is not None and not (
+                rw.alive and rw.device is device
+                and rw.g == g and rw.gl == gl
+            ):
+                rw = None
+            if rw is not None:
+                from adam_tpu.ops.colpack import pack_mask_bits
+
+                res_pk = pack_mask_bits(
+                    pad_rows_np(residue_ok, g, False, cols=gl)
+                )
+                mm_pk = pack_mask_bits(
+                    pad_rows_np(is_mm, g, False, cols=gl)
+                )
+                rd_pad = pad_rows_np(read_ok, g, False)
+
+                def dispatch_resident():
+                    # ingest-once H2D: the five resident arrays stay
+                    # put; only the bit-packed masks + read filter ship
+                    # (fresh placements per attempt, so the donating
+                    # variant's consumed masks never re-enter a retry)
+                    faults.point("device.dispatch", device=device)
+                    return jit_variant(
+                        "observe_packed", donation_ok(device)
+                    )(
+                        *rw.args(), _put(res_pk), _put(mm_pk),
+                        _put(rd_pad), n_rg, gl,
+                    )
+
+                # ledger key == observe_packed_prewarm_entry's key
+                with compile_ledger.track(
+                    ("bqsr.observe_packed", g, gl, n_rg), device
+                ):
+                    total, mism = _retry.retry_call(
+                        dispatch_resident, site="bqsr.observe.dispatch"
+                    )
+                rg_names = ds.read_groups.names + ["null"]
+                return total, mism, rg_names, gl
 
             def dispatch():
                 # ship + scatter-add as one retryable unit: the commit
@@ -490,7 +650,9 @@ def _observe_impl(
                 # tunneled chip, and re-running them is idempotent
                 faults.point("device.dispatch", device=device)
                 return observe_kernel(
+                    # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
                     _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=gl)),
+                    # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
                     _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
                     _put(pad_rows_np(b.lengths, g, 0)),
                     _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
@@ -500,8 +662,6 @@ def _observe_impl(
                     _put(pad_rows_np(read_ok, g, False)),
                     n_rg, gl,
                 )
-
-            from adam_tpu.utils import compile_ledger
 
             # ledger key == the prewarm entry key ("bqsr.observe"):
             # an in-window miss here is a prewarm coverage gap
@@ -780,6 +940,50 @@ def apply_pack_kernel(
     )
 
 
+def apply_pack2_body(
+    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+    phred_table, lmax: int, size: int,
+):
+    """Traceable fused apply + BOTH column packs — the bases half of
+    the packed tail (deferred by PR 12 until the window was
+    device-resident): with bases already on device from ingest, one
+    dispatch gathers the recalibrated quals, SANGER-encodes and packs
+    them, AND decodes + packs the base codes, so pass C ships two flat
+    encode-ready columns (``sum(lengths)`` bytes each) and the host
+    never walks either [N, L] matrix.  Returns
+    ``(packed_quals, packed_bases)``, each u8[size]."""
+    from adam_tpu.ops.colpack import (
+        base_decode_body, pack_rows_body, sanger_body,
+    )
+
+    new_q = apply_table_body(
+        bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+        phred_table, lmax,
+    )
+    qual_lens = jnp.where(
+        valid & has_qual, lengths.astype(jnp.int64), 0
+    )
+    base_lens = jnp.where(valid, lengths.astype(jnp.int64), 0)
+    return (
+        pack_rows_body(sanger_body(new_q), qual_lens, size),
+        pack_rows_body(base_decode_body(bases), base_lens, size),
+    )
+
+
+@partial(jax.jit, static_argnames=("lmax", "size"))
+def apply_pack2_kernel(
+    bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+    phred_table, lmax: int, size: int,
+):
+    """Jit entry point over :func:`apply_pack2_body` (the
+    resident-window pass-C dispatch when packed columns are on; the
+    donating twin lives in :func:`jit_variant`)."""
+    return apply_pack2_body(
+        bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+        phred_table, lmax, size,
+    )
+
+
 def merge_observations(parts: list[tuple], replays=None,
                        tracer=None, window_ids=None,
                        on_part=None) -> tuple:
@@ -903,7 +1107,7 @@ def recalibrate_base_qualities(
 def apply_recalibration_dispatch(
     ds: AlignmentDataset, phred_table: np.ndarray, gl: int,
     backend: Optional[str] = None, device=None, mesh=None,
-    pack: bool = False,
+    pack: bool = False, resident=None,
 ):
     """Start the per-residue table application for one window -> opaque
     handle for :func:`apply_recalibration_finish`.
@@ -925,7 +1129,17 @@ def apply_recalibration_dispatch(
     flat SANGER-encoded qual column (``ops/colpack``), fetched by
     :func:`apply_recalibration_finish_packed` as ``sum(lengths)``
     bytes — the pass-C d2h fetch ships the encode-ready column, never
-    the [N, L] matrix."""
+    the [N, L] matrix.
+
+    ``resident`` (a ``device_pool.ResidentWindow``) dispatches off the
+    window's ingest-resident arrays — only the post-split ``has_qual``/
+    ``valid`` bools ship — and with ``pack=True`` upgrades to the fused
+    bases+quals pack (``apply_pack2_kernel``): BOTH flat encode-ready
+    columns come home and the handle finishes as
+    ``(ds, io.arrow_pack.PackedColumns)``.  Where the runtime honors
+    donation the resident quals/bases buffers become the packed
+    outputs.  A dead handle falls back to the non-resident dispatch,
+    byte-identically."""
     backend = bqsr_backend(backend)
     from adam_tpu.parallel.device_pool import span_attrs
 
@@ -934,7 +1148,7 @@ def apply_recalibration_dispatch(
         _tele.SPAN_BQSR_APPLY_DISPATCH, backend=backend, **attrs,
     ):
         return _apply_dispatch_impl(
-            ds, phred_table, gl, backend, device, mesh, pack
+            ds, phred_table, gl, backend, device, mesh, pack, resident
         )
 
 
@@ -946,9 +1160,17 @@ def _apply_pack_lens(b) -> np.ndarray:
     return pack_lengths(b.lengths, b.valid, b.has_qual)
 
 
+def _apply_pack_lens_bases(b) -> np.ndarray:
+    """Per-row packed byte counts for the bases column (every valid row
+    carries its sequence, qual-less or not)."""
+    from adam_tpu.ops.colpack import pack_lengths
+
+    return pack_lengths(b.lengths, b.valid)
+
+
 def _apply_dispatch_impl(
     ds: AlignmentDataset, phred_table, gl: int, backend: str, device=None,
-    mesh=None, pack: bool = False,
+    mesh=None, pack: bool = False, resident=None,
 ):
     b = ds.batch.to_numpy()
     if backend == "device" and mesh is not None:
@@ -962,8 +1184,82 @@ def _apply_dispatch_impl(
         glc = grid_cols(L)
         n_rg = phred_table.shape[0]
         n_cyc = phred_table.shape[2]
+        rw = resident
+        if rw is not None and not (
+            rw.alive and rw.device == "mesh"
+            and rw.g == gm and rw.gl == glc
+        ):
+            rw = None
+        if rw is not None:
+            hq_pad = pad_rows_np(b.has_qual, gm, False)
+            vd_pad = pad_rows_np(b.valid, gm, False)
+            if pack:
+                # the bases half: both flat columns come home, each
+                # split into per-shard exact payload slices
+                pack_lens_q = _apply_pack_lens(b)
+                pack_lens_b = _apply_pack_lens_bases(b)
+                lens_q_pad = pad_rows_np(pack_lens_q, gm, 0)
+                lens_b_pad = pad_rows_np(pack_lens_b, gm, 0)
+
+                def dispatch_mesh_pack2():
+                    faults.point("device.dispatch")
+                    if not rw.alive:
+                        # donated buffers died under a half-run attempt:
+                        # re-ship the quals-only pack from the host copy
+                        return None
+                    try:
+                        pq, pb = mesh.apply_pack2_window(
+                            rw, hq_pad, vd_pad, phred_table, glc
+                        )
+                    except BaseException:
+                        if mesh.apply_supports_donation():
+                            # the donating collective may have consumed
+                            # the resident shards mid-failure: the
+                            # handle must never offer them again
+                            rw.mark_consumed()
+                        raise
+                    if mesh.apply_supports_donation():
+                        rw.mark_consumed()
+                    return (
+                        mesh.packed_payload_slices(pq, lens_q_pad, glc),
+                        mesh.packed_payload_slices(pb, lens_b_pad, glc),
+                    )
+
+                with compile_ledger.track(
+                    ("mesh.apply_pack2", gm, glc, n_rg, n_cyc),
+                    mesh.ledger_key(),
+                ):
+                    got = _retry.retry_call(
+                        dispatch_mesh_pack2, site="bqsr.apply.dispatch"
+                    )
+                if got is not None:
+                    q_slices, b_slices = got
+                    return ds, b, ("packed2", q_slices, pack_lens_q,
+                                   b_slices, pack_lens_b)
+                rw = None  # handle died: fall through to the re-ship
+            else:
+                def dispatch_mesh_resident():
+                    faults.point("device.dispatch")
+                    if not rw.alive:
+                        return None
+                    return mesh.apply_window_resident(
+                        rw, hq_pad, vd_pad, phred_table, glc
+                    )[:n, :L]
+
+                with compile_ledger.track(
+                    ("mesh.apply", gm, glc, n_rg, n_cyc),
+                    mesh.ledger_key(),
+                ):
+                    new_dev = _retry.retry_call(
+                        dispatch_mesh_resident, site="bqsr.apply.dispatch"
+                    )
+                if new_dev is not None:
+                    return ds, b, new_dev
+                rw = None
         args = (
+            # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
             pad_rows_np(b.bases, gm, schema.BASE_PAD, cols=glc),
+            # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
             pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=glc),
             pad_rows_np(b.lengths, gm, 0),
             pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
@@ -1012,32 +1308,116 @@ def _apply_dispatch_impl(
         L = b.lmax
         g = grid_rows(n)
         glc = grid_cols(L)
-        from adam_tpu.parallel.device_pool import putter
-        from adam_tpu.utils import faults
+        from adam_tpu.parallel.device_pool import donation_ok, putter
+        from adam_tpu.utils import compile_ledger, faults
         from adam_tpu.utils import retry as _retry
 
         _put = putter(device)
+        n_rg = phred_table.shape[0]
+        n_cyc = phred_table.shape[2]
+
+        def _placed_table():
+            if isinstance(phred_table, np.ndarray):
+                return _put(np.ascontiguousarray(phred_table, np.uint8))
+            return phred_table  # device-resident (pool-replicated)
+
+        rw = resident
+        if rw is not None and not (
+            rw.alive and rw.device is device and rw.g == g and rw.gl == glc
+        ):
+            rw = None
+        if rw is not None:
+            from adam_tpu.ops.colpack import fetch_grid
+
+            hq_pad = pad_rows_np(b.has_qual, g, False)
+            vd_pad = pad_rows_np(b.valid, g, False)
+            if pack:
+                # the bases half of the packed tail: one fused dispatch
+                # emits BOTH flat encode-ready columns off the resident
+                # arrays; the fetch ships sum(lengths) bytes each
+                pack_lens_q = _apply_pack_lens(b)
+                pack_lens_b = _apply_pack_lens_bases(b)
+                total_q = int(pack_lens_q.sum())
+                total_b = int(pack_lens_b.sum())
+                cut_q = min(g * glc, fetch_grid(total_q))
+                cut_b = min(g * glc, fetch_grid(total_b))
+
+                def dispatch_pack2():
+                    faults.point("device.dispatch", device=device)
+                    if not rw.alive:
+                        # donated buffers died under a half-run
+                        # attempt: re-ship through the fallback below
+                        return None
+                    donate = donation_ok(device)
+                    try:
+                        pq, pb = jit_variant("apply_pack2", donate)(
+                            *rw.args(), _put(hq_pad), _put(vd_pad),
+                            _placed_table(), glc, g * glc,
+                        )
+                    except BaseException:
+                        if donate:
+                            rw.mark_consumed()
+                        raise
+                    if donate:
+                        rw.mark_consumed()
+                    return pq[:cut_q], pb[:cut_b]
+
+                # ledger key == _apply_entry's resident pack2 key
+                with compile_ledger.track(
+                    ("bqsr.apply_pack2", g, glc, n_rg, n_cyc), device
+                ):
+                    got = _retry.retry_call(
+                        dispatch_pack2, site="bqsr.apply.dispatch"
+                    )
+                if got is not None:
+                    return ds, b, (
+                        "packed2", [(got[0], total_q)], pack_lens_q,
+                        [(got[1], total_b)], pack_lens_b,
+                    )
+                rw = None  # handle died: fall through to the re-ship
+            else:
+                def dispatch_resident():
+                    faults.point("device.dispatch", device=device)
+                    if not rw.alive:
+                        return None
+                    donate = donation_ok(device)
+                    try:
+                        out = jit_variant("apply", donate)(
+                            *rw.args(), _put(hq_pad), _put(vd_pad),
+                            _placed_table(), glc,
+                        )
+                    except BaseException:
+                        if donate:
+                            rw.mark_consumed()
+                        raise
+                    if donate:
+                        rw.mark_consumed()
+                    return out[:n, :L]
+
+                with compile_ledger.track(
+                    ("bqsr.apply", g, glc, n_rg, n_cyc), device
+                ):
+                    new_dev = _retry.retry_call(
+                        dispatch_resident, site="bqsr.apply.dispatch"
+                    )
+                if new_dev is not None:
+                    return ds, b, new_dev
+                rw = None
 
         def _placed_args():
-            if isinstance(phred_table, np.ndarray):
-                tbl = _put(np.ascontiguousarray(phred_table, np.uint8))
-            else:
-                tbl = phred_table  # device-resident (pool-replicated)
             return (
+                # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
                 _put(pad_rows_np(b.bases, g, schema.BASE_PAD, cols=glc)),
+                # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
                 _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=glc)),
                 _put(pad_rows_np(b.lengths, g, 0)),
                 _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
                 _put(pad_rows_np(b.read_group_idx, g, -1)),
                 _put(pad_rows_np(b.has_qual, g, False)),
                 _put(pad_rows_np(b.valid, g, False)),
-                tbl,
+                _placed_table(),
             )
 
-        from adam_tpu.utils import compile_ledger
-
-        n_rg = phred_table.shape[0]
-        n_cyc = phred_table.shape[2]
         if pack:
             from adam_tpu.ops.colpack import fetch_grid
 
@@ -1102,7 +1482,9 @@ def apply_handle_dataset(handle) -> AlignmentDataset:
 
 def _handle_is_packed(handle) -> bool:
     payload = handle[2]
-    return isinstance(payload, tuple) and payload[0] == "packed"
+    return isinstance(payload, tuple) and payload[0] in (
+        "packed", "packed2"
+    )
 
 
 def apply_recalibration_finish(handle) -> AlignmentDataset:
@@ -1126,28 +1508,45 @@ def apply_recalibration_finish_packed(handle):
     it beside the dataset (whose batch keeps its PRE-recalibration
     quals: the OQ stash is the only remaining consumer of the matrix,
     and the writer encodes the qual column straight off the packed
-    buffer).  A plain handle behaves exactly like
+    buffer).  A resident-window ``packed2`` handle additionally fetches
+    the flat base column (the bases half of the packed tail) and
+    returns a :class:`~adam_tpu.io.arrow_pack.PackedColumns` carrying
+    both.  A plain handle behaves exactly like
     :func:`apply_recalibration_finish` and returns ``packed=None``."""
-    from adam_tpu.io.arrow_pack import PackedQuals
+    from adam_tpu.io.arrow_pack import PackedColumns, PackedQuals
     from adam_tpu.utils.transfer import device_fetch
 
     if not _handle_is_packed(handle):
         return apply_recalibration_finish(handle), None
-    ds, b, (_tag, slices, pack_lens) = handle
-    with _tele.TRACE.span(_tele.SPAN_BQSR_APPLY_FETCH):
+
+    def _fetch_col(slices, pack_lens):
         # each slice is bucket-quantized (colpack.fetch_grid) so slice
         # programs stay few; the true payload size rides alongside and
         # the host trims the bucket tail here
         parts = [
             np.asarray(device_fetch(s))[:t] for s, t in slices
         ]
-    if len(parts) == 1:
-        buf = parts[0]
-    elif parts:
-        buf = np.concatenate(parts)
-    else:  # every row qual-less: a valid, all-null column
-        buf = np.zeros(0, np.uint8)
-    return _stash_orig_quals(ds, b), PackedQuals(buf, pack_lens)
+        if len(parts) == 1:
+            buf = parts[0]
+        elif parts:
+            buf = np.concatenate(parts)
+        else:  # every row column-less: a valid, all-null column
+            buf = np.zeros(0, np.uint8)
+        return PackedQuals(buf, pack_lens)
+
+    payload = handle[2]
+    if payload[0] == "packed2":
+        ds, b, (_tag, q_slices, q_lens, b_slices, b_lens) = handle
+        with _tele.TRACE.span(_tele.SPAN_BQSR_APPLY_FETCH):
+            packed = PackedColumns(
+                quals=_fetch_col(q_slices, q_lens),
+                bases=_fetch_col(b_slices, b_lens),
+            )
+        return _stash_orig_quals(ds, b), packed
+    ds, b, (_tag, slices, pack_lens) = handle
+    with _tele.TRACE.span(_tele.SPAN_BQSR_APPLY_FETCH):
+        packed_q = _fetch_col(slices, pack_lens)
+    return _stash_orig_quals(ds, b), packed_q
 
 
 def apply_recalibration(

@@ -59,28 +59,39 @@ def markdup_columns_local(
     return five, score
 
 
-_COLUMNS_JIT = None  # lazily-built module-level jit (one compile per shape)
+_COLUMNS_JITS: dict = {}  # donate -> lazily-built module-level jit
 _COLUMNS_JIT_LOCK = threading.Lock()
 
 
-def get_columns_jit():
+def get_columns_jit(donate: bool = False):
     """The module-level jit of :func:`markdup_columns_local` (built
     lazily; shared by the dispatch below and the device pool's prewarm
     so both hit the same executable cache).  Locked: the prewarm calls
     this from one thread per device, and a lost race here would warm a
     discarded wrapper whose executable cache the real dispatches never
-    see."""
-    global _COLUMNS_JIT
-    if _COLUMNS_JIT is None:
+    see.  ``donate=True`` is the resident-window variant: with quals/
+    lengths/flags read from the window's ingest-resident arrays, the
+    per-pass ``start`` temporary (i64[g], the only shipped input whose
+    buffer the i64[g] ``five`` output can alias) is donated —
+    dispatched only where ``device_pool.donation_ok`` says the runtime
+    honors it, and warmed by the same decision."""
+    key = bool(donate)
+    jit = _COLUMNS_JITS.get(key)
+    if jit is None:
         with _COLUMNS_JIT_LOCK:
-            if _COLUMNS_JIT is None:
+            jit = _COLUMNS_JITS.get(key)
+            if jit is None:
                 import jax
 
-                _COLUMNS_JIT = jax.jit(markdup_columns_local)
-    return _COLUMNS_JIT
+                jit = jax.jit(
+                    markdup_columns_local,
+                    **({"donate_argnums": (0,)} if donate else {}),
+                )
+                _COLUMNS_JITS[key] = jit
+    return jit
 
 
-def markdup_columns_dispatch(batch, device=None, mesh=None):
+def markdup_columns_dispatch(batch, device=None, mesh=None, resident=None):
     """Dispatch the [N, L] markdup reductions on a device -> lazy
     (five, score) device arrays for the batch's real rows.
 
@@ -92,11 +103,18 @@ def markdup_columns_dispatch(batch, device=None, mesh=None):
     device, exactly the single-chip behavior.  ``mesh``: a
     :class:`~adam_tpu.parallel.partitioner.MeshPartitioner` — the
     [N, L] arrays shard over its ``batch`` axis and every device works
-    the same window (SPMD), bitwise the single-chip columns."""
-    jit = get_columns_jit()
-
-    from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
-    from adam_tpu.parallel.device_pool import putter, span_attrs
+    the same window (SPMD), bitwise the single-chip columns.
+    ``resident``: the window's ingest-resident device payload
+    (``device_pool.ResidentWindow``) — quals/lengths/flags dispatch
+    straight off the handle and only the markdup-specific start/end/
+    cigar columns ship; a dead or mismatched handle falls back to the
+    legacy re-ship below, bitwise the same columns."""
+    from adam_tpu.formats.batch import (
+        grid_cigar_cols, grid_cols, grid_rows, pad_rows_np,
+    )
+    from adam_tpu.parallel.device_pool import (
+        donation_ok, putter, span_attrs,
+    )
     from adam_tpu.utils import faults
     from adam_tpu.utils import retry as _retry
     from adam_tpu.utils import telemetry as _tele
@@ -115,23 +133,38 @@ def markdup_columns_dispatch(batch, device=None, mesh=None):
         # trace+compile serialized inside pass A's ingest loop (the
         # walks mask by lengths/cigar_n, so the padding lanes are inert)
         gl = grid_cols(b.lmax)
-        gc = grid_cols(b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1)
+        gc = grid_cigar_cols(
+            b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1
+        )
 
         if mesh is not None:
             from adam_tpu.utils import compile_ledger
 
             gm = mesh.rows_for(g)
+            rw = resident
+            if rw is not None and not (
+                rw.alive and rw.device == "mesh"
+                and rw.g == gm and rw.gl == gl
+            ):
+                rw = None
 
             def dispatch_mesh():
                 faults.point("device.dispatch")
-                return mesh.markdup_window((
+                fresh = (
                     pad_rows_np(b.start, gm, -1),
                     pad_rows_np(b.end, gm, -1),
-                    pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
                     pad_rows_np(b.cigar_ops, gm, schema.CIGAR_PAD,
                                 cols=gc),
                     pad_rows_np(b.cigar_lens, gm, 0, cols=gc),
                     pad_rows_np(b.cigar_n, gm, 0),
+                )
+                if rw is not None and rw.alive:
+                    return mesh.markdup_window_resident(rw, fresh)
+                return mesh.markdup_window((
+                    fresh[0], fresh[1],
+                    pad_rows_np(b.flags, gm, schema.FLAG_UNMAPPED),
+                    fresh[2], fresh[3], fresh[4],
+                    # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
                     pad_rows_np(b.quals, gm, schema.QUAL_PAD, cols=gl),
                     pad_rows_np(b.lengths, gm, 0),
                 ))
@@ -144,18 +177,35 @@ def markdup_columns_dispatch(batch, device=None, mesh=None):
                 )
             return five[:n], score[:n]
 
+        rw = resident
+        if rw is not None and not (
+            rw.alive and rw.device is device and rw.g == g and rw.gl == gl
+        ):
+            rw = None
+
         def dispatch():
             # the device_put + jit call is the RPC pair that fails
             # transiently on a tunneled chip; the whole unit re-runs on
-            # a retry (device_put is idempotent — a fresh commit)
+            # a retry (device_put is idempotent — a fresh commit; the
+            # donated start temporary is re-placed every attempt, so a
+            # half-run donating call can never re-pass a dead buffer)
             faults.point("device.dispatch", device=device)
-            return jit(
-                _put(pad_rows_np(b.start, g, -1)),
-                _put(pad_rows_np(b.end, g, -1)),
+            start = _put(pad_rows_np(b.start, g, -1))
+            end = _put(pad_rows_np(b.end, g, -1))
+            ops = _put(pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD,
+                                   cols=gc))
+            lens = _put(pad_rows_np(b.cigar_lens, g, 0, cols=gc))
+            n_ops = _put(pad_rows_np(b.cigar_n, g, 0))
+            if rw is not None and rw.alive:
+                return get_columns_jit(donate=donation_ok(device))(
+                    start, end, rw.get("flags"), ops, lens, n_ops,
+                    rw.get("quals"), rw.get("lengths"),
+                )
+            return get_columns_jit()(
+                start, end,
                 _put(pad_rows_np(b.flags, g, schema.FLAG_UNMAPPED)),
-                _put(pad_rows_np(b.cigar_ops, g, schema.CIGAR_PAD, cols=gc)),
-                _put(pad_rows_np(b.cigar_lens, g, 0, cols=gc)),
-                _put(pad_rows_np(b.cigar_n, g, 0)),
+                ops, lens, n_ops,
+                # adam-tpu: noqa[residency] reason=non-resident fallback: residency off, a dead handle, or a replay re-ships from the host ingest copy
                 _put(pad_rows_np(b.quals, g, schema.QUAL_PAD, cols=gl)),
                 _put(pad_rows_np(b.lengths, g, 0)),
             )

@@ -444,6 +444,17 @@ def _transform_streamed_impl(
 
     use_packed = use_device and packed_columns_enabled()
     stats["packed_columns"] = use_packed
+    # device-resident windows (ADAM_TPU_RESIDENT, default on for the
+    # device backend; docs/PERF.md "Device-resident windows"): each
+    # window's bases/quals/lengths/flags/rg land on device ONCE at
+    # ingest — pinned per pool device, or as one mesh-sharded placement
+    # — and every pass dispatches against the handle; the later passes
+    # ship only their per-pass inputs (bit-packed MD masks, post-split
+    # validity bools), so the ledger's per-pass h2d collapses to the
+    # one ingest entry.  With packed columns on, pass C upgrades to the
+    # fused bases+quals pack (the bases half of the packed tail).
+    use_resident = use_device and dp_mod.resident_windows_enabled()
+    stats["resident_windows"] = 0
     # pass-B windows folded into the mesh's device-resident observe
     # accumulator, kept referenced so a degrade can replay them through
     # the pool/host path; the host-side merge lists live up here too so
@@ -490,9 +501,75 @@ def _transform_streamed_impl(
 
         return "native" if native.available() else "numpy"
 
+    # ---- device-resident windows: handle registry + lifecycle ----------
+    # window index -> ResidentWindow; the live-bytes ledger backs the
+    # no-HBM-growth invariant (gauge returns to 0 as pass C releases)
+    resident_map: dict = {}
+    resident_live = {"bytes": 0, "made": 0}
+
+    def _make_resident(win, ds):
+        """Place window ``win``'s resident payload at ingest (pinned on
+        its round-robin pool device, or mesh-sharded).  Best-effort: a
+        failed placement just leaves the window on the legacy
+        re-ship-per-pass path."""
+        if not use_resident or res["device_lost"]:
+            return
+        b = ds.batch.to_numpy()
+        mp = exec_state["mesh"]
+        try:
+            # the one per-window h2d: attributed to the ledger's
+            # ``ingest`` bucket, which the analyzer's residency verdict
+            # compares against the (≈0) observe/apply buckets
+            with tele.pass_scope("ingest"):
+                if mp is not None:
+                    rw = part_mod.mesh_resident_window(b, win, mp)
+                else:
+                    dev = _pick_device(win)
+                    if dev is _HOST:
+                        return
+                    rw = dp_mod.make_resident_window(b, win, dev)
+        except Exception as e:
+            log.warning(
+                "resident placement of window %d failed (%s); the "
+                "window re-ships per pass", win, e,
+            )
+            return
+        resident_map[win] = rw
+        resident_live["bytes"] += rw.nbytes
+        resident_live["made"] += 1
+        tr.count(tele.C_RESIDENT_WINDOWS)
+        tr.count(tele.C_RESIDENT_BYTES, rw.nbytes)
+        tr.gauge(tele.G_RESIDENT_LIVE, resident_live["bytes"])
+
+    def _release_resident(win, drop=False):
+        """Release window ``win``'s handle (the refcounted base ref —
+        after its pass-C fetch, or at the skip/fault sites); ``drop``
+        marks a fault-path drop (eviction, degrade) for the counters."""
+        rw = resident_map.pop(win, None)
+        if rw is None:
+            return
+        rw.drop() if drop else rw.release()
+        resident_live["bytes"] -= rw.nbytes
+        tr.count(
+            tele.C_RESIDENT_EVICTED if drop else tele.C_RESIDENT_RELEASED
+        )
+        tr.gauge(tele.G_RESIDENT_LIVE, resident_live["bytes"])
+
+    def _drop_resident_on(dev):
+        """An evicted device takes its pinned windows with it: their
+        later passes re-ship from the host-retained ingest copy."""
+        for win, rw in list(resident_map.items()):
+            if rw.device is dev:
+                _release_resident(win, drop=True)
+
+    def _drop_all_resident():
+        for win in list(resident_map):
+            _release_resident(win, drop=True)
+
     def _evict_or_lose(dev, exc) -> bool:
         """Evict a failed device; True = survivors remain, False = the
         device path is gone (callers fall back to the host backend)."""
+        _drop_resident_on(dev)
         if dpool is not None:
             dpool.evict(dev, reason=str(exc), tracer=tr)
             if dpool.alive_devices():
@@ -503,6 +580,7 @@ def _transform_streamed_impl(
                 "pipeline on the %s host backend", exc, _host_backend(),
             )
         res["device_lost"] = True
+        _drop_all_resident()
         return False
 
     def _pick_device(win):
@@ -547,6 +625,10 @@ def _transform_streamed_impl(
         exec_state["mesh"] = None
         exec_state["mode"] = "pool"
         stats["partitioner"] = "pool"
+        # mesh-sharded resident handles die with the mesh: the pool
+        # path takes their windows over by re-shipping from the
+        # host-retained ingest copy (docs/ROBUSTNESS.md)
+        _drop_all_resident()
         tr.count(tele.C_MESH_DEGRADED)
         log.error(
             "mesh partitioner failed%s (%s); degrading to the pool path"
@@ -638,7 +720,9 @@ def _transform_streamed_impl(
         mp = exec_state["mesh"]
         if mp is not None:
             try:
-                cols = md_mod.markdup_columns_dispatch(batch, mesh=mp)
+                cols = md_mod.markdup_columns_dispatch(
+                    batch, mesh=mp, resident=resident_map.get(win)
+                )
                 tr.count(tele.C_DEVICE_DISPATCHED)
                 tr.count(tele.C_MESH_DISPATCHED)
                 return "mesh", cols
@@ -646,7 +730,11 @@ def _transform_streamed_impl(
                 _mesh_degrade(e, "pass-A markdup")
 
         def on_device(dev):
-            cols = md_mod.markdup_columns_dispatch(batch, device=dev)
+            # the dispatch validates the handle itself (device match +
+            # aliveness), so a replay on a survivor just re-ships
+            cols = md_mod.markdup_columns_dispatch(
+                batch, device=dev, resident=resident_map.get(win)
+            )
             tr.count(tele.C_DEVICE_DISPATCHED)
             return dev, cols
 
@@ -697,11 +785,13 @@ def _transform_streamed_impl(
         if (mp is None and dpool is None) or res["device_lost"]:
             return
         b = ds.batch.to_numpy()
-        from adam_tpu.formats.batch import grid_cols, grid_rows
+        from adam_tpu.formats.batch import (
+            grid_cigar_cols, grid_cols, grid_rows,
+        )
 
         key = (
             grid_rows(b.n_rows), grid_cols(b.lmax),
-            grid_cols(
+            grid_cigar_cols(
                 b.cigar_ops.shape[1] if b.cigar_ops.ndim == 2 else 1
             ),
             exec_state["mode"],
@@ -722,6 +812,12 @@ def _transform_streamed_impl(
                     entries.append(
                         part_mod.mesh_observe_prewarm_entry(b, n_rg, mp)
                     )
+                    if use_resident:
+                        entries.append(
+                            part_mod.mesh_observe_packed_prewarm_entry(
+                                b, n_rg, mp
+                            )
+                        )
                 mp.prewarm(entries, tracer=tr)
             else:
                 from adam_tpu.parallel.device_pool import (
@@ -733,6 +829,7 @@ def _transform_streamed_impl(
                         b, n_rg, mark_duplicates=mark_duplicates,
                         recalibrate=recalibrate,
                         packed_apply=use_packed,
+                        resident=use_resident,
                     ),
                     tracer=tr,
                 )
@@ -759,14 +856,21 @@ def _transform_streamed_impl(
         t_pw = time.monotonic_ns()
         try:
             if mp is not None:
-                mp.prewarm(
-                    [part_mod.mesh_observe_prewarm_entry(b, n_rg, mp)],
-                    tracer=tr,
-                )
+                entries = [part_mod.mesh_observe_prewarm_entry(b, n_rg, mp)]
+                if use_resident:
+                    entries.append(
+                        part_mod.mesh_observe_packed_prewarm_entry(
+                            b, n_rg, mp
+                        )
+                    )
+                mp.prewarm(entries, tracer=tr)
             else:
-                dpool.prewarm(
-                    [dp_mod.observe_prewarm_entry(b, n_rg)], tracer=tr
-                )
+                entries = [dp_mod.observe_prewarm_entry(b, n_rg)]
+                if use_resident:
+                    entries.append(
+                        dp_mod.observe_packed_prewarm_entry(b, n_rg)
+                    )
+                dpool.prewarm(entries, tracer=tr)
         finally:
             tr.add_span(
                 tele.SPAN_POOL_PREWARM, t_pw,
@@ -811,6 +915,10 @@ def _transform_streamed_impl(
                 # process-wide cache makes warm runs a no-op.
                 if use_device:
                     _prewarm_window_shapes(ds)
+                    # ingest-once H2D: the window's resident payload
+                    # places NOW — markdup keys, observe and apply all
+                    # dispatch against this one placement
+                    _make_resident(win, ds)
                 if mark_duplicates:
                     # dispatch window i's [N, L] key/score reductions
                     # (on device i % n under a pool), then drain the
@@ -1019,7 +1127,8 @@ def _transform_streamed_impl(
             try:
                 with tele.pass_scope("observe"):
                     total, mism, _rg, g = bqsr_mod._observe_device(
-                        w, known_snps, backend, mesh=mp
+                        w, known_snps, backend, mesh=mp,
+                        resident=resident_map.get(i),
                     )
                     mp.accumulate(total, mism, g)
                 mesh_obs.append((i, w))
@@ -1032,7 +1141,8 @@ def _transform_streamed_impl(
 
         def on_device(dev):
             total, mism, _rg, g = bqsr_mod._observe_device(
-                w, known_snps, backend, device=dev
+                w, known_snps, backend, device=dev,
+                resident=resident_map.get(i),
             )
             tr.count(tele.C_DEVICE_DISPATCHED)
             return (total, mism, g), _obs_replay(i, w, dev)
@@ -1057,6 +1167,12 @@ def _transform_streamed_impl(
             if recalibrate:
                 for i, w in enumerate(windows):
                     if window_valid[i]:
+                        # chaos-harness kill point: one arrival per
+                        # observed window — the mid-pass-B leg of the
+                        # kill-and-resume matrix (nothing persisted
+                        # yet: a resume replays every un-persisted
+                        # observation, resident or not)
+                        faults.point("proc.kill", device="pass_b")
                         # pool: window i's scatter-add queues on device
                         # i % n and its compact table merges host-side
                         # at the barrier.  mesh: the window shards over
@@ -1126,6 +1242,10 @@ def _transform_streamed_impl(
             # the realigned part's grid shape rarely matches any ingest
             # window's: warm its observe kernel before the dispatch
             _prewarm_observe_shape(realigned)
+            # the realigned part is a window too: place it resident so
+            # its observe AND its pass-C apply dispatch off one ingest
+            # placement, like every streamed window
+            _make_resident(len(windows), realigned)
             got = _observe_window(len(windows), realigned)
             if got is not None:
                 obs_parts.append(got[0])
@@ -1276,6 +1396,13 @@ def _transform_streamed_impl(
     for i in done_parts:
         if i < len(windows):
             windows[i] = None
+    # windows with no part to write (resumed, or fully realigned away)
+    # have no pass-C fetch to release them at — release their resident
+    # handles now, so HBM tracks exactly the parts still in flight
+    _part_idxs = {idx for idx, _w in parts}
+    for win in list(resident_map):
+        if win not in _part_idxs:
+            _release_resident(win)
     stats["windows_fresh"] = len(parts)
     if hb is not None:
         # the part count THIS process will write (residual windows drop
@@ -1329,9 +1456,13 @@ def _transform_streamed_impl(
         remainder for the pool path to finish, bit-identically."""
         mp = exec_state["mesh"]
         try:
-            tbl_dev = mp.put_replicated(
-                np.ascontiguousarray(table, np.uint8)
-            )
+            # the once-per-run table placement gets its own transfer
+            # bucket: the "apply" bucket stays per-window traffic, so
+            # the analyzer's ingest-only verdict compares marginals
+            with tele.pass_scope("table"):
+                tbl_dev = mp.put_replicated(
+                    np.ascontiguousarray(table, np.uint8)
+                )
             # re-warm the mesh apply against the SOLVED table's real
             # width, one entry per distinct window grid shape (the
             # pool path's apply_prewarm_entry semantics)
@@ -1340,16 +1471,26 @@ def _transform_streamed_impl(
                 bw = item[1].batch
                 seen_dims.setdefault((bw.n_rows, bw.lmax), item[1])
             t_pwc = time.monotonic_ns()
-            mp.prewarm(
-                [
-                    part_mod.mesh_apply_prewarm_entry(
-                        w.batch.to_numpy(), table.shape[0],
-                        table.shape[2], mp, pack=use_packed,
-                    )
-                    for w in seen_dims.values()
-                ],
-                tracer=tr,
-            )
+            pw_entries = []
+            for w in seen_dims.values():
+                bw = w.batch.to_numpy()
+                if use_packed and use_resident:
+                    # the resident bases+quals pack2, plus the
+                    # quals-only pack a dead handle falls back to
+                    pw_entries.append(part_mod.mesh_apply_prewarm_entry(
+                        bw, table.shape[0], table.shape[2], mp,
+                        pack2=True,
+                    ))
+                if use_packed:
+                    pw_entries.append(part_mod.mesh_apply_prewarm_entry(
+                        bw, table.shape[0], table.shape[2], mp,
+                        pack=True,
+                    ))
+                else:
+                    pw_entries.append(part_mod.mesh_apply_prewarm_entry(
+                        bw, table.shape[0], table.shape[2], mp,
+                    ))
+            mp.prewarm(pw_entries, tracer=tr)
             tr.add_span(
                 tele.SPAN_POOL_PREWARM_C, t_pwc,
                 time.monotonic_ns() - t_pwc,
@@ -1395,6 +1536,7 @@ def _transform_streamed_impl(
                         handle = bqsr_mod.apply_recalibration_dispatch(
                             w, tbl_dev, gl, backend, mesh=mp,
                             pack=use_packed,
+                            resident=resident_map.get(idx),
                         )
                 except Exception as e:
                     return _remainder(e, "pass-C apply dispatch")
@@ -1424,6 +1566,10 @@ def _transform_streamed_impl(
             # failure — it must abort the run with its own attribution,
             # never trigger a degrade-and-replay
             _submit(p_idx, done, p_packed)
+            # refcounted release after pass C: the window's device
+            # arrays free as its part submits (the host copy lives on
+            # in the writer pool until the part publishes)
+            _release_resident(p_idx)
             if p_idx < len(windows):
                 windows[p_idx] = None  # free as we go
         return []
@@ -1440,11 +1586,14 @@ def _transform_streamed_impl(
             # putter so the per-device table replication shows
             # up in the h2d transfer ledger.
             alive_now = dpool.alive_devices()
-            dev_tables = [
-                dp_mod.putter(d)(tbl_c) if d in alive_now
-                else None
-                for d in dpool.devices
-            ]
+            # own transfer bucket (once-per-run, not per-window): see
+            # the mesh table placement above
+            with tele.pass_scope("table"):
+                dev_tables = [
+                    dp_mod.putter(d)(tbl_c) if d in alive_now
+                    else None
+                    for d in dpool.devices
+                ]
             # re-warm the apply gather against the SOLVED
             # table's real width: merge_observations can widen
             # the table past window 0's grid, which pass A's
@@ -1465,16 +1614,26 @@ def _transform_streamed_impl(
             pw_entries = []
             for w in seen_dims.values():
                 bw = w.batch.to_numpy()
-                pw_entries.append(apply_prewarm_entry(
-                    bw, table.shape[0], table.shape[2],
-                    pack=use_packed,
-                ))
-                if use_packed:
-                    # eviction replays re-apply with pack=False on a
-                    # survivor: the plain gather must be warm too
+                if use_packed and use_resident:
+                    # the resident bases+quals pack2 (what pass C will
+                    # actually dispatch), beside the quals-only pack a
+                    # dead handle falls back to
                     pw_entries.append(apply_prewarm_entry(
                         bw, table.shape[0], table.shape[2],
+                        pack=True, resident=True,
                     ))
+                if use_packed:
+                    pw_entries.append(apply_prewarm_entry(
+                        bw, table.shape[0], table.shape[2], pack=True,
+                    ))
+                # the plain gather stays warm on every leg: eviction
+                # replays re-apply with pack=False on a survivor, and
+                # one entry covers both twins (resident warms the
+                # donating variant alongside the plain one)
+                pw_entries.append(apply_prewarm_entry(
+                    bw, table.shape[0], table.shape[2],
+                    resident=use_resident,
+                ))
             dpool.prewarm(pw_entries, tracer=tr)
             # umbrella wall for the re-warm: the stats view
             # folds it into prewarm_s and subtracts it from
@@ -1546,6 +1705,10 @@ def _transform_streamed_impl(
                 )
                 p_packed = None
             _submit(p_idx, done, p_packed)
+            # refcounted release after pass C: the window's device
+            # arrays free as its part submits (the host copy lives on
+            # in the writer pool until the part publishes)
+            _release_resident(p_idx)
 
         for j in range(len(plist)):
             idx, w = plist[j]
@@ -1559,11 +1722,16 @@ def _transform_streamed_impl(
                     handle = bqsr_mod.apply_recalibration_dispatch(
                         w, _device_table(dev), gl, backend,
                         device=dev, pack=use_packed,
+                        resident=resident_map.get(idx),
                     )
                 tr.count(tele.C_DEVICE_DISPATCHED)
                 return dev, handle
 
-            got = _on_survivors(j, _dispatch_one, lambda: None)
+            # round-robin by WINDOW index (not parts position): the
+            # resident handle was pinned at ingest by _pick_device(win),
+            # and an index mismatch here would silently re-ship every
+            # window (placement never affects output bytes either way)
+            got = _on_survivors(idx, _dispatch_one, lambda: None)
             if got is None:  # device path lost: apply host-side
                 _submit(idx, _host_apply(w))
             else:
@@ -1606,6 +1774,7 @@ def _transform_streamed_impl(
                     if idx < len(windows):
                         windows[idx] = None  # free as we go
                     _submit(idx, w)
+                    _release_resident(idx)
     except RunCancelled:
         # graceful drain at a pass-C boundary: close the pool NON-abort
         # so every part already submitted encodes, publishes durably
@@ -1625,6 +1794,11 @@ def _transform_streamed_impl(
         raise
     with tr.span(tele.SPAN_WRITE_WAIT):
         pool.close()
+    # backstop: any handle pass C had no fetch to release (edge paths)
+    # frees here, so the live-bytes gauge ends at 0 on every clean run
+    for win in list(resident_map):
+        _release_resident(win)
+    stats["resident_windows"] = resident_live["made"]
     tr.add_span(tele.SPAN_TOTAL, t_start_ns,
                 time.monotonic_ns() - t_start_ns)
 

@@ -6,5 +6,6 @@ from adam_tpu.staticcheck.rules import (  # noqa: F401
     faultpoints,
     hostsync,
     locks,
+    residency,
     telemetry_names,
 )

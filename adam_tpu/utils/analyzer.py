@@ -424,6 +424,53 @@ def _transfer_report(doc: dict, counters: dict) -> dict:
     }
 
 
+def _residency_report(doc: dict, counters: dict) -> dict:
+    """Device-residency section (docs/PERF.md "Device-resident
+    windows"): the resident-window counters, the per-pass h2d byte
+    table summed across devices, and the **ingest-only verdict** — true
+    when windows placed resident and the per-pass dispatch traffic
+    (``observe`` + ``apply`` buckets) stayed under 25% of the one
+    ``ingest`` placement, i.e. the passes genuinely dispatched against
+    the handles instead of re-shipping.  Donated-signature executables
+    (the resident pack2/packed-observe kernels) are split out of the
+    compile entries so their prewarm coverage is visible next to the
+    verdict."""
+    xfer = doc.get("transfers") or {}
+    per_pass: dict = {}
+    for _dev, per in (xfer.get("h2d") or {}).items():
+        for p, v in (per or {}).items():
+            per_pass[p] = per_pass.get(p, 0) + (
+                v.get("bytes", 0) if isinstance(v, dict) else 0
+            )
+    windows = counters.get(tele.C_RESIDENT_WINDOWS, 0)
+    if not windows and "ingest" not in per_pass:
+        return {}
+    ingest = per_pass.get("ingest", 0)
+    dispatch = per_pass.get("observe", 0) + per_pass.get("apply", 0)
+    entries = (doc.get("compiles") or {}).get("entries") or []
+    donated = [
+        e for e in entries
+        if any(k in str(e.get("kernel", ""))
+               for k in ("pack2", "observe_packed"))
+    ]
+    return {
+        "windows": windows,
+        "bytes": counters.get(tele.C_RESIDENT_BYTES, 0),
+        "released": counters.get(tele.C_RESIDENT_RELEASED, 0),
+        "evicted": counters.get(tele.C_RESIDENT_EVICTED, 0),
+        "h2d_by_pass": dict(sorted(per_pass.items())),
+        "ingest_only": bool(
+            windows and ingest and dispatch <= 0.25 * ingest
+        ),
+        "donated_compiles": {
+            "count": len(donated),
+            "in_window": sum(
+                1 for e in donated if e.get("in_window")
+            ),
+        },
+    }
+
+
 def _compile_report(doc: dict, counters: dict) -> dict:
     """Compile-cache section: hit/miss counts plus the cold-compile
     entry list, with the ``in_window`` subset split out — every entry
@@ -536,6 +583,9 @@ def analyze(doc: dict) -> dict:
         # cold-compile warnings, HBM footprint
         "transfers": _transfer_report(doc, counters),
         "compiles": _compile_report(doc, counters),
+        # device-resident windows: per-pass h2d table + ingest-only
+        # verdict + donated-executable prewarm coverage
+        "residency": _residency_report(doc, counters),
         "hbm": _hbm_report(doc, devices),
         # the write-tail byte decomposition (encode in -> arrow out ->
         # parquet on disk) beside the stage walls it explains
@@ -685,6 +735,34 @@ def render_report(report: dict) -> str:
                     f"    {e['kernel']}[{shape}] on device {e['device']}"
                     f": {_fmt_s(e['seconds'])} s"
                 )
+    res = report.get("residency") or {}
+    if res:
+        out += ["", "Device residency (ingest-once H2D)"]
+        out.append(
+            f"  resident windows {res['windows']} "
+            f"({_fmt_bytes(res['bytes'])} placed), released "
+            f"{res['released']}, evicted {res['evicted']}"
+        )
+        by_pass = ", ".join(
+            f"{p}={_fmt_bytes(b)}"
+            for p, b in (res.get("h2d_by_pass") or {}).items()
+        )
+        if by_pass:
+            out.append(f"  per-pass h2d: {by_pass}")
+        out.append(
+            "  verdict: h2d is ingest-only"
+            if res.get("ingest_only") else
+            "  verdict: h2d is NOT ingest-only — observe/apply "
+            "re-shipped window payloads (residency off, handles "
+            "dropped, or a regression the residency staticcheck rule "
+            "should have caught)"
+        )
+        dc = res.get("donated_compiles") or {}
+        if dc.get("count"):
+            out.append(
+                f"  donated-signature executables: {dc['count']} "
+                f"compiled, {dc['in_window']} inside timed windows"
+            )
     hbm = report.get("hbm") or {}
     if hbm:
         out += ["", "HBM footprint"]

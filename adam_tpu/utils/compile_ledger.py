@@ -90,6 +90,19 @@ def device_cache_key(device) -> str:
     return _device_key(device)
 
 
+def claim(key: tuple, device=None) -> None:
+    """Assert a (kernel, shape, device) triple warm without recording
+    anything — the prewarm's dedupe-skip path calls this so the ledger
+    seen-set re-agrees with the prewarm cache.  The two can diverge
+    after a faulted run: a dispatch that RAISES gives its track claim
+    back (so the retry re-measures) while the jit executable it built
+    stays cached and the prewarm cache keeps the triple — without this
+    re-seed, the next clean run's first dispatch of the triple would
+    read as a false in-window cold compile."""
+    with _LOCK:
+        _SEEN.add((key, device_cache_key(device)))
+
+
 class track:
     """Context manager for one jit dispatch: times the call and records
     hit/miss against the process-wide seen-set.

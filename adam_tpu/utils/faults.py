@@ -69,7 +69,9 @@ KNOWN_POINTS = frozenset({
     # scripts/chaos-kill-resume): the streamed pipeline arrives at this
     # site once per phase step, with the PHASE name in the ``device``
     # attribution slot — ``ingest`` (per tokenized window), ``pass_a``
-    # (per window summary), ``barrier2`` (before the observe merge and
+    # (per window summary), ``pass_b`` (per observed window — the
+    # mid-observe leg that exercises killing a run with device-resident
+    # windows in flight), ``barrier2`` (before the observe merge and
     # again after the solve), ``pass_c`` (per part submit) and ``write``
     # (after each part's atomic publish) — so a clause like
     # ``proc.kill=kill,device=pass_c,after=3,times=1`` SIGKILLs the

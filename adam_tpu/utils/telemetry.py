@@ -253,6 +253,21 @@ C_D2H_BYTES = _metric("device.d2h.bytes")
 H_H2D_BPS = _metric("device.h2d.bps")
 H_D2H_BPS = _metric("device.d2h.bps")
 
+# ---- device-resident windows (parallel/device_pool.ResidentWindow,
+# docs/PERF.md "Device-resident windows"): each window's bases/quals
+# land on device once at ingest (the ``ingest`` pass bucket in the
+# transfers section) and stay resident through markdup -> observe ->
+# apply.  Counters: windows placed resident / total bytes placed /
+# refcounted releases after pass C / handles dropped by an eviction or
+# mesh degradation (their windows re-ship from the host ingest copy).
+# The gauge tracks live resident bytes — back to 0 at run end, the
+# no-HBM-growth invariant tests/test_resident.py asserts. ----
+C_RESIDENT_WINDOWS = _metric("device.resident.windows")
+C_RESIDENT_BYTES = _metric("device.resident.bytes")
+C_RESIDENT_RELEASED = _metric("device.resident.released")
+C_RESIDENT_EVICTED = _metric("device.resident.evicted")
+G_RESIDENT_LIVE = _metric("device.resident.live_bytes")
+
 # ---- compile ledger (utils/compile_ledger.py wraps every streamed jit
 # dispatch site): per-dispatch executable-cache hit/miss accounting
 # keyed by (kernel, grid shape, device).  A miss's duration is the

@@ -222,8 +222,8 @@ _KILL_DRIVER = (
 #: (phase, arrival offset) — one SIGKILL at each phase boundary the
 #: proc.kill fault point exposes (docs/ROBUSTNESS.md)
 _KILL_MATRIX = [
-    ("ingest", 3), ("pass_a", 4), ("barrier2", 0), ("pass_c", 2),
-    ("write", 1),
+    ("ingest", 3), ("pass_a", 4), ("pass_b", 2), ("barrier2", 0),
+    ("pass_c", 2), ("write", 1),
 ]
 
 
