@@ -681,6 +681,26 @@ class Serve(Command):
             "stays resumable, surviving jobs are untouched)",
         )
         p.add_argument(
+            "--batch", dest="batch", action="store_true", default=None,
+            help="continuous cross-job window batching "
+            "(serve/batching.py, docs/SERVING.md): concurrent jobs' "
+            "windows merge into one fused device dispatch per pass, "
+            "WFQ-ordered, with a bounded coalescing delay "
+            "(ADAM_TPU_BATCH_WAIT_MS, default 25 ms); every job's "
+            "output stays byte-identical to its solo run.  Default: "
+            "ADAM_TPU_BATCH, off",
+        )
+        p.add_argument(
+            "--quota", dest="quota", default=None, metavar="SPEC",
+            help="per-tenant rolling-window budgets, e.g. "
+            "'tenantA:bytes=512M,compute=10s;*:bytes=1G' (window "
+            "ADAM_TPU_QUOTA_WINDOW_S, default 60 s): an over-budget "
+            "tenant's submissions are refused with a typed quota "
+            "rejection (HTTP 429 on the gateway) carrying a "
+            "budget-derived Retry-After; other tenants are untouched.  "
+            "Default: ADAM_TPU_QUOTA, none",
+        )
+        p.add_argument(
             "--listen", dest="listen", default=None, metavar="HOST:PORT",
             help="serve the HTTP gateway on HOST:PORT (port 0 = OS-"
             "assigned; the bound address publishes durably to "
@@ -727,6 +747,8 @@ class Serve(Command):
             devices=getattr(args, "devices", None),
             partitioner=getattr(args, "partitioner", None),
             job_retries=args.job_retries,
+            batching=args.batch,
+            quota=args.quota,
         )
         gw = None
         if listen is not None:

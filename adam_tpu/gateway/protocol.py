@@ -76,9 +76,13 @@ def events_ctrl_line(cursor: int) -> dict:
 
 #: Typed back-pressure mapping (docs/SERVING.md): the scheduler's
 #: ``Busy.kind`` to the HTTP status the gateway answers with.  429 is
-#: "slots full, retry with backoff"; 503 is "going away (drain) or
-#: transiently unhealthy" — both carry Retry-After.
-BUSY_HTTP_STATUS = {"capacity": 429, "draining": 503}
+#: "come back later, the refusal is about YOU" — either ``capacity``
+#: (slots full; Retry-After from the WFQ grant cadence) or ``quota``
+#: (the tenant spent its rolling-window budget; Retry-After is
+#: budget-derived, carried on the Busy itself) — clients branch on the
+#: error document's ``kind``.  503 is "going away (drain) or
+#: transiently unhealthy".  All carry Retry-After.
+BUSY_HTTP_STATUS = {"capacity": 429, "quota": 429, "draining": 503}
 
 #: Part names the gateway will serve: the ``part-r-NNNNN.parquet``
 #: writer contract (io/parquet.py) plus the realigned-tail part —

@@ -22,7 +22,7 @@ publish atomically.  What the gateway ADDS is the protocol surface
   grant cadence (gateway/protocol.retry_after_s), so clients back off
   at the pace the pool is actually draining windows.
 * **Resumable event streaming** — ``GET /v1/jobs/<job>/events`` tails
-  the job's ``adam_tpu.heartbeat/3`` NDJSON stream as a chunked
+  the job's ``adam_tpu.heartbeat/4`` NDJSON stream as a chunked
   response, resumable from a line ``cursor`` (a tailer that
   reconnects re-requests from its last count; a heartbeat-file
   rotation resets the cursor, exactly like ``adam-tpu top``'s
@@ -307,10 +307,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_busy(self, busy: Busy) -> None:
         status = protocol.BUSY_HTTP_STATUS.get(busy.kind, 429)
-        retry = protocol.retry_after_s(
-            self.gw.service.scheduler.grant_times(),
-            now=protocol.now_monotonic(),
-        )
+        # the quota leg carries its own budget-derived hint (when the
+        # tenant's rolling window frees enough spend, serve/quota.py)
+        # — it OVERRIDES the grant-cadence estimate, which describes
+        # slot turnover, not budget refill
+        retry = getattr(busy, "retry_after_s", None)
+        if retry is None:
+            retry = protocol.retry_after_s(
+                self.gw.service.scheduler.grant_times(),
+                now=protocol.now_monotonic(),
+            )
         tele.TRACE.count(tele.C_GW_BUSY)
         self._send_json(
             status,

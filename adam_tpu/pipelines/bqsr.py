@@ -428,6 +428,63 @@ def _observe_device(
                              resident)
 
 
+def observe_read_mask(b, has_md: np.ndarray) -> np.ndarray:
+    """The canonical-read filter of the observe pass (primary, mapped,
+    not duplicate, qual present, 0 < mapq < 255, passed vendor QC, MD
+    present) -> bool[N].  ONE copy of the expression, shared by the
+    solo dispatch below and the cross-job coalescer's fused grid
+    (serve/batching.py) — bitwise the same filter on either path."""
+    flags = np.asarray(b.flags)
+    return (
+        np.asarray(b.valid)
+        & ((flags & schema.FLAG_UNMAPPED) == 0)
+        & ((flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0)
+        & ((flags & schema.FLAG_DUPLICATE) == 0)
+        & ((flags & schema.FLAG_FAILED_QC) == 0)
+        & np.asarray(b.has_qual)
+        & (np.asarray(b.mapq) > 0)
+        & (np.asarray(b.mapq) != 255)
+        & has_md
+    )
+
+
+def observe_residue_mask(
+    ds: AlignmentDataset, b, known_snps: Optional[SnpTable]
+) -> np.ndarray:
+    """The per-residue observe filter (q > 0, regular ACGT base,
+    aligned to reference, not a known SNP) -> bool[N, L] — shared by
+    the device/numpy solo paths and the coalescer's fused payload."""
+    ref_pos = cigar_ops.reference_positions_np(
+        b.cigar_ops, b.cigar_lens, b.cigar_n, b.start, b.lmax
+    )
+    quals = np.asarray(b.quals)
+    rok = (
+        (quals > 0) & (quals < schema.QUAL_PAD)
+        & (np.asarray(b.bases) < 4) & (ref_pos >= 0)
+    )
+    if known_snps is not None and len(known_snps):
+        rok &= ~known_snps.mask_positions(
+            ds.seq_dict.names, np.asarray(b.contig_idx), ref_pos
+        )
+    return rok
+
+
+def observe_inputs(ds: AlignmentDataset, known_snps=None) -> tuple:
+    """Host-side observe-pass inputs for one window ->
+    ``(b, read_ok, residue_ok, is_mm, n_rg)`` — exactly the arrays the
+    device scatter-add consumes.  The cross-job coalescer
+    (serve/batching.py) builds its fused ``[N_total, L]`` grid from
+    these, so a coalesced window's per-job histogram slice is bitwise
+    the solo kernel's output."""
+    b = ds.batch.to_numpy()
+    is_mm, _, has_md = batch_md_arrays(
+        ds.batch, ds.sidecar, need_ref_codes=False
+    )
+    read_ok = observe_read_mask(b, has_md)
+    residue_ok = observe_residue_mask(ds, b, known_snps)
+    return b, read_ok, residue_ok, is_mm, len(ds.read_groups) + 1
+
+
 def _observe_impl(
     ds: AlignmentDataset, known_snps: Optional[SnpTable], backend: str,
     device=None, mesh=None, resident=None,
@@ -452,18 +509,7 @@ def _observe_impl(
             ds.batch, ds.sidecar, need_ref_codes=False
         )
 
-    flags = np.asarray(b.flags)
-    read_ok = (
-        np.asarray(b.valid)
-        & ((flags & schema.FLAG_UNMAPPED) == 0)
-        & ((flags & (schema.FLAG_SECONDARY | schema.FLAG_SUPPLEMENTARY)) == 0)
-        & ((flags & schema.FLAG_DUPLICATE) == 0)
-        & ((flags & schema.FLAG_FAILED_QC) == 0)
-        & np.asarray(b.has_qual)
-        & (np.asarray(b.mapq) > 0)
-        & (np.asarray(b.mapq) != 255)
-        & has_md
-    )
+    read_ok = observe_read_mask(b, has_md)
 
     # one extra bin for RG-less reads (the reference's null readGroup)
     n_rg = len(ds.read_groups) + 1
@@ -481,21 +527,9 @@ def _observe_impl(
         snp_keys = known_snps.site_keys(ds.seq_dict.names)
 
     def _python_residue_mask():
-        # device/numpy backends: residue filter built host-side — q>0,
-        # ACGT base, aligned to reference, not a known SNP
-        ref_pos = cigar_ops.reference_positions_np(
-            b.cigar_ops, b.cigar_lens, b.cigar_n, b.start, lmax
-        )
-        quals = np.asarray(b.quals)
-        rok = (
-            (quals > 0) & (quals < schema.QUAL_PAD)
-            & (np.asarray(b.bases) < 4) & (ref_pos >= 0)
-        )
-        if snp_active:
-            rok &= ~known_snps.mask_positions(
-                ds.seq_dict.names, np.asarray(b.contig_idx), ref_pos
-            )
-        return rok
+        # device/numpy backends: residue filter built host-side (the
+        # module-level helper, shared with the cross-job coalescer)
+        return observe_residue_mask(ds, b, known_snps)
 
     nat = None
     if use_native:

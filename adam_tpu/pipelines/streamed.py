@@ -87,6 +87,13 @@ _SENTINEL = object()
 #: last pool device is evicted, or after the single default chip fails).
 _HOST = object()
 
+#: Sentinel for "this window's observe was submitted to the cross-job
+#: coalescer and its future parked on the caller's ``defer`` list" —
+#: pass B submits every window before resolving any, so the coalescer
+#: sees the whole window set and the job thread never serializes on a
+#: single fused dispatch.
+_DEFERRED = object()
+
 
 class RunCancelled(BaseException):
     """Cooperative stop at a window boundary (the multi-job service's
@@ -258,6 +265,7 @@ def transform_streamed(
     resume: bool = False,
     pacer=None,
     device_pool=None,
+    coalescer=None,
 ) -> dict:
     """Run the flagship transform as a streamed, overlapped pipeline.
 
@@ -309,6 +317,15 @@ def transform_streamed(
     shared pool for the run's own, so concurrent jobs place windows on
     the same chips; pacing and pool sharing change only where and when
     work runs, never the output bytes.
+
+    ``coalescer`` (a :class:`~adam_tpu.serve.batching.CoalescerClient`)
+    routes this run's per-window device dispatches through the
+    scheduler's cross-job :class:`~adam_tpu.serve.batching.WindowCoalescer`
+    so concurrent jobs' windows merge into ONE fused dispatch per pass
+    (docs/SERVING.md "Continuous batching & quotas").  Device backend +
+    pool partitioner only (the mesh already fuses the device set per
+    window); a coalesced window that fails falls back to this run's own
+    solo dispatch path — byte-identical output either way.
     """
     # Per-run tracer, ALWAYS recording: the returned stats dict is a
     # derived view of its span data (telemetry.streamed_stats_view), so
@@ -331,7 +348,7 @@ def transform_streamed(
             lod_threshold=lod_threshold, max_target_size=max_target_size,
             dump_observations=dump_observations, devices=devices,
             partitioner=partitioner, run_dir=run_dir, resume=resume,
-            pacer=pacer, device_pool=device_pool,
+            pacer=pacer, device_pool=device_pool, coalescer=coalescer,
         )
     except BaseException:
         # crashed run: the final heartbeat line must carry ok=false —
@@ -371,6 +388,7 @@ def _transform_streamed_impl(
     resume: bool,
     pacer=None,
     device_pool=None,
+    coalescer=None,
 ) -> dict:
     from adam_tpu.parallel import partitioner as part_mod
     from adam_tpu.pipelines import bqsr as bqsr_mod
@@ -455,6 +473,22 @@ def _transform_streamed_impl(
     # fused bases+quals pack (the bases half of the packed tail).
     use_resident = use_device and dp_mod.resident_windows_enabled()
     stats["resident_windows"] = 0
+    # cross-job window batching (serve/batching.py): the scheduler's
+    # coalescer client merges this job's per-window dispatches with its
+    # neighbors' into one fused dispatch per pass.  Device backend
+    # only; the per-hook guards additionally skip it while the mesh
+    # partitioner is live (the mesh already fuses the device set).
+    if not use_device:
+        coalescer = None
+    stats["batched"] = coalescer is not None
+
+    def _win_nbytes(b) -> int:
+        """A window's grant size (bytes) for the fairness ring / quota
+        leg: the per-residue payload the device passes actually move."""
+        try:
+            return int(b.bases.nbytes) + int(b.quals.nbytes)
+        except AttributeError:
+            return 0
     # pass-B windows folded into the mesh's device-resident observe
     # accumulator, kept referenced so a degrade can replay them through
     # the pool/host path; the host-side merge lists live up here too so
@@ -710,14 +744,32 @@ def _transform_streamed_impl(
     pend_cols: deque = deque()
     hb_queues.append((pend_cols, 2))  # items: (win, ds, dev, cols)
 
-    def _md_dispatch(win, batch):
+    def _md_dispatch(win, batch, coalesce=True):
         """Dispatch one window's [N, L] markdup reductions -> (device,
         lazy cols), walking to the next survivor after a spent retry
         budget; None = compute the summary on the host instead.  Under
         the mesh partitioner the window shards across every device at
         once (device tag ``"mesh"``); a mesh failure degrades to the
-        pool path and re-dispatches here."""
+        pool path and re-dispatches here.  With a coalescer attached
+        (and the pool partitioner live) the window submits to the
+        cross-job batch instead — device tag ``"batch"``, cols a
+        future; a coalesce failure re-enters here with
+        ``coalesce=False``."""
         mp = exec_state["mesh"]
+        if (
+            coalesce and coalescer is not None and mp is None
+            and not res["device_lost"]
+        ):
+            try:
+                fut = coalescer.submit_markdup(
+                    win, batch, resident_map.get(win)
+                )
+                return "batch", fut
+            except Exception as e:
+                log.warning(
+                    "coalesced markdup submit of window %d failed "
+                    "(%s); dispatching solo", win, e,
+                )
         if mp is not None:
             try:
                 cols = md_mod.markdup_columns_dispatch(
@@ -741,6 +793,28 @@ def _transform_streamed_impl(
         return _on_survivors(win, on_device, lambda: None)
 
     def _summarize(win, ds, dev, cols):
+        if dev == "batch":
+            # coalesced window: the future resolves to host (five,
+            # score) slices bitwise the solo columns; a fused-dispatch
+            # failure falls back to this window's own solo path (which
+            # owns eviction/replay/host-degrade)
+            try:
+                five, score = cols.result()
+            except Exception as e:
+                log.warning(
+                    "coalesced markdup of window %d fell back to the "
+                    "solo dispatch (%s)", win, e,
+                )
+                nxt = _md_dispatch(win, ds.batch, coalesce=False)
+                if nxt is None:
+                    summaries.append(md_mod.row_summary(ds))
+                    return
+                dev, cols = nxt
+            else:
+                summaries.append(md_mod.row_summary(
+                    ds, five_prime=five, score=score
+                ))
+                return
         while cols is not None:
             try:
                 with tr.span(tele.SPAN_MD_FETCH):
@@ -904,9 +978,11 @@ def _transform_streamed_impl(
                 # multi-job fairness / graceful drain: the scheduler's
                 # interleaver grants this job one window (or raises
                 # RunCancelled at this boundary — nothing is in flight
-                # for this window yet, so the resume re-runs it)
+                # for this window yet, so the resume re-runs it).  The
+                # grant carries the window's byte size, so the fairness
+                # ring can reason in bytes-per-grant (quota Retry-After)
                 if pacer is not None:
-                    pacer("pass_a", win)
+                    pacer("pass_a", win, _win_nbytes(batch))
                 # compile the grid-quantized kernel set for this
                 # window's grid shape BEFORE its device work — a
                 # 20-40 s cold remote compile must never serialize
@@ -1103,17 +1179,21 @@ def _transform_streamed_impl(
 
         return replay
 
-    def _observe_window(i, w):
+    def _observe_window(i, w, defer=None, coalesce=True):
         """Observe one window -> ((total, mism, g), replay hook) for
         the host-side merge, or **None when the histograms were folded
         into the mesh's device-resident accumulator** (nothing comes
-        home until barrier 2 fetches the one merged table).  Walks
+        home until barrier 2 fetches the one merged table), or
+        ``_DEFERRED`` when the window rode the cross-job coalescer and
+        its future was parked on ``defer`` (pass B resolves them after
+        every window has submitted).  Walks
         dispatch failures to the next survivor and to the host backend
         when the pool is gone; a mesh failure degrades to the pool path
         and replays the accumulated windows.  A histogram persisted by
         a previous run (the barrier sidecars) loads instead of
         recomputing — identical int64 sums, so the merge stays
-        bit-identical."""
+        bit-identical.  ``coalesce=False`` skips the coalescer (the
+        fused-failure fallback re-enters here solo)."""
         if journal is not None and journal.resumed:
             got = journal.load_observation(i)
             if got is not None:
@@ -1138,6 +1218,38 @@ def _transform_streamed_impl(
             except Exception as e:
                 _mesh_degrade(e, "pass-B observe")
                 # fall through: this window re-dispatches on the pool
+
+        if coalesce and coalescer is not None \
+                and exec_state["mesh"] is None \
+                and not res["device_lost"]:
+            # cross-job batching: this window's observe rides a fused
+            # dispatch; its read-group band of the fused histogram is
+            # bitwise the solo scatter-add, so the barrier merge (and
+            # everything downstream) cannot tell the difference.  Any
+            # failure falls through to the solo pool path below.
+            try:
+                fut = coalescer.submit_observe(
+                    i, w, known_snps, resident_map.get(i)
+                )
+            except Exception as e:
+                log.warning(
+                    "coalesced observe submit of window %d failed "
+                    "(%s); dispatching solo", i, e,
+                )
+            else:
+                if defer is not None:
+                    defer.append((i, w, fut))
+                    return _DEFERRED
+                try:
+                    with tele.pass_scope("observe"):
+                        got = fut.result()
+                except Exception as e:
+                    log.warning(
+                        "coalesced observe of window %d fell back to "
+                        "the solo dispatch (%s)", i, e,
+                    )
+                else:
+                    return got, None
 
         def on_device(dev):
             total, mism, _rg, g = bqsr_mod._observe_device(
@@ -1165,6 +1277,12 @@ def _transform_streamed_impl(
             return
         with tr.span(tele.SPAN_OBSERVE):
             if recalibrate:
+                # coalesced windows park their futures here and resolve
+                # AFTER every window has submitted: the coalescer sees
+                # the job's whole window set at once (maximal fusion)
+                # and the job thread keeps the solo path's overlap
+                # instead of serializing on each fused dispatch
+                deferred: list = []
                 for i, w in enumerate(windows):
                     if window_valid[i]:
                         # chaos-harness kill point: one arrival per
@@ -1180,11 +1298,27 @@ def _transform_streamed_impl(
                         # and fold into the device-resident accumulator
                         # (_observe_window returns None) — barrier 2
                         # fetches one merged table, not one per window.
-                        got = _observe_window(i, w)
+                        got = _observe_window(i, w, defer=deferred)
+                        if got is _DEFERRED:
+                            continue
                         if got is not None:
                             obs_parts.append(got[0])
                             obs_replays.append(got[1])
                             obs_windows.append(i)
+                for i, w, fut in deferred:
+                    try:
+                        with tele.pass_scope("observe"):
+                            got = (fut.result(), None)
+                    except Exception as e:
+                        log.warning(
+                            "coalesced observe of window %d fell back "
+                            "to the solo dispatch (%s)", i, e,
+                        )
+                        got = _observe_window(i, w, coalesce=False)
+                    if got is not None:
+                        obs_parts.append(got[0])
+                        obs_replays.append(got[1])
+                        obs_windows.append(i)
 
     # ---- tail: realign the gathered candidates (observing remainders
     # under the device wait), then observe the realigned part with its
@@ -1441,7 +1575,7 @@ def _transform_streamed_impl(
         # lost (it re-executes on resume) but every previously
         # submitted part still publishes and journals.
         if pacer is not None:
-            pacer("pass_c", idx)
+            pacer("pass_c", idx, _win_nbytes(ds.batch))
         # chaos-harness kill point: one arrival per fresh part submit
         faults.point("proc.kill", device="pass_c")
         pool.submit(_part_path(out_path, idx), ds.batch, ds.sidecar,
@@ -1681,8 +1815,54 @@ def _transform_streamed_impl(
                     p_idx, on_device, lambda: _host_apply(w)
                 )
 
+        def _solo_apply_sync(p_idx, w):
+            """Synchronous solo apply -> (dataset, packed | None) for a
+            window whose coalesced dispatch failed: the normal survivor
+            walk with the same packed/resident fast paths the solo
+            dispatch loop uses, host backend when the device path is
+            gone — byte-identical output either way."""
+
+            def on_device(nd):
+                h = bqsr_mod.apply_recalibration_dispatch(
+                    w, _device_table(nd), gl, backend, device=nd,
+                    pack=use_packed, resident=resident_map.get(p_idx),
+                )
+                return bqsr_mod.apply_recalibration_finish_packed(h)
+
+            return _on_survivors(
+                p_idx, on_device, lambda: (_host_apply(w), None)
+            )
+
         def _fetch_one():
             p_idx, p_dev, p_handle = pend_q.popleft()
+            if p_dev == "batch":
+                # coalesced window: the future resolves to a standard
+                # dispatch handle whose payload is already host-
+                # resident (the coalescer fetched the fused output
+                # once and split it per job)
+                p_packed = None
+                try:
+                    handle = p_handle.result()
+                    with tr.span(
+                        tele.SPAN_APPLY_FETCH, window=p_idx,
+                        device="batch",
+                    ):
+                        done, p_packed = (
+                            bqsr_mod.apply_recalibration_finish_packed(
+                                handle
+                            )
+                        )
+                except Exception as e:
+                    log.warning(
+                        "coalesced apply of window %d fell back to "
+                        "the solo dispatch (%s)", p_idx, e,
+                    )
+                    done, p_packed = _solo_apply_sync(
+                        p_idx, p_handle.dataset
+                    )
+                _submit(p_idx, done, p_packed)
+                _release_resident(p_idx)
+                return
             attrs = dp_mod.span_attrs(p_dev)
             p_packed = None
             try:
@@ -1713,6 +1893,32 @@ def _transform_streamed_impl(
         for j in range(len(plist)):
             idx, w = plist[j]
             plist[j] = None  # the list must not pin every window
+
+            if coalescer is not None and not res["device_lost"]:
+                # cross-job batching: the window's apply rides a fused
+                # dispatch (per-job table band + per-job payload split
+                # on the fetch); the future joins the same in-flight
+                # queue as a solo handle, so the double buffer and the
+                # writer-pool overlap are unchanged
+                try:
+                    fut = coalescer.submit_apply(
+                        idx, w, table, pack=use_packed,
+                        resident=resident_map.get(idx),
+                    )
+                except Exception as e:
+                    log.warning(
+                        "coalesced apply submit of window %d failed "
+                        "(%s); dispatching solo", idx, e,
+                    )
+                else:
+                    pend_q.append((idx, "batch", fut))
+                    tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_q))
+                    del w
+                    if idx < len(windows):
+                        windows[idx] = None  # free as we go
+                    if len(pend_q) >= apply_depth:
+                        _fetch_one()
+                    continue
 
             def _dispatch_one(dev, idx=idx, w=w):
                 with tr.span(

@@ -8,12 +8,20 @@ scheduling").
   interleaving (virtual-time fair queuing over the shared pool).
 * :mod:`adam_tpu.serve.scheduler` — admission control, job quarantine,
   graceful drain and whole-process crash recovery.
+* :mod:`adam_tpu.serve.batching` — continuous cross-job window
+  batching: the :class:`WindowCoalescer` merges concurrent jobs'
+  windows into one fused dispatch per pass (docs/SERVING.md
+  "Continuous batching & quotas").
+* :mod:`adam_tpu.serve.quota` — per-tenant rolling-window byte/compute
+  budgets, surfaced as the gateway's typed 429 quota leg.
 
 The thin front-ends live next door: ``adam_tpu/api/transform_service``
 is the library submission seam, ``adam-tpu serve`` the CLI one.
 """
 
+from adam_tpu.serve.batching import WindowCoalescer, batching_enabled
 from adam_tpu.serve.fairness import WeightedInterleaver
+from adam_tpu.serve.quota import QuotaManager
 from adam_tpu.serve.job import (
     DONE,
     INTERRUPTED,
@@ -35,7 +43,10 @@ __all__ = [
     "JobSpec",
     "PENDING",
     "QUARANTINED",
+    "QuotaManager",
     "RUNNING",
     "WeightedInterleaver",
+    "WindowCoalescer",
+    "batching_enabled",
     "default_job_retries",
 ]

@@ -121,7 +121,7 @@ class WeightedInterleaver:
     def grant_history(self) -> list:
         """Recent grants as job ids, oldest first (bounded ring)."""
         with self._lock:
-            return [job for job, _ in self._grants]
+            return [job for job, _, _ in self._grants]
 
     def grant_times(self, last: Optional[int] = None) -> list:
         """Monotonic timestamps of recent grants, oldest first (the
@@ -131,18 +131,41 @@ class WeightedInterleaver:
         after a batch of windows has drained" is the honest estimate
         of when a slot could free (docs/SERVING.md back-pressure)."""
         with self._lock:
-            times = [t for _, t in self._grants]
+            times = [t for _, t, _ in self._grants]
         return times if last is None else times[-last:]
+
+    def grant_records(self, last: Optional[int] = None) -> list:
+        """Recent grants as ``(monotonic time, size)`` pairs, oldest
+        first.  ``size`` is the granted window's byte payload (the
+        streamed pipeline passes it through the pacer seam; 0 when the
+        caller predates sizes) — the quota leg's Retry-After derives
+        from bytes-per-grant here instead of grant cadence alone
+        (serve/quota.rate_retry_hint)."""
+        with self._lock:
+            recs = [(t, s) for _, t, s in self._grants]
+        return recs if last is None else recs[-last:]
+
+    def tenant_clock(self, tenant: str) -> Optional[float]:
+        """The tenant's WFQ virtual clock (None when unknown) — the
+        cross-job coalescer orders the row blocks of a fused dispatch
+        by it, so the most underserved tenant's windows lead the grid
+        exactly as they would have led the solo grant order."""
+        with self._lock:
+            t = self._tenants.get(tenant)
+            return t.vt if t is not None else None
 
     # ---- the pacing hot path -------------------------------------------
     def pacer(self, job: str):
-        """The per-job ``pacer(phase, index)`` hook the scheduler hands
-        to ``transform_streamed`` — one fault point + one turn per
-        window boundary."""
+        """The per-job ``pacer(phase, index, size)`` hook the scheduler
+        hands to ``transform_streamed`` — one fault point + one turn
+        per window boundary.  ``size`` is the window's byte payload
+        (0 from callers that predate sizes); it lands in the grant
+        ring so the quota leg can reason in bytes-per-grant."""
 
-        def pace(phase: str, index: int, _job=job) -> None:
+        def pace(phase: str, index: int, size: int = 0,
+                 _job=job) -> None:
             faults.point("sched.dispatch", device=_job)
-            self.turn(_job)
+            self.turn(_job, size=size)
 
         return pace
 
@@ -162,12 +185,13 @@ class WeightedInterleaver:
                 best_lane = lane
         return best_lane
 
-    def turn(self, job: str) -> None:
+    def turn(self, job: str, size: int = 0) -> None:
         """Block until this job's tenant is granted the next window.
 
         Unregistered jobs free-run (a pacer outliving its lane must not
         deadlock teardown).  Raises ``RunCancelled`` once the job — or
-        the whole pool — is cancelled."""
+        the whole pool — is cancelled.  ``size`` (bytes this grant
+        covers) is recorded in the grant ring beside the timestamp."""
         with self._lock:
             lane = self._lanes.get(job)
             if lane is None:
@@ -188,7 +212,9 @@ class WeightedInterleaver:
                     if self._next_waiter_locked() is lane:
                         self._vtime = t.vt
                         t.vt += 1.0 / t.weight
-                        self._grants.append((job, time.monotonic()))
+                        self._grants.append(
+                            (job, time.monotonic(), int(size))
+                        )
                         self._cond.notify_all()
                         return
                     self._cond.wait(_WAIT_S)

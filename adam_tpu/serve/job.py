@@ -106,10 +106,16 @@ class Admitted:
 class Busy:
     """Typed admission rejection — the bounded-slots contract: a full
     or draining scheduler REFUSES instead of queueing unboundedly.
-    ``kind`` is one of ``capacity`` / ``draining`` / ``duplicate``."""
+    ``kind`` is one of ``capacity`` / ``draining`` / ``duplicate`` /
+    ``quota`` (the tenant spent its rolling-window byte/compute
+    budget, serve/quota.py — the gateway's 429 quota leg).
+    ``retry_after_s``, when set, is a budget-derived hint that
+    OVERRIDES the gateway's grant-cadence Retry-After: quota frees on
+    the rolling window's schedule, not at job-slot turnover speed."""
 
     reason: str
     kind: str = "capacity"
+    retry_after_s: Optional[int] = None
 
 
 @dataclass

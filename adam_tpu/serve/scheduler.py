@@ -31,7 +31,7 @@ multi-job scheduling"):
   would crash-loop the pool).
 
 Every job runs in its own thread with its own run tracer and its own
-``adam_tpu.heartbeat/3`` stream at ``<run-root>/<job>/heartbeat.ndjson``
+``adam_tpu.heartbeat/4`` stream at ``<run-root>/<job>/heartbeat.ndjson``
 (``adam-tpu top <run-root>`` aggregates them).  The ``sched.*`` fault
 points (``sched.admit`` / ``sched.dispatch`` / ``sched.drain`` /
 ``sched.job_crash``, job id in the ``device`` selector slot) extend the
@@ -96,7 +96,13 @@ class JobScheduler:
     def __init__(self, run_root: str, *, max_jobs: int = 2,
                  devices: Optional[int] = None,
                  partitioner: Optional[str] = None,
-                 job_retries: Optional[int] = None):
+                 job_retries: Optional[int] = None,
+                 batching: Optional[bool] = None,
+                 batch_wait_ms: Optional[float] = None,
+                 quota=None):
+        from adam_tpu.serve.batching import batching_enabled
+        from adam_tpu.serve.quota import QuotaManager, quota_from_env
+
         self.run_root = os.path.abspath(run_root)
         os.makedirs(self.run_root, exist_ok=True)
         self.max_jobs = max(1, max_jobs)
@@ -106,6 +112,23 @@ class JobScheduler:
             job_retries if job_retries is not None
             else default_job_retries()
         )
+        # cross-job window batching (serve/batching.py; `--batch` /
+        # ADAM_TPU_BATCH, default off): the coalescer itself is built
+        # lazily with the shared pool on the first job start
+        self.batching = (
+            batching_enabled() if batching is None else bool(batching)
+        )
+        self._batch_wait_ms = batch_wait_ms
+        self._coalescer = None
+        # per-tenant quota enforcement (serve/quota.py; `--quota` /
+        # ADAM_TPU_QUOTA, default none): accepts a ready QuotaManager,
+        # a grammar string, or None (then the environment decides)
+        if quota is None:
+            self._quota = quota_from_env()
+        elif isinstance(quota, str):
+            self._quota = QuotaManager(quota) if quota.strip() else None
+        else:
+            self._quota = quota
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # serializes JOB.json rewrites: a submit/recover thread and the
@@ -221,6 +244,42 @@ class JobScheduler:
                     f"job {spec.job_id!r} is already {prior.state}",
                     kind="duplicate",
                 )
+            # per-tenant quota gate (serve/quota.py): an over-budget
+            # tenant's FRESH submissions are refused with the typed
+            # quota leg + a budget-derived Retry-After before they can
+            # take a slot — other tenants are untouched.  Deliberately
+            # AFTER the duplicate check (an idempotent re-PUT of a live
+            # job must keep answering duplicate, never 429) and skipped
+            # for resubmissions of a known job (prior is not None:
+            # resuming an interrupted/quarantined journal) and for
+            # crash recovery — that spend already happened, and
+            # refusing the resume would strand a journal.
+            if (
+                self._quota is not None and not recovered
+                and prior is None
+            ):
+                exceeded = self._quota.check(spec.tenant)
+                if exceeded is not None:
+                    from adam_tpu.serve.quota import rate_retry_hint
+
+                    hint = exceeded.retry_after_s
+                    if exceeded.resource == "bytes":
+                        # bytes-per-grant refinement: the fairness
+                        # ring's sized grants estimate how fast the
+                        # service actually burns bytes — the larger
+                        # (more honest) of the two hints wins
+                        rh = rate_retry_hint(
+                            exceeded.used - exceeded.budget,
+                            self._interleaver.grant_records(64),
+                        )
+                        if rh is not None:
+                            hint = max(hint, rh)
+                    tele.TRACE.count(tele.C_SCHED_REJECTED)
+                    tele.TRACE.count(tele.C_QUOTA_REJECTED)
+                    return Busy(
+                        exceeded.reason, kind="quota",
+                        retry_after_s=hint,
+                    )
             if not recovered and self._active_count_locked() >= self.max_jobs:
                 tele.TRACE.count(tele.C_SCHED_REJECTED)
                 return Busy(
@@ -289,6 +348,74 @@ class JobScheduler:
             self._pool = pool
         return pool
 
+    def _ensure_coalescer(self):
+        """Build the shared cross-job coalescer once (with the shared
+        pool, the WFQ interleaver and the quota manager attached).
+        None once the scheduler is closed — a job thread racing
+        ``close()`` must never rebuild a fresh coalescer whose
+        dispatcher thread nothing would ever stop."""
+        from adam_tpu.serve.batching import WindowCoalescer
+
+        with self._lock:
+            if self._closed:
+                return None
+            if self._coalescer is not None:
+                return self._coalescer
+        pool = self._get_pool()
+        with self._lock:
+            if self._closed:
+                return None
+            if self._coalescer is None:
+                self._coalescer = WindowCoalescer(
+                    pool=pool, wait_ms=self._batch_wait_ms,
+                    interleaver=self._interleaver, quota=self._quota,
+                )
+            return self._coalescer
+
+    def _job_coalesces(self, spec: JobSpec) -> bool:
+        """True when this job's dispatches can actually reach the
+        coalescer: the device backend (the coalescer fuses device
+        dispatches only) and a non-mesh EFFECTIVE execution mode —
+        resolved the same way the pipeline resolves them (spec override
+        → scheduler default → the ``ADAM_TPU_*`` environment), so an
+        env-pinned mesh or host-backend job never sits in the eligible
+        set as a silent member."""
+        try:
+            from adam_tpu.parallel.partitioner import (
+                resolve_execution_mode,
+            )
+            from adam_tpu.pipelines.bqsr import bqsr_backend
+
+            if bqsr_backend() != "device":
+                return False
+            return resolve_execution_mode(
+                spec.partitioner if spec.partitioner
+                else self.partitioner
+            ) != "mesh"
+        except Exception:
+            # a malformed backend/partitioner env surfaces from the
+            # job's own run with proper attribution; here it just
+            # means "don't register"
+            return False
+
+    def _job_pacer(self, spec: JobSpec):
+        """The job's pacer: the WFQ turn plus the quota byte charge —
+        every grant's window payload size lands on the tenant's
+        rolling-window budget (the device-ledger-shaped byte leg; the
+        coalescer charges the compute leg per fused dispatch)."""
+        inner = self._interleaver.pacer(spec.job_id)
+        quota = self._quota
+        if quota is None:
+            return inner
+        tenant = spec.tenant
+
+        def pace(phase: str, index: int, size: int = 0) -> None:
+            inner(phase, index, size)
+            if size:
+                quota.charge(tenant, nbytes=size)
+
+        return pace
+
     # ---- the job runner -------------------------------------------------
     def _set_state(self, rec: JobRecord, state: str,
                    error: Optional[str] = None) -> None:
@@ -303,11 +430,25 @@ class JobScheduler:
         spec = rec.spec
         resume = rec.recovered
         lease = None
+        coal = None
+        coal_client = None
         try:
             self._set_state(rec, RUNNING)
             pool = self._get_pool()
             if pool is not None:
                 lease = pool.lease(job=spec.job_id)
+            if self.batching and self._job_coalesces(spec):
+                # cross-job batching: register this job with the shared
+                # coalescer and hand its bound client to the pipeline.
+                # Jobs that can never submit tickets (mesh execution
+                # mode — the mesh already fuses the device set per
+                # window — or a non-device backend) are skipped
+                # outright: a registered-but-silent member would force
+                # every other job's group to wait out the full batching
+                # delay instead of flushing early.
+                coal = self._ensure_coalescer()
+                if coal is not None:
+                    coal_client = coal.client(spec.job_id, spec.tenant)
             known_snps = known_indels = None
             while True:
                 try:
@@ -336,8 +477,9 @@ class JobScheduler:
                             progress=self.heartbeat_path(spec.job_id),
                             run_dir=self.job_run_dir(spec.job_id),
                             resume=resume,
-                            pacer=self._interleaver.pacer(spec.job_id),
+                            pacer=self._job_pacer(spec),
                             device_pool=lease,
+                            coalescer=coal_client,
                         )
                     with self._lock:
                         rec.stats = stats
@@ -388,6 +530,10 @@ class JobScheduler:
         finally:
             if lease is not None:
                 lease.release()
+            if coal is not None:
+                # drop out of the coalesce-eligible set FIRST: groups
+                # waiting for this job's windows flush immediately
+                coal.deregister(spec.job_id)
             self._interleaver.deregister(spec.job_id)
             self._gauge_active()
             with self._lock:
@@ -452,6 +598,16 @@ class JobScheduler:
         gateway's Retry-After signal; serve/fairness.py)."""
         return self._interleaver.grant_times(last)
 
+    def grant_records(self, last: Optional[int] = None) -> list:
+        """Recent ``(time, size)`` grant records — the bytes-per-grant
+        view the quota leg's Retry-After derives from."""
+        return self._interleaver.grant_records(last)
+
+    @property
+    def quota(self):
+        """The per-tenant QuotaManager (None when quotas are off)."""
+        return self._quota
+
     def has_capacity(self) -> bool:
         """True when a submission would not be refused for capacity or
         draining — the polite client's pre-check, so a capacity poll
@@ -489,6 +645,10 @@ class JobScheduler:
             self._closed = True
             hb = self._service_hb
             self._service_hb = None
+            coal = self._coalescer
+            self._coalescer = None
+        if coal is not None:
+            coal.stop()
         if hb is not None:
             hb.stop()
         tele.TRACE.recording = self._restore_recording
@@ -582,6 +742,10 @@ class JobScheduler:
             "run_root": self.run_root,
             "max_jobs": self.max_jobs,
             "draining": draining,
+            "batching": self.batching,
+            "quota": (
+                self._quota.status() if self._quota is not None else None
+            ),
             "active_leases": (
                 [lz.job for lz in pool.active_leases()]
                 if pool is not None else []

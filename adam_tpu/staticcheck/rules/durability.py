@@ -40,6 +40,12 @@ SCOPE_FILES = frozenset({
     # they must publish through utils/durability like every other
     # resume-bearing artifact
     "adam_tpu/serve/scheduler.py",
+    # the cross-job coalescer and quota manager sit ON the output path
+    # (fused pass-C dispatches feed the part writers) but own no
+    # durable artifacts of their own — any file write they grew would
+    # bypass the staging + durable-publish protocol
+    "adam_tpu/serve/batching.py",
+    "adam_tpu/serve/quota.py",
     # the gateway's discovery document (gateway.json) and the client's
     # verified part downloads are resume-bearing too: a fetched part
     # must publish exactly like a written one (staging name + durable

@@ -52,6 +52,10 @@ SCOPE_FILES = (
     "adam_tpu/pipelines/streamed.py",
     "adam_tpu/parallel/device_pool.py",
     "adam_tpu/parallel/partitioner.py",
+    # the cross-job coalescer dispatches fused grids built from
+    # ResidentWindow slices; its non-resident re-ship fallbacks must
+    # stay visibly fallbacks (serve/batching.py)
+    "adam_tpu/serve/batching.py",
 )
 
 #: Call targets that place host arrays on device — plus the grid pad

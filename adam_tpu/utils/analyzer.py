@@ -515,6 +515,32 @@ def _hbm_report(doc: dict, devices: dict) -> dict:
 # --------------------------------------------------------------------------
 # Entry points
 # --------------------------------------------------------------------------
+def _batching_report(doc: dict, counters: dict, hists: dict) -> dict:
+    """Cross-job batching section (docs/SERVING.md "Continuous
+    batching & quotas"): fused-dispatch counts vs the windows they
+    carried (the dispatches-saved ratio), the grid-fill distribution,
+    fallback count, and per-tenant quota consumption from the
+    snapshot's ``quota`` ledger.  ``{}`` when the run never coalesced
+    (solo runs, batching off) — the section renders nothing."""
+    dispatches = counters.get(tele.C_BATCH_DISPATCHES, 0)
+    quota = doc.get("quota") or {}
+    if not dispatches and not quota:
+        return {}
+    windows = counters.get(tele.C_BATCH_WINDOWS, 0)
+    occ = counters.get(tele.C_BATCH_ROWS_OCCUPIED, 0)
+    disp_rows = counters.get(tele.C_BATCH_ROWS_DISPATCHED, 0)
+    return {
+        "dispatches": dispatches,
+        "windows": windows,
+        "dispatches_saved": max(0, windows - dispatches),
+        "fill": round(occ / disp_rows, 4) if disp_rows else None,
+        "fallbacks": counters.get(tele.C_BATCH_FALLBACKS, 0),
+        "fill_hist": (hists or {}).get(tele.H_BATCH_FILL),
+        "quota_rejected": counters.get(tele.C_QUOTA_REJECTED, 0),
+        "quota": quota,
+    }
+
+
 def _hist_rows(hists: dict) -> dict:
     return {
         name: {
@@ -590,6 +616,9 @@ def analyze(doc: dict) -> dict:
         # the write-tail byte decomposition (encode in -> arrow out ->
         # parquet on disk) beside the stage walls it explains
         "write_tail": _write_tail_report(counters),
+        # cross-job batching (serve/batching.py) + per-tenant quota
+        # consumption (serve/quota.py)
+        "batching": _batching_report(doc, counters, hists),
         "counters": {
             k: counters[k]
             for k in (
@@ -762,6 +791,46 @@ def render_report(report: dict) -> str:
             out.append(
                 f"  donated-signature executables: {dc['count']} "
                 f"compiled, {dc['in_window']} inside timed windows"
+            )
+    bat = report.get("batching") or {}
+    if bat:
+        out += ["", "Batching (cross-job window coalescing)"]
+        if bat.get("dispatches"):
+            fill = bat.get("fill")
+            out.append(
+                f"  {bat['windows']} window(s) in {bat['dispatches']} "
+                f"fused dispatch(es) — {bat['dispatches_saved']} "
+                "dispatch(es) saved vs solo"
+                + (f", grid fill {fill:.0%}" if fill is not None else "")
+            )
+            fh = bat.get("fill_hist")
+            if fh and fh.get("count"):
+                out.append(
+                    f"  fill distribution: p50 {_fmt_s(fh.get('p50'))}"
+                    f"  p90 {_fmt_s(fh.get('p90'))}"
+                    f"  min {_fmt_s(fh.get('min'))}"
+                    f"  max {_fmt_s(fh.get('max'))}"
+                )
+            if bat.get("fallbacks"):
+                out.append(
+                    f"  WARNING: {bat['fallbacks']} window(s) fell back "
+                    "to their solo dispatch path (fused-dispatch "
+                    "failures; output stays byte-identical)"
+                )
+        if bat.get("quota_rejected"):
+            out.append(
+                f"  quota rejections: {bat['quota_rejected']} "
+                "(typed 429 quota leg)"
+            )
+        for tenant, q in sorted((bat.get("quota") or {}).items()):
+            bb = q.get("budget_bytes")
+            bc = q.get("budget_compute_s")
+            out.append(
+                f"  tenant {tenant}: {_fmt_bytes(q.get('bytes', 0))}"
+                + (f" of {_fmt_bytes(bb)}" if bb is not None else "")
+                + f" bytes, {q.get('compute_s', 0.0):.3f}"
+                + (f" of {bc:g}" if bc is not None else "")
+                + f" s compute ({q.get('charges', 0)} charges)"
             )
     hbm = report.get("hbm") or {}
     if hbm:

@@ -76,7 +76,10 @@ KNOWN_POINTS = frozenset({
     # (after each part's atomic publish) — so a clause like
     # ``proc.kill=kill,device=pass_c,after=3,times=1`` SIGKILLs the
     # process at a chosen (or ``p=F,seed=N`` randomized-but-seeded)
-    # point without any cooperation from the code under test.
+    # point without any cooperation from the code under test.  The
+    # multi-job coalescer adds the ``batch`` phase (once per fused
+    # cross-job dispatch, on the dispatcher thread) — the mid-batch
+    # kill leg of the chaos matrix.
     "proc.kill",
     # multi-job transform service (adam_tpu/serve; docs/ROBUSTNESS.md
     # "Fault-isolated multi-job scheduling").  The ``device``
@@ -89,7 +92,13 @@ KNOWN_POINTS = frozenset({
     #   sched.job_crash  the top of every job run attempt — a
     #                    ``permanent`` clause keyed to one job id is the
     #                    canonical quarantine driver
+    #   sched.batch      each fused cross-job dispatch the window
+    #                    coalescer issues (serve/batching.py); the
+    #                    ``device`` slot carries the PASS KIND
+    #                    (markdup/observe/apply) — a failing clause
+    #                    drives the per-job solo-fallback path
     "sched.admit",
+    "sched.batch",
     "sched.dispatch",
     "sched.drain",
     "sched.job_crash",

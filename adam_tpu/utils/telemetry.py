@@ -222,6 +222,23 @@ C_SCHED_RECOVERED = _metric("sched.jobs.recovered")
 C_GW_REQUESTS = _metric("gateway.requests")
 C_GW_BUSY = _metric("gateway.busy")
 C_GW_BYTES_OUT = _metric("gateway.bytes_out")
+# cross-job window batching (adam_tpu/serve/batching.py; docs/SERVING.md
+# "Continuous batching & quotas"): fused device dispatches actually
+# issued by the coalescer, the per-job windows they carried (windows /
+# dispatches is the dispatches-saved ratio `adam-tpu analyze` prints),
+# real rows occupied vs grid rows dispatched (their running ratio is
+# the heartbeat's `batch_fill`), and windows that FELL BACK to their
+# job's solo dispatch path (a fused-dispatch failure isolates to the
+# tickets it carried; each job re-dispatches alone, byte-identically).
+C_BATCH_DISPATCHES = _metric("sched.batch.dispatches")
+C_BATCH_WINDOWS = _metric("sched.batch.windows")
+C_BATCH_ROWS_OCCUPIED = _metric("sched.batch.rows_occupied")
+C_BATCH_ROWS_DISPATCHED = _metric("sched.batch.rows_dispatched")
+C_BATCH_FALLBACKS = _metric("sched.batch.fallbacks")
+# per-tenant quota enforcement (adam_tpu/serve/quota.py): submissions
+# refused with the typed `Busy(kind="quota")` — the gateway's 429
+# quota leg, distinct from the capacity leg
+C_QUOTA_REJECTED = _metric("sched.quota.rejected")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
@@ -240,6 +257,10 @@ G_POOL_DEVICES = _metric("device.pool.devices")
 G_RESOLVE_DEVICE_SORT = _metric("streamed.resolve.device_sort")
 # live job-slot occupancy of the multi-job scheduler (adam_tpu/serve)
 G_SCHED_ACTIVE = _metric("sched.jobs.active")
+# distinct jobs the coalescer's LAST fused dispatch carried (the
+# heartbeat's `batched_jobs` field; 1 = batching on but traffic too
+# sparse to coalesce)
+G_BATCH_JOBS = _metric("sched.batch.jobs")
 
 # ---- device ledger: tunnel byte accounting (utils/transfer.py +
 # parallel/device_pool.py).  Counters carry the run totals; the
@@ -293,6 +314,11 @@ H_POOL_SUBMIT_WAIT = _metric("parquet.pool.submit_wait")
 # end-to-end gateway request wall (accept -> last byte written),
 # streaming requests included — the service-side latency SLO view
 H_GW_REQUEST_SECONDS = _metric("gateway.request.seconds")
+# per-fused-dispatch grid fill (rows occupied / rows dispatched, in
+# (0, 1]): the coalescer's fill/latency tradeoff rendered as a
+# distribution — `adam-tpu analyze` prints its quantiles in the
+# Batching section
+H_BATCH_FILL = _metric("sched.batch.fill")
 
 #: Device-only metrics: the paired-CPU bench baseline zeroes these
 #: instead of omitting them so round-over-round diffs are key-stable.
@@ -567,6 +593,9 @@ class Tracer:
         self._compiles: list = []  # {kernel, shape, device, seconds, ...}
         self._compiles_dropped = 0
         self._hbm: dict = {}       # dev -> {last, peak, n}
+        # per-tenant quota ledger (serve/quota.py feeds it): tenant ->
+        # {charges, bytes, compute_s, budget_bytes, budget_compute_s}
+        self._quota: dict = {}
         self._tls = threading.local()
         self._n_recorded = 0
 
@@ -747,6 +776,32 @@ class Tracer:
                     g["peak"] = hi
                 g["n"] += 1
 
+    def record_quota(self, tenant: str, nbytes: int = 0,
+                     compute_s: float = 0.0, budget_bytes=None,
+                     budget_compute_s=None) -> None:
+        """Account one quota charge against a tenant (serve/quota.py
+        feeds this from the device ledger's h2d/d2h grant sizes and the
+        per-pass compute attribution).  The snapshot's ``quota`` section
+        carries the running per-tenant consumption — and the budgets,
+        when the QuotaManager knows them — so ``adam-tpu analyze`` can
+        render per-tenant consumption next to the batching fill."""
+        if not self.recording:
+            return
+        with self._lock:
+            q = self._quota.get(str(tenant))
+            if q is None:
+                q = self._quota[str(tenant)] = {
+                    "charges": 0, "bytes": 0, "compute_s": 0.0,
+                    "budget_bytes": None, "budget_compute_s": None,
+                }
+            q["charges"] += 1
+            q["bytes"] += int(nbytes)
+            q["compute_s"] += float(compute_s)
+            if budget_bytes is not None:
+                q["budget_bytes"] = int(budget_bytes)
+            if budget_compute_s is not None:
+                q["budget_compute_s"] = float(budget_compute_s)
+
     def gauge(self, name: str, value) -> None:
         if not self.recording:
             return
@@ -827,6 +882,7 @@ class Tracer:
                     "dropped": self._compiles_dropped,
                 },
                 "hbm": {k: dict(v) for k, v in self._hbm.items()},
+                "quota": {k: dict(v) for k, v in self._quota.items()},
                 "events_recorded": self._n_recorded,
                 "events_retained": len(self._events),
                 "events_evicted": self._n_recorded - len(self._events),
@@ -845,6 +901,7 @@ class Tracer:
             self._compiles.clear()
             self._compiles_dropped = 0
             self._hbm.clear()
+            self._quota.clear()
             self._n_recorded = 0
 
     def reset_metrics(self) -> None:
@@ -859,6 +916,7 @@ class Tracer:
             self._compiles.clear()
             self._compiles_dropped = 0
             self._hbm.clear()
+            self._quota.clear()
 
     def absorb(self, other: "Tracer") -> None:
         """Merge another tracer's events + aggregates into this one
@@ -884,6 +942,7 @@ class Tracer:
             compiles = [dict(e) for e in other._compiles]
             compiles_dropped = other._compiles_dropped
             hbm = {k: dict(v) for k, v in other._hbm.items()}
+            quota = {k: dict(v) for k, v in other._quota.items()}
             n_rec = other._n_recorded
         with self._lock:
             self._events.extend(events)
@@ -958,6 +1017,17 @@ class Tracer:
                     mine["last"] = g["last"]
                     mine["peak"] = max(mine["peak"], g["peak"])
                     mine["n"] += g["n"]
+            for k, q in quota.items():
+                mine = self._quota.get(k)
+                if mine is None:
+                    self._quota[k] = dict(q)
+                else:
+                    mine["charges"] += q["charges"]
+                    mine["bytes"] += q["bytes"]
+                    mine["compute_s"] += q["compute_s"]
+                    for bk in ("budget_bytes", "budget_compute_s"):
+                        if q.get(bk) is not None:
+                            mine[bk] = q[bk]
 
     # ---- exports ----------------------------------------------------------
     def to_json(self, timers=None, include_events: bool = False) -> dict:
@@ -1061,6 +1131,7 @@ class Tracer:
                 "dropped": self._compiles_dropped,
             }
             hbm = {k: dict(v) for k, v in self._hbm.items()}
+            quota = {k: dict(v) for k, v in self._quota.items()}
             counters = dict(self._counters)
             gauges = {k: dict(v) for k, v in self._gauges.items()}
             n_rec = self._n_recorded
@@ -1078,6 +1149,7 @@ class Tracer:
             "transfers": xfer,
             "compiles": compiles,
             "hbm": hbm,
+            "quota": quota,
             "counters": counters,
             # gauges ride along too: the analyzer labels the resolve
             # stage (device vs host sort) and the execution mode off
@@ -1257,6 +1329,7 @@ def key_stable_snapshot(tr: Tracer | None = None) -> dict:
         xfer.setdefault(direction, {})
     snap.setdefault("compiles", {"entries": [], "dropped": 0})
     snap.setdefault("hbm", {})
+    snap.setdefault("quota", {})
     return snap
 
 
@@ -1291,10 +1364,12 @@ def merge_snapshots(snaps: list) -> dict:
 # --------------------------------------------------------------------------
 #: NDJSON schema tag every heartbeat line carries.  /2 added the
 #: device-ledger fields (tunnel bytes + HBM); /3 appended the
-#: ``partitioner`` execution-mode field — each older version's fields
-#: are a strict prefix of the next, so a consumer keying on field NAMES
-#: keeps working; ``adam-tpu top`` accepts all three.
-HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/3"
+#: ``partitioner`` execution-mode field; /4 appended the cross-job
+#: batching fields (``batch_fill`` + ``batched_jobs``) — each older
+#: version's fields are a strict prefix of the next, so a consumer
+#: keying on field NAMES keeps working; ``adam-tpu top`` accepts all
+#: four.
+HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/4"
 
 #: THE heartbeat line field set — a stable contract (documented in
 #: docs/OBSERVABILITY.md, lint-enforced by scripts/check-telemetry-names):
@@ -1325,8 +1400,15 @@ HEARTBEAT_FIELDS = (
     "ok",
     # /3: the streamed execution mode ("pool" | "mesh"; a mesh run that
     # degraded mid-flight flips to "pool" on its next beat) — appended
-    # LAST so the /2 fields stay a strict prefix
+    # so the /2 fields stay a strict prefix
     "partitioner",
+    # /4: cross-job window batching (serve/batching.py) — the running
+    # grid fill rate (rows occupied / rows dispatched across every
+    # fused dispatch so far; null when batching is off or nothing
+    # coalesced yet) and the distinct-job count of the LAST fused
+    # dispatch.  Appended LAST so the /3 fields stay a strict prefix.
+    "batch_fill",
+    "batched_jobs",
 )
 
 _DEFAULT_HEARTBEAT_INTERVAL_S = 2.0
@@ -1678,6 +1760,18 @@ class Heartbeat:
             # overridden by the streamed provider with the live mode
             # ("pool" | "mesh"); None = the producer predates /3 fields
             "partitioner": None,
+            # cross-job batching (/4): derived from the coalescer's
+            # counters whenever the sampled tracers carry them (the
+            # service-wide heartbeat samples the global TRACE, which
+            # the coalescer records on); null otherwise
+            "batch_fill": (
+                round(
+                    counters[C_BATCH_ROWS_OCCUPIED]
+                    / counters[C_BATCH_ROWS_DISPATCHED], 4,
+                )
+                if counters.get(C_BATCH_ROWS_DISPATCHED) else None
+            ),
+            "batched_jobs": gauges.get(G_BATCH_JOBS, {}).get("last"),
         }
         if self._provider is not None:
             try:

@@ -39,10 +39,11 @@ from typing import Optional
 
 from adam_tpu.utils.telemetry import format_bytes as _fmt_bytes
 
-#: Heartbeat schema tags this dashboard understands (missing /2 / /3
+#: Heartbeat schema tags this dashboard understands (missing /2–/4
 #: fields render as "-"; unknown future fields are ignored).
 ACCEPTED_SCHEMAS = (
     "adam_tpu.heartbeat/1", "adam_tpu.heartbeat/2", "adam_tpu.heartbeat/3",
+    "adam_tpu.heartbeat/4",
 )
 
 _CLEAR = "\x1b[H\x1b[2J"
@@ -139,6 +140,14 @@ def render_frame(line: dict, source: str = "") -> str:
         out.append(f"hbm      {devs}   peak {_fmt_bytes(peak)}")
     elif "hbm_bytes_in_use" in line:
         out.append("hbm      (unsupported backend — no memory stats)")
+    fill = line.get("batch_fill")
+    if fill is not None:
+        # cross-job batching (/4): running grid fill + the last fused
+        # dispatch's distinct-job count
+        out.append(
+            f"batching {_bar(fill, 12)} fill {fill:.0%}"
+            f"   jobs/dispatch {line.get('batched_jobs', '-')}"
+        )
     out.append(
         f"events   retries {line.get('retries', 0)}"
         f"   faults {line.get('faults', 0)}"
@@ -293,11 +302,19 @@ def render_multi_frame(jobs: dict, root: str = "",
         )
         rows.append(f"hbm      {devs}")
     if pool:
+        fill = pool.get("batch_fill")
         rows.append(
             f"global   h2d {_fmt_bytes(pool.get('h2d_bytes'))}   "
             f"d2h {_fmt_bytes(pool.get('d2h_bytes'))}   "
             f"retries {pool.get('retries', 0)}   "
             f"faults {pool.get('faults', 0)}"
+            + (
+                # cross-job batching fill rate (the service stream is
+                # the one that carries it — the coalescer is shared)
+                f"   fill {fill:.0%}"
+                f" ({pool.get('batched_jobs', '-')} jobs/dispatch)"
+                if fill is not None else ""
+            )
         )
     if jobs and all(j.get("done") for j in jobs.values()):
         rows.append(
