@@ -22,7 +22,7 @@ publish atomically.  What the gateway ADDS is the protocol surface
   grant cadence (gateway/protocol.retry_after_s), so clients back off
   at the pace the pool is actually draining windows.
 * **Resumable event streaming** — ``GET /v1/jobs/<job>/events`` tails
-  the job's ``adam_tpu.heartbeat/4`` NDJSON stream as a chunked
+  the job's ``adam_tpu.heartbeat/5`` NDJSON stream as a chunked
   response, resumable from a line ``cursor`` (a tailer that
   reconnects re-requests from its last count; a heartbeat-file
   rotation resets the cursor, exactly like ``adam-tpu top``'s

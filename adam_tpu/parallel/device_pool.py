@@ -51,6 +51,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from adam_tpu.utils import faults
+from adam_tpu.utils import health as health_mod
 from adam_tpu.utils import retry as retry_mod
 from adam_tpu.utils import telemetry as tele
 
@@ -426,6 +427,11 @@ class DevicePool:
         # shares the eviction lock — both are rare-path bookkeeping
         self._leases: set = set()
         self._evict_lock = threading.Lock()
+        # the process-wide device-health scoreboard (utils/health.py):
+        # placement consults it (probation devices are excluded until
+        # their re-admission probe passes), eviction informs it.  One
+        # board across pools/leases — health is a hardware property.
+        self.health = health_mod.BOARD
 
     # ---- multi-tenant leasing (adam_tpu/serve) -------------------------
     def lease(self, job: Optional[str] = None) -> "PoolLease":
@@ -453,12 +459,28 @@ class DevicePool:
         the stats/queue-depth constant, not the live device count)."""
         return len(self.devices)
 
-    # ---- eviction -----------------------------------------------------
-    def alive_devices(self) -> list:
+    # ---- eviction + health --------------------------------------------
+    def survivors(self) -> list:
+        """Devices not hard-evicted (health-filter-free): the prewarm
+        set — probation devices keep their executables warm so a
+        re-admitted chip never cold-compiles inside a window."""
         with self._evict_lock:
             return [
                 d for d in self.devices if _device_key(d) not in self._dead
             ]
+
+    def alive_devices(self) -> list:
+        """The PLACEABLE device set: survivors minus health-blocked
+        (probation/evicted on the scoreboard) chips.  Availability
+        beats health: when the scoreboard would empty the set, the
+        blocked survivors serve anyway — a poolwide false alarm must
+        degrade observability, not the run (the SDC audit still guards
+        the pass-C payload those devices produce)."""
+        alive = self.survivors()
+        if len(alive) <= 1:
+            return alive
+        ok = [d for d in alive if not self.health.blocked(d)]
+        return ok if ok else alive
 
     def evict(self, device, reason: str = "", tracer=None) -> bool:
         """Remove a failed device from round-robin placement.
@@ -486,7 +508,33 @@ class DevicePool:
         (tracer if tracer is not None else tele.TRACE).count(
             tele.C_DEVICE_EVICTED
         )
+        self.health.mark_evicted(device, tracer=tracer)
         return True
+
+    def _maybe_probe(self, tracer=None) -> None:
+        """Run due re-admission probes (probation devices whose
+        cooldown elapsed): a passing known-answer dispatch re-admits
+        the chip into placement, a failing one graduates it to a real
+        eviction.  The ``probe_maybe_due`` fast path is one lock-free
+        clock compare — taken BEFORE building the survivor set, so the
+        per-window placement call stays cheap."""
+        if not self.health.probe_maybe_due():
+            return
+        survivors = self.survivors()
+        # claim only THIS pool's devices: a foreign probation device's
+        # cooldown must stay claimable by the pool that can probe it
+        due = set(self.health.due_probes(survivors))
+        if not due:
+            return
+        for dev in survivors:
+            if _device_key(dev) not in due:
+                continue
+            if health_mod.probe_known_answer(dev):
+                self.health.readmit(dev, tracer=tracer)
+            else:
+                self.health.probe_failed(dev, tracer=tracer)
+                self.evict(dev, reason="re-admission probe failed",
+                           tracer=tracer)
 
     def device_index(self, window: int) -> int:
         """Index of window's device in the ORIGINAL pool order (stable
@@ -494,6 +542,7 @@ class DevicePool:
         return self.devices.index(self.device(window))
 
     def device(self, window: int):
+        self._maybe_probe()
         alive = self.alive_devices()
         if not alive:
             raise AllDevicesEvicted(
@@ -540,11 +589,14 @@ class DevicePool:
             # the same triple twice; a failed compile DISCARDS its claim
             # below — a transient compile/RPC failure must stay
             # retryable, or the next run pays the cold compile inside a
-            # timed window with no signal.  Evicted devices are skipped:
-            # replayed windows re-prewarm on survivors via the same
-            # process-wide cache (already-warm triples dedupe to no-ops).
+            # timed window with no signal.  Evicted devices are skipped;
+            # health-PROBATION devices are still warmed (survivors, not
+            # alive_devices) so a probe re-admission never cold-compiles
+            # inside the window it rejoins on.  Replayed windows
+            # re-prewarm on survivors via the same process-wide cache
+            # (already-warm triples dedupe to no-ops).
             for key, fn in entries:
-                for dev in self.alive_devices():
+                for dev in self.survivors():
                     cache_key = (key, _device_key(dev))
                     if cache_key not in _PREWARMED and cache_key not in claimed:
                         claimed.add(cache_key)
@@ -685,8 +737,13 @@ class PoolLease:
 
 
 def _device_key(dev) -> str:
-    """Stable per-device cache key (id is unique within a process)."""
-    return f"{getattr(dev, 'platform', '?')}:{getattr(dev, 'id', id(dev))}"
+    """Stable per-device cache key (id is unique within a process).
+    Delegates to :func:`adam_tpu.utils.health.device_key` — the ONE
+    key vocabulary shared by the prewarm cache, the eviction set and
+    the health scoreboard; a divergence would silently stop the
+    board's placement filter from matching pool devices (health.py
+    cannot import this module, hence the direction)."""
+    return health_mod.device_key(dev)
 
 
 def make_pool(requested: Optional[int] = None) -> Optional[DevicePool]:
@@ -697,6 +754,89 @@ def make_pool(requested: Optional[int] = None) -> Optional[DevicePool]:
     if n <= 1:
         return None
     return DevicePool(limit=n)
+
+
+# --------------------------------------------------------------------------
+# Hedged dispatch (docs/ROBUSTNESS.md "Device health, hedging, and SDC
+# audit"): rescue an in-flight window from a straggler chip.
+# --------------------------------------------------------------------------
+def hedged_call(primary_fn, hedge_fn, threshold_s: float, tracer=None):
+    """Run ``primary_fn()`` on a watchdog thread; if it is still in
+    flight after ``threshold_s`` (the kernel's
+    ``ADAM_TPU_HEDGE_FACTOR`` × p99, Dean & Barroso's hedged-request
+    discipline), run ``hedge_fn()`` — the same window re-dispatched on
+    another alive device from the host-retained ingest copy — on the
+    calling thread.  **First completed result wins**; output is
+    byte-identical either way because the kernels are deterministic
+    parity twins, so the race decides latency, never bytes.
+
+    Returns ``(result, winner, fired)`` where ``winner`` is
+    ``"primary"`` (hedge never fired, or fired and lost) or
+    ``"hedge"``, and ``fired`` whether the speculative dispatch
+    launched (so callers can keep hedge-inflated walls out of their
+    latency statistics).  Counters: ``device.hedge.fired`` when the
+    hedge launches, ``device.hedge.won`` when its result is used,
+    ``device.hedge.wasted`` when the primary beat it (fired = won +
+    wasted).  A hedge that RAISES falls back to waiting out the
+    primary — hedging is an optimization and must never turn a slow
+    window into a failed one; a primary that raises after a losing
+    hedge surfaces its own error to the caller's normal recovery path
+    (a primary error swallowed by a WINNING hedge is deliberate: the
+    window was rescued, and a genuinely sick chip keeps feeding the
+    scoreboard through its other signals).
+    """
+    tr = tracer if tracer is not None else tele.TRACE
+    box: list = []
+    done = threading.Event()
+    # the primary runs on a helper thread, which carries none of the
+    # caller's thread-local telemetry pass scope — capture and re-enter
+    # it there, so the transfer ledger's per-pass attribution (and the
+    # fault grammar's pass= selector) see the same pass the un-hedged
+    # call would have
+    caller_pass = tele.current_pass()
+
+    def run_primary():
+        try:
+            if caller_pass is not None:
+                with tele.pass_scope(caller_pass):
+                    box.append((True, primary_fn()))
+            else:
+                box.append((True, primary_fn()))
+        except BaseException as e:  # noqa: BLE001 — relayed below
+            box.append((False, e))
+        done.set()
+
+    t = threading.Thread(target=run_primary, daemon=True,
+                         name="hedge-primary")
+    t.start()
+    if done.wait(threshold_s):
+        ok, val = box[0]
+        if ok:
+            return val, "primary", False
+        raise val
+    # the primary is officially late: speculate
+    tr.count(tele.C_HEDGE_FIRED)
+    try:
+        hedged = hedge_fn()
+    except Exception as e:
+        log.warning(
+            "hedged re-dispatch failed (%s); waiting out the primary", e,
+        )
+        # the speculative attempt was launched and discarded: it counts
+        # as wasted, keeping fired == won + wasted even on this path
+        tr.count(tele.C_HEDGE_WASTED)
+        done.wait()
+        ok, val = box[0]
+        if ok:
+            return val, "primary", True
+        raise val
+    if done.is_set() and box and box[0][0]:
+        # the primary finished while the hedge computed: first result
+        # wins, the speculative copy is the wasted one
+        tr.count(tele.C_HEDGE_WASTED)
+        return box[0][1], "primary", True
+    tr.count(tele.C_HEDGE_WON)
+    return hedged, "hedge", True
 
 
 # --------------------------------------------------------------------------

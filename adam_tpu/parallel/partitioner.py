@@ -224,6 +224,28 @@ def resolve_execution_mode(override: Optional[str] = None) -> str:
     return v or "pool"
 
 
+def healthy_subset(devices: Sequence, board=None) -> list:
+    """The device subset the mesh should span, per the health
+    scoreboard (utils/health.py): probation/evicted chips are excluded
+    at CONSTRUCTION time — a collective spans every mesh device, so
+    one quietly-bad chip would poison every window, and the mesh has
+    no per-chip eviction to fall back on (docs/ROBUSTNESS.md
+    "Mesh-mode degradation").  Falls back to the full set when the
+    board would empty it (availability beats health) and never shrinks
+    below one device."""
+    if board is None:
+        from adam_tpu.utils.health import BOARD as board
+    devs = list(devices)
+    ok = [d for d in devs if not board.blocked(d)]
+    if ok and len(ok) < len(devs):
+        log.warning(
+            "mesh construction excluded %d health-blocked device(s); "
+            "spanning the %d healthy one(s)", len(devs) - len(ok),
+            len(ok),
+        )
+    return ok if ok else devs
+
+
 # ---- mesh jit wrappers (module level: ONE executable cache per shape,
 # shared by the prewarm and every window's dispatch) -----------------------
 def _mesh_specs(n_args: int):

@@ -75,6 +75,7 @@ import numpy as np
 from adam_tpu.api.datasets import AlignmentDataset
 from adam_tpu.parallel import device_pool as dp_mod
 from adam_tpu.utils import faults
+from adam_tpu.utils import health as health_mod
 from adam_tpu.utils import telemetry as tele
 from adam_tpu.utils.transfer import device_fetch
 
@@ -420,6 +421,19 @@ def _transform_streamed_impl(
     stats["n_devices"] = dpool.n if dpool is not None else (
         1 if use_device else 0
     )
+    # device health / hedging / SDC audit (utils/health.py,
+    # docs/ROBUSTNESS.md "Device health, hedging, and SDC audit"): the
+    # process-wide scoreboard feeds placement (probation devices are
+    # excluded until their re-admission probe passes — and the mesh
+    # construction below spans only the healthy subset); pass C hedges
+    # in-flight windows past ADAM_TPU_HEDGE_FACTOR x the apply kernel's
+    # observed p99, and deterministically samples ADAM_TPU_AUDIT_RATE
+    # of windows for a host dual-compute bit comparison — a mismatch
+    # quarantines the producing chip and replays the window from the
+    # host copy, so the published part is clean either way.
+    health_board = health_mod.BOARD
+    sdc_audit_rate = health_mod.audit_rate() if use_device else 0.0
+    stats["audit_rate"] = sdc_audit_rate
     # execution partitioner (--partitioner / ADAM_TPU_PARTITIONER):
     # "pool" round-robins whole windows; "mesh" shards every window
     # over a batch Mesh spanning the same device set, psums the
@@ -440,6 +454,13 @@ def _transform_streamed_impl(
             else:
                 n_mesh = dp_mod.resolve_device_count(devices)
                 mesh_devs = jax.local_devices()[:n_mesh]
+            # mesh construction consults the health scoreboard: a
+            # collective spans every mesh device, so ONE probation
+            # chip would poison every window — build the mesh over the
+            # healthy subset (all-blocked falls back to the full set;
+            # availability beats health, and the pool degrade path
+            # still owns mid-run failures)
+            mesh_devs = part_mod.healthy_subset(mesh_devs, health_board)
             mesh_part = part_mod.MeshPartitioner(mesh_devs)
         except Exception as e:
             log.warning(
@@ -1581,6 +1602,88 @@ def _transform_streamed_impl(
         pool.submit(_part_path(out_path, idx), ds.batch, ds.sidecar,
                     ds.header, packed=packed)
 
+    # ---- SDC audit (shared by the pool, mesh and coalesced pass-C
+    # paths — docs/ROBUSTNESS.md "Device health, hedging, and SDC
+    # audit"): every path that publishes device-produced bytes is
+    # auditable, or ADAM_TPU_AUDIT_RATE would silently protect only
+    # solo pool runs while the multi-tenant serving modes ship
+    # unaudited bits.
+    def _host_audit_apply(w):
+        return bqsr_mod.apply_recalibration(w, table, gl, _host_backend())
+
+    def _audit_matches(done, p_packed, host_ds) -> bool:
+        """Bit-compare the device-produced pass-C result against the
+        host parity twin's recompute — the SDC audit's verdict.
+        Packed payloads compare in the packed domain (the very bytes
+        the Arrow column publishes), matrix results compare the whole
+        post-apply qual matrix."""
+        from adam_tpu.formats import schema
+        from adam_tpu.io.arrow_pack import pack_matrix_host
+
+        hb = host_ds.batch.to_numpy()
+        if p_packed is None:
+            return np.array_equal(
+                np.asarray(done.batch.to_numpy().quals),
+                np.asarray(hb.quals),
+            )
+        pq = getattr(p_packed, "quals", p_packed)
+        exp_q = pack_matrix_host(
+            np.asarray(hb.quals),
+            bqsr_mod._apply_pack_lens(hb),
+            schema.QUAL_SANGER_LUT256,
+        )
+        if not (np.array_equal(pq.buf, exp_q.buf)
+                and np.array_equal(pq.lens, exp_q.lens)):
+            return False
+        pb = getattr(p_packed, "bases", None)
+        if pb is not None:
+            # the bases half rides its own fetch: audit it too, or a
+            # flipped base byte would publish undetected
+            exp_b = pack_matrix_host(
+                np.asarray(hb.bases),
+                bqsr_mod._apply_pack_lens_bases(hb),
+                schema.BASE_DECODE_LUT256,
+            )
+            if not (np.array_equal(pb.buf, exp_b.buf)
+                    and np.array_equal(pb.lens, exp_b.lens)):
+                return False
+        return True
+
+    def _audit_result(p_idx, prod_dev, pre_ds, done, p_packed):
+        """Dual-compute audit of a sampled window: recompute
+        ``pre_ds`` (the window's pre-apply dataset) on the host parity
+        twin and bit-compare.  A mismatch counts
+        ``device.audit.mismatch`` and replaces the result with the
+        host recompute — the published part is clean either way; when
+        a single producing chip is attributable (``prod_dev``, the
+        pool path) it is additionally QUARANTINED through the
+        scoreboard (its resident handles drop, later windows avoid it
+        until a clean re-admission probe).  Mesh collectives and
+        coalesced dispatches have no single producing chip — their
+        mismatches republish and count, and the operator reads the
+        counter.  Returns the (possibly replaced) ``(done,
+        p_packed)``."""
+        tr.count(tele.C_AUDIT_SAMPLED)
+        host_ds = _host_audit_apply(pre_ds)
+        if _audit_matches(done, p_packed, host_ds):
+            return done, p_packed
+        tr.count(tele.C_AUDIT_MISMATCH)
+        log.error(
+            "SDC audit: window %d's device result does not match the "
+            "host recompute — %s and publishing the host bytes", p_idx,
+            f"quarantining device {dp_mod._attr_id(prod_dev)}"
+            if prod_dev is not None
+            else "no single producing chip to quarantine",
+        )
+        if prod_dev is not None:
+            health_board.quarantine(
+                prod_dev,
+                reason=f"sdc audit mismatch on window {p_idx}",
+                tracer=tr,
+            )
+            _drop_resident_on(prod_dev)
+        return host_ds, None
+
     def _apply_parts_mesh(plist):
         """Mesh pass C: the solved table places ONCE, replicated, and
         stays device-resident while every window's [N, L] gather shards
@@ -1695,6 +1798,17 @@ def _transform_streamed_impl(
                 return _remainder(e, "pass-C apply fetch")
             pend.popleft()
             tr.count(tele.C_DEVICE_FETCHED)
+            # SDC audit: mesh collectives have no single producing chip
+            # to quarantine, but a sampled mismatch still counts and
+            # the host bytes still publish
+            if sdc_audit_rate > 0 and health_mod.audit_due(
+                p_idx, sdc_audit_rate
+            ):
+                done, p_packed = _audit_result(
+                    p_idx, None,
+                    bqsr_mod.apply_handle_dataset(p_handle),
+                    done, p_packed,
+                )
             # OUTSIDE the mesh try blocks, like the pool path: a writer-
             # pool fail-fast error is an output failure, not a mesh
             # failure — it must abort the run with its own attribution,
@@ -1791,10 +1905,18 @@ def _transform_streamed_impl(
             )
 
         def _device_table(dev):
-            return (
-                table if dpool is None
-                else dev_tables[dpool.devices.index(dev)]
-            )
+            if dpool is None:
+                return table
+            i = dpool.devices.index(dev)
+            if dev_tables[i] is None:
+                # a device with no replica joined placement mid-pass
+                # (a health-probation chip re-admitted by its probe):
+                # place its table copy now, once
+                with tele.pass_scope("table"):
+                    dev_tables[i] = dp_mod.putter(dev)(
+                        np.ascontiguousarray(table, np.uint8)
+                    )
+            return dev_tables[i]
 
         def _replay_apply(p_idx, dev, w, exc):
             """Window p_idx's apply died on ``dev``: evict it
@@ -1833,6 +1955,34 @@ def _transform_streamed_impl(
                 p_idx, on_device, lambda: (_host_apply(w), None)
             )
 
+        def _hedge_redispatch(p_idx, p_dev, p_handle):
+            """The speculative twin of a late window -> (closure, nd):
+            synchronous dispatch+fetch on another alive device ``nd``,
+            from the host-retained dataset (the PR 13 replay contract)
+            — output bytes identical by kernel determinism + backend
+            parity.  Raises when no alternate device exists (the
+            caller then never fires the hedge)."""
+            others = [
+                d for d in dpool.alive_devices() if d is not p_dev
+            ] if dpool is not None else []
+            if not others:
+                raise RuntimeError("no alternate device to hedge on")
+            nd = others[p_idx % len(others)]
+            w = bqsr_mod.apply_handle_dataset(p_handle)
+
+            def run():
+                with tr.span(
+                    tele.SPAN_APPLY_DISPATCH, window=p_idx, hedge=1,
+                    **dp_mod.span_attrs(nd),
+                ):
+                    h = bqsr_mod.apply_recalibration_dispatch(
+                        w, _device_table(nd), gl, backend, device=nd,
+                        pack=use_packed,
+                    )
+                return bqsr_mod.apply_recalibration_finish_packed(h)
+
+            return run, nd
+
         def _fetch_one():
             p_idx, p_dev, p_handle = pend_q.popleft()
             if p_dev == "batch":
@@ -1860,21 +2010,98 @@ def _transform_streamed_impl(
                     done, p_packed = _solo_apply_sync(
                         p_idx, p_handle.dataset
                     )
+                else:
+                    # SDC audit, fused-fetch success only: a fused
+                    # dispatch has no single producing chip to
+                    # quarantine, but a sampled mismatch still counts
+                    # and the host bytes still publish.  The fallback
+                    # branch may have applied on the HOST — auditing
+                    # host bytes against a host recompute can never
+                    # mismatch and would just double the window's cost
+                    if sdc_audit_rate > 0 and health_mod.audit_due(
+                        p_idx, sdc_audit_rate
+                    ):
+                        done, p_packed = _audit_result(
+                            p_idx, None, p_handle.dataset, done,
+                            p_packed,
+                        )
                 _submit(p_idx, done, p_packed)
                 _release_resident(p_idx)
                 return
             attrs = dp_mod.span_attrs(p_dev)
             p_packed = None
+            prod_dev = p_dev  # the device whose bits we end up using
+            # hedged dispatch (Dean & Barroso): once the apply kernel
+            # has a pooled p99, an in-flight window past
+            # ADAM_TPU_HEDGE_FACTOR x p99 speculatively re-dispatches
+            # on another alive device from the host-retained copy —
+            # first result wins, bytes identical by parity
+            thr = None
+            if (
+                dpool is not None and not res["device_lost"]
+                and p_dev is not None
+                and len(dpool.alive_devices()) > 1
+            ):
+                thr = health_board.hedge_threshold("bqsr.apply")
             try:
+                t_fetch = time.monotonic()
+                hedged = False
                 with tr.span(
                     tele.SPAN_APPLY_FETCH, window=p_idx, **attrs
                 ):
-                    done, p_packed = (
-                        bqsr_mod.apply_recalibration_finish_packed(
-                            p_handle
+                    if thr is None:
+                        done, p_packed = (
+                            bqsr_mod.apply_recalibration_finish_packed(
+                                p_handle
+                            )
                         )
-                    )
+                    else:
+                        nd_box: list = []
+
+                        def _hedge_fn():
+                            run, nd = _hedge_redispatch(
+                                p_idx, p_dev, p_handle
+                            )
+                            nd_box.append(nd)
+                            return run()
+
+                        (done, p_packed), winner, hedged = (
+                            dp_mod.hedged_call(
+                                lambda: (
+                                    bqsr_mod
+                                    .apply_recalibration_finish_packed(
+                                        p_handle
+                                    )
+                                ),
+                                _hedge_fn, thr, tracer=tr,
+                            )
+                        )
+                        if winner == "hedge":
+                            prod_dev = nd_box[0]
+                            # the primary lost to a COLD re-dispatch on
+                            # a peer: the strongest straggler signal —
+                            # without it a chip whose every window
+                            # hedges would never accrue a latency
+                            # penalty (its own wall never finishes, so
+                            # observe_latency has nothing true to feed)
+                            health_board.note_hedge_lost(
+                                p_dev, "bqsr.apply", tracer=tr
+                            )
                 tr.count(tele.C_DEVICE_FETCHED)
+                if not hedged and p_dev is not None:
+                    # feed the scoreboard's per-kernel latency pool
+                    # (hedge-inflated walls stay out of it; a LOST
+                    # race penalizes through note_hedge_lost above).
+                    # Only REAL device attributions feed it — the
+                    # poolless default-device path's None would accrue
+                    # EWMAs on a phantom "default" key that no pool can
+                    # probe and that the cross-device best-peer check
+                    # would read as a (stale, fast) peer in a LATER
+                    # pooled run on this process-wide board
+                    health_board.observe_latency(
+                        "bqsr.apply", p_dev,
+                        time.monotonic() - t_fetch, tracer=tr,
+                    )
             except Exception as e:
                 # the replay re-applies synchronously (survivor chip or
                 # host backend) and returns a matrix-path dataset —
@@ -1884,6 +2111,19 @@ def _transform_streamed_impl(
                     bqsr_mod.apply_handle_dataset(p_handle), e,
                 )
                 p_packed = None
+            else:
+                # SDC audit: a deterministic ADAM_TPU_AUDIT_RATE sample
+                # of windows dual-computes on the host parity twin and
+                # bit-compares; a mismatch quarantines the producing
+                # chip and the HOST bytes publish
+                if sdc_audit_rate > 0 and health_mod.audit_due(
+                    p_idx, sdc_audit_rate
+                ):
+                    done, p_packed = _audit_result(
+                        p_idx, prod_dev,
+                        bqsr_mod.apply_handle_dataset(p_handle),
+                        done, p_packed,
+                    )
             _submit(p_idx, done, p_packed)
             # refcounted release after pass C: the window's device
             # arrays free as its part submits (the host copy lives on
@@ -2005,6 +2245,12 @@ def _transform_streamed_impl(
     for win in list(resident_map):
         _release_resident(win)
     stats["resident_windows"] = resident_live["made"]
+    if use_device:
+        # run-end health publish: every tracked device's scoreboard
+        # state lands in the snapshot's `health` section (the
+        # analyzer's "Device health" rows), beside the transition
+        # counters recorded as they happened
+        health_board.publish(tr)
     tr.add_span(tele.SPAN_TOTAL, t_start_ns,
                 time.monotonic_ns() - t_start_ns)
 

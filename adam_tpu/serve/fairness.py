@@ -118,6 +118,16 @@ class WeightedInterleaver:
                     lane.cancelled = True
             self._cond.notify_all()
 
+    def cancelled(self, job: str) -> bool:
+        """Whether ``turn`` would raise for this job right now (drain
+        or per-job cancel) — the quota throttle's stop probe, so a
+        deferral never outlives the drain that should interrupt it."""
+        with self._lock:
+            if self._cancel_all:
+                return True
+            lane = self._lanes.get(job)
+            return lane is not None and lane.cancelled
+
     def grant_history(self) -> list:
         """Recent grants as job ids, oldest first (bounded ring)."""
         with self._lock:

@@ -29,10 +29,19 @@ which is precisely what its Retry-After advertises.  Malformed clauses
 warn and are ignored (the tuning-var contract every ``ADAM_TPU_*``
 knob keeps): a quota typo must never take down admission for everyone.
 
-Enforcement is at admission only: a job admitted within budget runs to
-completion (killing a paid-for run mid-flight wastes the spend that
-triggered the kill), and other tenants' throughput is untouched — the
-WFQ interleaver still owns intra-run fairness.
+Enforcement has two rungs.  **Admission** refuses fresh submissions
+from an over-budget tenant (the 429 leg above).  **Mid-run
+throttling** (:meth:`QuotaManager.throttle`, on by default with
+``ADAM_TPU_QUOTA_THROTTLE``; ``ADAM_TPU_QUOTA_MAX_DEFER_S`` bounds a
+single deferral) smooths the edge for long jobs: when a tenant goes
+over budget mid-run, its next window grants DEFER at the pacer seam —
+short bounded sleeps until enough spend ages out of the rolling
+window — instead of streaming at full rate until the next admission
+check.  Deferred grants count ``sched.quota.deferred``; a drain (or
+job cancel) interrupts a deferral immediately, and a job is never
+killed mid-flight for quota (killing a paid-for run wastes the spend
+that triggered the kill).  Other tenants' throughput is untouched —
+the WFQ interleaver still owns intra-run fairness.
 """
 
 from __future__ import annotations
@@ -57,6 +66,30 @@ DEFAULT_WINDOW_S = 60.0
 #: window's schedule, not at job-slot turnover speed.
 QUOTA_RETRY_MIN_S = 1
 QUOTA_RETRY_MAX_S = 3600
+
+#: Mid-run throttle poll step (seconds): short enough that a drain or
+#: an expiring charge is honored promptly, long enough not to spin.
+THROTTLE_POLL_S = 0.05
+
+
+def throttle_enabled() -> bool:
+    """``ADAM_TPU_QUOTA_THROTTLE`` (default on): whether over-budget
+    tenants get pacer-level grant deferral mid-run."""
+    from adam_tpu.utils.retry import env_toggle
+
+    return env_toggle("ADAM_TPU_QUOTA_THROTTLE", True)
+
+
+def max_defer_s() -> float:
+    """``ADAM_TPU_QUOTA_MAX_DEFER_S``: the bound on ONE grant's
+    deferral; 0/unset means "derive from the rolling window" (the
+    window plus a poll's slack — by then every charge that was in the
+    window when the deferral began has aged out, so a longer wait can
+    never be needed)."""
+    from adam_tpu.utils.retry import env_float
+
+    v = env_float("ADAM_TPU_QUOTA_MAX_DEFER_S", 0.0)
+    return v if v > 0 else 0.0
 
 _SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
 
@@ -298,6 +331,54 @@ class QuotaManager:
                                max(QUOTA_RETRY_MIN_S, round(eta))))
         return int(min(QUOTA_RETRY_MAX_S,
                        max(QUOTA_RETRY_MIN_S, round(self.window_s))))
+
+    # ---- the mid-run throttle ------------------------------------------
+    def throttle(self, tenant: str, should_stop=None,
+                 max_wait_s: Optional[float] = None,
+                 sleep=None, tracer=None) -> float:
+        """Defer one grant while ``tenant`` is over budget (the pacer
+        seam calls this before taking the WFQ turn).  Returns the
+        seconds actually deferred (0.0 on the in-budget fast path —
+        one ``check`` call).
+
+        The wait polls in :data:`THROTTLE_POLL_S` steps so (a) charges
+        aging out of the rolling window free the grant promptly and
+        (b) ``should_stop()`` — the scheduler's drain/cancel probe —
+        interrupts a deferral immediately (the caller's own pacer turn
+        then raises ``RunCancelled``).  Bounded by ``max_wait_s``
+        (default :func:`max_defer_s`): a stuck budget degrades to a
+        bounded delay, never a wedged job.  Counts
+        ``sched.quota.deferred`` once per deferral episode."""
+        if self.check(tenant) is None:
+            return 0.0
+        if should_stop is not None and should_stop():
+            # draining/cancelled: the caller's own pacer turn raises
+            # next — a deferral that would end before it began is not
+            # an episode (no count, no warning)
+            return 0.0
+        tr = tracer if tracer is not None else self._tracer
+        tr.count(tele.C_QUOTA_DEFERRED)
+        if max_wait_s is not None:
+            bound = max_wait_s
+        else:
+            bound = max_defer_s() or (self.window_s + 1.0)
+        do_sleep = sleep if sleep is not None else time.sleep
+        t0 = self._clock()
+        exceeded = self.check(tenant)
+        log.warning(
+            "tenant %r over budget mid-run (%s); deferring grants up "
+            "to %.1fs", tenant,
+            exceeded.reason if exceeded else "rechecking", bound,
+        )
+        while True:
+            if should_stop is not None and should_stop():
+                break
+            if self.check(tenant) is None:
+                break
+            if self._clock() - t0 >= bound:
+                break
+            do_sleep(THROTTLE_POLL_S)
+        return max(0.0, self._clock() - t0)
 
     # ---- status ---------------------------------------------------------
     def status(self) -> dict:

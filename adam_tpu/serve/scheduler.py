@@ -31,7 +31,7 @@ multi-job scheduling"):
   would crash-loop the pool).
 
 Every job runs in its own thread with its own run tracer and its own
-``adam_tpu.heartbeat/4`` stream at ``<run-root>/<job>/heartbeat.ndjson``
+``adam_tpu.heartbeat/5`` stream at ``<run-root>/<job>/heartbeat.ndjson``
 (``adam-tpu top <run-root>`` aggregates them).  The ``sched.*`` fault
 points (``sched.admit`` / ``sched.dispatch`` / ``sched.drain`` /
 ``sched.job_crash``, job id in the ``device`` selector slot) extend the
@@ -64,6 +64,7 @@ from adam_tpu.serve.job import (
     JobSpec,
 )
 from adam_tpu.utils import faults
+from adam_tpu.utils import retry as retry_mod
 from adam_tpu.utils import telemetry as tele
 from adam_tpu.utils.durability import atomic_write_json
 from adam_tpu.utils.retry import _env_int
@@ -154,6 +155,15 @@ class JobScheduler:
         # heartbeat restore semantics assume one run per process)
         self._restore_recording = tele.TRACE.recording
         tele.TRACE.recording = True
+        # drain-aware retry backoff: every retry sleep in this process
+        # waits on this event, so a SIGTERM drain never stalls up to
+        # ADAM_TPU_RETRY_MAX_BACKOFF_S per in-flight retry — the
+        # sleeping retry wakes and runs its remaining attempts with a
+        # small bounded pause (failure semantics untouched: a mid-drain
+        # transient still absorbs), and the job stops at its window
+        # boundary under the normal drain contract
+        self._drain_ev = threading.Event()
+        retry_mod.set_cancel_event(self._drain_ev)
 
     # ---- paths ---------------------------------------------------------
     def job_dir(self, job_id: str) -> str:
@@ -399,17 +409,35 @@ class JobScheduler:
             return False
 
     def _job_pacer(self, spec: JobSpec):
-        """The job's pacer: the WFQ turn plus the quota byte charge —
-        every grant's window payload size lands on the tenant's
-        rolling-window budget (the device-ledger-shaped byte leg; the
-        coalescer charges the compute leg per fused dispatch)."""
+        """The job's pacer: the mid-run quota throttle, then the WFQ
+        turn, then the quota byte charge — every grant's window payload
+        size lands on the tenant's rolling-window budget (the
+        device-ledger-shaped byte leg; the coalescer charges the
+        compute leg per fused dispatch).  The throttle DEFERS an
+        over-budget tenant's grant (bounded sleeps until enough spend
+        ages out of the rolling window, ``sched.quota.deferred``)
+        instead of letting a long admitted job stream past its budget
+        until the next admission-time 429; a drain or per-job cancel
+        interrupts the deferral immediately and the turn that follows
+        raises ``RunCancelled`` as usual."""
+        from adam_tpu.serve.quota import throttle_enabled
+
         inner = self._interleaver.pacer(spec.job_id)
         quota = self._quota
         if quota is None:
             return inner
         tenant = spec.tenant
+        job_id = spec.job_id
+        throttling = throttle_enabled()
+
+        def _stop_deferral() -> bool:
+            return (
+                self.draining or self._interleaver.cancelled(job_id)
+            )
 
         def pace(phase: str, index: int, size: int = 0) -> None:
+            if throttling:
+                quota.throttle(tenant, should_stop=_stop_deferral)
             inner(phase, index, size)
             if size:
                 quota.charge(tenant, nbytes=size)
@@ -557,6 +585,9 @@ class JobScheduler:
         log.info("drain requested: admissions closed, %d job(s) will "
                  "stop at their next window boundary",
                  len(self.active_jobs()))
+        # wake every backoff-sleeping retry NOW: a drain must not wait
+        # out exponential backoffs (utils/retry.set_cancel_event)
+        self._drain_ev.set()
         self._interleaver.cancel()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -651,6 +682,9 @@ class JobScheduler:
             coal.stop()
         if hb is not None:
             hb.stop()
+        # release the process-wide retry-cancel registration, but only
+        # if it is still ours (a newer scheduler may have re-registered)
+        retry_mod.clear_cancel_event(self._drain_ev)
         tele.TRACE.recording = self._restore_recording
 
     # ---- whole-process crash recovery ----------------------------------

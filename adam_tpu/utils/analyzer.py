@@ -541,6 +541,35 @@ def _batching_report(doc: dict, counters: dict, hists: dict) -> dict:
     }
 
 
+def _health_report(doc: dict, counters: dict) -> dict:
+    """Device-health section (utils/health.py, docs/ROBUSTNESS.md
+    "Device health, hedging, and SDC audit"): the per-device scoreboard
+    states from the snapshot's ``health`` ledger plus the hedge/audit
+    counters.  ``{}`` when the run tracked no device health and never
+    hedged or audited — the section renders nothing."""
+    health = doc.get("health") or {}
+    keys = (
+        tele.C_HEALTH_DEMOTED, tele.C_HEALTH_PROBATION,
+        tele.C_HEALTH_READMITTED, tele.C_HEALTH_PROBE_FAILED,
+        tele.C_HEDGE_FIRED, tele.C_HEDGE_WON, tele.C_HEDGE_WASTED,
+        tele.C_AUDIT_SAMPLED, tele.C_AUDIT_MISMATCH,
+    )
+    if not health and not any(counters.get(k) for k in keys):
+        return {}
+    return {
+        "devices": {k: dict(v) for k, v in sorted(health.items())},
+        "demoted": counters.get(tele.C_HEALTH_DEMOTED, 0),
+        "probation": counters.get(tele.C_HEALTH_PROBATION, 0),
+        "readmitted": counters.get(tele.C_HEALTH_READMITTED, 0),
+        "probe_failed": counters.get(tele.C_HEALTH_PROBE_FAILED, 0),
+        "hedge_fired": counters.get(tele.C_HEDGE_FIRED, 0),
+        "hedge_won": counters.get(tele.C_HEDGE_WON, 0),
+        "hedge_wasted": counters.get(tele.C_HEDGE_WASTED, 0),
+        "audit_sampled": counters.get(tele.C_AUDIT_SAMPLED, 0),
+        "audit_mismatch": counters.get(tele.C_AUDIT_MISMATCH, 0),
+    }
+
+
 def _hist_rows(hists: dict) -> dict:
     return {
         name: {
@@ -619,6 +648,9 @@ def analyze(doc: dict) -> dict:
         # cross-job batching (serve/batching.py) + per-tenant quota
         # consumption (serve/quota.py)
         "batching": _batching_report(doc, counters, hists),
+        # device health scoreboard + hedged dispatch + SDC audit
+        # (utils/health.py)
+        "health": _health_report(doc, counters),
         "counters": {
             k: counters[k]
             for k in (
@@ -630,6 +662,10 @@ def analyze(doc: dict) -> dict:
                 tele.C_COMPILE_IN_WINDOW,
                 tele.C_RETRY_ATTEMPTS, tele.C_FAULT_INJECTED,
                 tele.C_DEVICE_EVICTED,
+                tele.C_HEDGE_FIRED, tele.C_HEDGE_WON,
+                tele.C_HEDGE_WASTED,
+                tele.C_AUDIT_SAMPLED, tele.C_AUDIT_MISMATCH,
+                tele.C_HEALTH_PROBATION, tele.C_HEALTH_READMITTED,
                 tele.C_MESH_DISPATCHED, tele.C_MESH_DEGRADED,
                 # resumed-vs-fresh window accounting (a resumed run's
                 # report must say how much work the journal spared)
@@ -831,6 +867,40 @@ def render_report(report: dict) -> str:
                 + f" bytes, {q.get('compute_s', 0.0):.3f}"
                 + (f" of {bc:g}" if bc is not None else "")
                 + f" s compute ({q.get('charges', 0)} charges)"
+            )
+    hlth = report.get("health") or {}
+    if hlth:
+        out += ["", "Device health (scoreboard / hedging / SDC audit)"]
+        for dev, row in (hlth.get("devices") or {}).items():
+            reason = row.get("reason")
+            out.append(
+                f"  device {dev}: {row.get('state', '?')}"
+                f" (score {row.get('score', 0)},"
+                f" {row.get('transitions', 0)} transition(s))"
+                + (f" — {reason}" if reason else "")
+            )
+        out.append(
+            f"  transitions: {hlth['demoted']} demoted, "
+            f"{hlth['probation']} probation, "
+            f"{hlth['readmitted']} readmitted, "
+            f"{hlth['probe_failed']} probe-failed"
+        )
+        if hlth.get("hedge_fired"):
+            out.append(
+                f"  hedged dispatch: {hlth['hedge_fired']} fired — "
+                f"{hlth['hedge_won']} won, {hlth['hedge_wasted']} "
+                "wasted (first result wins; bytes identical either way)"
+            )
+        if hlth.get("audit_sampled"):
+            out.append(
+                f"  SDC audit: {hlth['audit_sampled']} window(s) "
+                f"dual-computed, {hlth['audit_mismatch']} mismatch(es)"
+            )
+        if hlth.get("audit_mismatch"):
+            out.append(
+                "  WARNING: the audit caught silent data corruption — "
+                "the offending device was quarantined and every "
+                "mismatched window republished from the host recompute"
             )
     hbm = report.get("hbm") or {}
     if hbm:

@@ -25,10 +25,13 @@ docs/ROBUSTNESS.md)::
     spec    := clause (';' clause)*
     clause  := site '=' action (',' option)*
     action  := 'transient' | 'permanent' | 'delay:<seconds>' | 'kill'
+             | 'corrupt'
     option  := 'every=N'    match every Nth arrival at the site
              | 'after=N'    skip the first N arrivals
              | 'times=N'    stop matching after N injections
              | 'device=K'   only arrivals attributed to device id K
+             | 'pass=NAME'  only arrivals under this telemetry pass
+                            scope (a / observe / apply / sweep / ...)
              | 'p=F'        match with probability F (seeded RNG)
              | 'seed=N'     RNG seed for p= (default 0)
 
@@ -42,8 +45,15 @@ device-eviction path owns it), ``delay:S`` sleeps S seconds at the site
 (a hung RPC; the fetch deadline watchdog turns it into a retryable
 timeout), ``kill`` SIGKILLs the process itself (a host death — the
 kill-and-resume chaos harness's weapon; see the ``proc.kill`` site).
-Every injection counts ``fault.injected`` on the global telemetry
-tracer.
+``corrupt`` is the silent-data-corruption weapon (Dixit et al., "Silent
+Data Corruptions at Scale"): instead of raising, it flips one
+deterministically chosen bit in the *result* flowing through a
+corruption-capable site (:data:`CORRUPT_POINTS` — today the
+``device.fetch`` d2h boundary, via :func:`corrupt_array`), modelling a
+chip that computes wrong answers without erroring; the SDC audit
+(``ADAM_TPU_AUDIT_RATE``, docs/ROBUSTNESS.md "Device health, hedging,
+and SDC audit") is what must catch it.  Every injection counts
+``fault.injected`` on the global telemetry tracer.
 """
 
 from __future__ import annotations
@@ -136,15 +146,28 @@ class PermanentFault(FaultError):
     re-raise it immediately and the eviction path takes over."""
 
 
+#: Sites whose call path can actually flip result bits: ``corrupt``
+#: clauses are only legal here (a corrupt clause on any other site
+#: would arm an injection that can never fire — the same install-time
+#: hard-error contract unknown sites get).  ``device.fetch`` is the one
+#: data-bearing boundary every device result crosses
+#: (``utils/transfer.device_fetch`` routes the fetched array through
+#: :func:`corrupt_array`), so a dispatch's wrong answer and a torn
+#: fetch are both expressible there.
+CORRUPT_POINTS = frozenset({"device.fetch"})
+
+
 class _Clause:
     __slots__ = (
         "site", "action", "delay_s", "every", "after", "times",
-        "device", "p", "_rng", "_arrivals", "_fired",
+        "device", "pass_name", "p", "seed", "_rng", "_arrivals",
+        "_fired",
     )
 
     def __init__(self, site: str, action: str, delay_s: float,
                  every: int | None, after: int, times: int | None,
-                 device: str | None, p: float | None, seed: int):
+                 device: str | None, p: float | None, seed: int,
+                 pass_name: str | None = None):
         self.site = site
         self.action = action
         self.delay_s = delay_s
@@ -152,18 +175,22 @@ class _Clause:
         self.after = after
         self.times = times
         self.device = device
+        self.pass_name = pass_name
         self.p = p
+        self.seed = seed
         self._rng = random.Random(seed)
         self._arrivals = 0
         self._fired = 0
 
-    def arrive(self, device) -> bool:
+    def arrive(self, device, pass_name=None) -> bool:
         """Advance this clause's arrival counter and evaluate its
         predicate (called under the module lock).  Firing — and the
         ``times=`` accounting — is the caller's decision: every clause
         on a site sees every arrival, so 'the Nth time any call reaches
         this site' holds even when an earlier clause fires first."""
         if self.device is not None and str(device) != self.device:
+            return False
+        if self.pass_name is not None and pass_name != self.pass_name:
             return False
         self._arrivals += 1
         if self.times is not None and self._fired >= self.times:
@@ -200,14 +227,24 @@ def _parse_clause(text: str) -> _Clause:
                 f"fault clause {text!r}: delay wants a float seconds value"
             ) from None
         action = "delay"
-    if action not in ("transient", "permanent", "delay", "kill"):
+    if action not in ("transient", "permanent", "delay", "kill",
+                      "corrupt"):
         raise ValueError(
             f"fault clause {text!r}: unknown action {action!r} "
-            "(expected transient | permanent | delay:<seconds> | kill)"
+            "(expected transient | permanent | delay:<seconds> | kill "
+            "| corrupt)"
+        )
+    if action == "corrupt" and site not in CORRUPT_POINTS:
+        raise ValueError(
+            f"fault clause {text!r}: 'corrupt' only fires at the "
+            f"corruption-capable sites {sorted(CORRUPT_POINTS)} — a "
+            "clause here would arm an injection that can never flip "
+            "anything"
         )
     every = times = None
     after = 0
     device = None
+    pass_name = None
     p = None
     seed = 0
     for opt in filter(None, (o.strip() for o in opts.split(","))):
@@ -225,6 +262,8 @@ def _parse_clause(text: str) -> _Clause:
                 times = int(val)
             elif key == "device":
                 device = val
+            elif key == "pass":
+                pass_name = val
             elif key == "p":
                 p = float(val)
             elif key == "seed":
@@ -240,7 +279,7 @@ def _parse_clause(text: str) -> _Clause:
                 f"fault clause {text!r}: bad value for {key!r}: {val!r}"
             ) from None
     return _Clause(site, action, delay_s, every, after, times, device, p,
-                   seed)
+                   seed, pass_name)
 
 
 def parse_spec(spec: str) -> list:
@@ -261,12 +300,28 @@ _LOCK = threading.Lock()
 
 
 def install(spec: str | None) -> None:
-    """Arm (or, with None/empty, disarm) a fault spec process-wide."""
+    """Arm (or, with None/empty, disarm) a fault spec process-wide.
+
+    Arming or disarming also RESETS the device-health scoreboard
+    (utils/health.py): the board's whole point is remembering real
+    hardware misbehavior across runs, and signals manufactured by an
+    injected spec are not that — without the reset, one test's
+    injected evictions would leak probation/evicted states into every
+    later run in the process.  Production never arms specs, so the
+    persistent-scoreboard contract is untouched there."""
     global ENABLED, _CLAUSES
     clauses = parse_spec(spec) if spec else []
     with _LOCK:
+        was = ENABLED
         _CLAUSES = clauses
         ENABLED = bool(clauses)
+    if was or clauses:
+        try:
+            from adam_tpu.utils import health as health_mod
+
+            health_mod.reset_board()
+        except Exception:
+            pass
     if clauses:
         log.warning(
             "fault injection ARMED: %d clause(s) from %r (this is a "
@@ -280,25 +335,42 @@ def clear() -> None:
     install(None)
 
 
-def point(site: str, device=None) -> None:
+def _current_pass():
+    """The thread's active telemetry pass scope (the ``pass=NAME``
+    clause selector matches against it); None outside any scope."""
+    from adam_tpu.utils import telemetry as tele
+
+    return tele.current_pass()
+
+
+def point(site: str, device=None, pass_name=None) -> None:
     """A named fault point.  Disabled cost: one module-global branch.
 
     ``device``: the jax device (or its id) the call is attributed to,
     matched against a clause's ``device=K`` filter the same way the
-    telemetry ``device=<k>`` span attribution is keyed.
+    telemetry ``device=<k>`` span attribution is keyed.  ``pass_name``
+    overrides the thread-local telemetry pass scope for the ``pass=``
+    clause selector — call sites that arrive on helper threads (the
+    fetch watchdog) capture the scope on the caller thread and thread
+    it through.  ``corrupt`` clauses never fire here — they live on
+    the data channel (:func:`corrupt_array`), and the two channels
+    count arrivals independently so a mixed spec's ``every``/``after``
+    schedules stay anchored to the arrivals each action can see.
     """
     if not ENABLED:
         return
     dev_id = getattr(device, "id", device)
+    if pass_name is None:
+        pass_name = _current_pass()
     fire = None
     with _LOCK:
         # every same-site clause counts the arrival (so each clause's
         # every/after schedule is anchored to REAL arrivals at the
         # site); the first whose predicate matches fires
         for clause in _CLAUSES:
-            if clause.site != site:
+            if clause.site != site or clause.action == "corrupt":
                 continue
-            if clause.arrive(dev_id) and fire is None:
+            if clause.arrive(dev_id, pass_name) and fire is None:
                 fire = clause
         if fire is not None:
             fire._fired += 1
@@ -330,6 +402,64 @@ def point(site: str, device=None) -> None:
                              f" (device={dev_id})")
     raise TransientFault(f"injected transient fault at {site}"
                          f" (device={dev_id})")
+
+
+def corrupt_array(site: str, arr, device=None, pass_name=None):
+    """The data channel of the fault grammar: pass a just-produced
+    result array through the ``corrupt`` clauses armed at ``site`` and
+    return it — bit-flipped when a clause fires, untouched (the very
+    same object) otherwise.  Disabled cost: one module-global branch.
+
+    The flip is **deterministic**: the flipped bit's position derives
+    from a seeded RNG per clause (``seed=N``), so a chaos run
+    reproduces the exact corruption from its spec — and the SDC audit
+    (docs/ROBUSTNESS.md "Device health, hedging, and SDC audit") must
+    detect every one of them.  Non-numpy results (scalars, lists) pass
+    through unflipped: every corruption-capable site hands numpy in
+    practice, and a silent skip is exactly what a bit flip in
+    un-auditable metadata must never be mistaken for.
+    """
+    if not ENABLED:
+        return arr
+    dev_id = getattr(device, "id", device)
+    if pass_name is None:
+        pass_name = _current_pass()
+    fire = None
+    with _LOCK:
+        for clause in _CLAUSES:
+            if clause.site != site or clause.action != "corrupt":
+                continue
+            if clause.arrive(dev_id, pass_name) and fire is None:
+                fire = clause
+        if fire is not None:
+            fire._fired += 1
+            # one RNG draw per injection, under the lock: the flipped
+            # byte/bit sequence is a pure function of (seed, #fired)
+            draw = fire._rng.random()
+    if fire is None:
+        return arr
+    import numpy as np
+
+    a = np.asarray(arr)
+    if a.size == 0 or a.dtype == object:
+        return arr
+    out = np.array(a, copy=True)
+    # reshape BEFORE the u8 view: a 0-d result (a scalar fetch) cannot
+    # view-cast to a different itemsize, and the corrupt channel must
+    # never raise — reshape(-1) of the fresh contiguous copy is a view,
+    # so the flip below lands in `out`
+    flat = out.reshape(-1).view(np.uint8).reshape(-1)
+    pos = int(draw * flat.size * 8) % (flat.size * 8)
+    flat[pos // 8] ^= np.uint8(1 << (pos % 8))
+    from adam_tpu.utils import telemetry as tele
+
+    tele.TRACE.count(tele.C_FAULT_INJECTED)
+    log.warning(
+        "fault injected at %s (device=%s, pass=%s): corrupt — flipped "
+        "bit %d of a %d-byte result", site, dev_id, pass_name,
+        pos, flat.size,
+    )
+    return out
 
 
 # Arm from the environment at import: subprocess drivers (the CI fault

@@ -145,6 +145,53 @@ def jitter_factor(site: str, attempt: int, *, seed: int = 0,
     return 1.0 + amount * unit
 
 
+# ---------------------------------------------------------------------------
+# Drain-aware backoff: a process-wide cancellation event retry sleeps
+# wait on.  Before this, an `adam-tpu serve` SIGTERM drain could stall
+# up to ADAM_TPU_RETRY_MAX_BACKOFF_S per in-flight retry — each backoff
+# was a blind time.sleep.  The multi-job scheduler registers its drain
+# event here (serve/scheduler.py); when it fires, every sleeping retry
+# wakes immediately and runs its REMAINING attempts with only a small
+# bounded pause (_DRAIN_RETRY_PAUSE_S) between them.  Only the long
+# exponential sleeps stall a drain — the attempts themselves are cheap,
+# and keeping them preserves failure semantics: a one-off transient
+# that arrives during a drain still absorbs (the window completes and
+# the job stops cleanly at its boundary), instead of surfacing as a
+# device failure that would spuriously evict a healthy chip on the
+# process-wide health scoreboard (utils/health.py — mark_evicted is
+# terminal).  docs/ROBUSTNESS.md "Fault-isolated multi-job scheduling".
+# ---------------------------------------------------------------------------
+_CANCEL_EVENT: Optional[threading.Event] = None
+_CANCEL_LOCK = threading.Lock()
+#: Pause between attempts once the cancel event fired: long enough for
+#: a short transient to clear across the remaining attempts, bounded so
+#: a drain never stalls more than attempts x this per in-flight retry.
+_DRAIN_RETRY_PAUSE_S = 0.05
+
+
+def set_cancel_event(event: Optional[threading.Event]) -> None:
+    """Install (or, with None, remove) the process-wide retry-sleep
+    cancellation event.  Idempotent; the scheduler owns its lifetime."""
+    global _CANCEL_EVENT
+    with _CANCEL_LOCK:
+        _CANCEL_EVENT = event
+
+
+def clear_cancel_event(event: Optional[threading.Event] = None) -> None:
+    """Remove the installed cancellation event — but only when it is
+    still ``event`` (or unconditionally with None): two schedulers in
+    one process must not clear each other's registration."""
+    global _CANCEL_EVENT
+    with _CANCEL_LOCK:
+        if event is None or _CANCEL_EVENT is event:
+            _CANCEL_EVENT = None
+
+
+def cancel_event() -> Optional[threading.Event]:
+    with _CANCEL_LOCK:
+        return _CANCEL_EVENT
+
+
 class RetryPolicy:
     """Attempt/backoff tuning for one family of call sites."""
 
@@ -204,6 +251,7 @@ def retry_call(
     site: str,
     policy: Optional[RetryPolicy] = None,
     retryable: Callable[[BaseException], bool] = is_retryable,
+    cancel: Optional[threading.Event] = None,
 ):
     """Call ``fn()``; retry retryable failures with exponential backoff.
 
@@ -211,6 +259,14 @@ def retry_call(
     caller (the device-eviction path, usually) decides what a spent
     budget means.  ``site`` labels the log lines and groups nothing
     else; the ``retry.attempts`` counter is global.
+
+    Backoff sleeps are **drain-aware**: they wait on ``cancel`` (or the
+    process-wide event installed via :func:`set_cancel_event`) instead
+    of sleeping blind, and a set event collapses this and every
+    remaining backoff sleep to a small bounded pause — a graceful
+    drain never waits out an exponential backoff.  The attempt budget
+    is untouched, so a transient that would have been absorbed still
+    absorbs and no spurious device failure surfaces mid-drain.
     """
     if policy is None:
         policy = RetryPolicy.from_env()
@@ -237,7 +293,19 @@ def retry_call(
                 site, attempt, policy.attempts, e, sleep_s,
             )
             if sleep_s > 0:
-                time.sleep(sleep_s)
+                ev = cancel if cancel is not None else cancel_event()
+                if ev is not None:
+                    if ev.wait(sleep_s):
+                        # a drain fired mid-wait (or was already set):
+                        # keep a SMALL bounded pause between the
+                        # remaining attempts — zero-delay retries would
+                        # burn the whole budget in microseconds and turn
+                        # a clears-in-100ms transient into a spurious
+                        # device failure; attempts x 50ms can never
+                        # stall the drain
+                        time.sleep(min(sleep_s, _DRAIN_RETRY_PAUSE_S))
+                else:
+                    time.sleep(sleep_s)
             backoff = min(backoff * 2, policy.max_backoff_s)
             attempt += 1
 

@@ -239,6 +239,33 @@ C_BATCH_FALLBACKS = _metric("sched.batch.fallbacks")
 # refused with the typed `Busy(kind="quota")` — the gateway's 429
 # quota leg, distinct from the capacity leg
 C_QUOTA_REJECTED = _metric("sched.quota.rejected")
+# mid-run quota throttle (serve/quota.QuotaManager.throttle): grants
+# deferred at the pacer seam because the tenant's rolling window was
+# over budget — the smooth edge between "admitted" and the 429 leg
+C_QUOTA_DEFERRED = _metric("sched.quota.deferred")
+
+# ---- device health / hedged dispatch / SDC audit (utils/health.py,
+# docs/ROBUSTNESS.md "Device health, hedging, and SDC audit").
+# Scoreboard transitions: healthy->suspect demotions, entries into
+# probation (placement-excluded; includes audit quarantines),
+# re-admissions after a passing known-answer probe, and probes that
+# FAILED (probation -> evicted).  Hedge counters: speculative
+# re-dispatches launched when an in-flight window exceeded
+# ADAM_TPU_HEDGE_FACTOR x the kernel's observed p99, the subset whose
+# result was actually used (won), and the subset discarded because the
+# primary finished first (wasted) — fired == won + wasted.  Audit
+# counters: windows sampled for dual-compute (ADAM_TPU_AUDIT_RATE) and
+# bit-compare mismatches caught (each one quarantines the producing
+# device and replays the window from the host copy). ----
+C_HEALTH_DEMOTED = _metric("device.health.demoted")
+C_HEALTH_PROBATION = _metric("device.health.probation")
+C_HEALTH_READMITTED = _metric("device.health.readmitted")
+C_HEALTH_PROBE_FAILED = _metric("device.health.probe_failed")
+C_HEDGE_FIRED = _metric("device.hedge.fired")
+C_HEDGE_WON = _metric("device.hedge.won")
+C_HEDGE_WASTED = _metric("device.hedge.wasted")
+C_AUDIT_SAMPLED = _metric("device.audit.sampled")
+C_AUDIT_MISMATCH = _metric("device.audit.mismatch")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
@@ -596,6 +623,10 @@ class Tracer:
         # per-tenant quota ledger (serve/quota.py feeds it): tenant ->
         # {charges, bytes, compute_s, budget_bytes, budget_compute_s}
         self._quota: dict = {}
+        # device-health ledger (utils/health.py feeds it): device key ->
+        # {state, score, reason, transitions} — the snapshot's `health`
+        # section, rendered by `adam-tpu analyze` as "Device health"
+        self._health: dict = {}
         self._tls = threading.local()
         self._n_recorded = 0
 
@@ -802,6 +833,39 @@ class Tracer:
             if budget_compute_s is not None:
                 q["budget_compute_s"] = float(budget_compute_s)
 
+    def record_health(self, device_key: str, state: str, score: float,
+                      reason: str = "", transition: bool = True) -> None:
+        """One device-health scoreboard update (utils/health.py feeds
+        transitions and the run-end publish).  The ledger keeps the
+        LAST state/score per device plus a transition count, so the
+        snapshot's ``health`` section reads as "where every chip ended
+        up and how often it moved".  ``transition=False`` records a
+        state WITHOUT counting movement — the run-end ``publish`` of
+        the board's current states, which must not inflate the count
+        of transitions the run actually witnessed (a serve process
+        publishes once per job)."""
+        if not self.recording:
+            return
+        with self._lock:
+            h = self._health.get(str(device_key))
+            if h is None:
+                # every device starts healthy, so a first LIVE record
+                # that is not healthy is itself a transition; a publish
+                # of a pre-existing state is not
+                h = self._health[str(device_key)] = {
+                    "state": state, "score": 0.0, "reason": "",
+                    "transitions": (
+                        1 if transition and state != "healthy" else 0
+                    ),
+                }
+            else:
+                if transition and h["state"] != state:
+                    h["transitions"] += 1
+                h["state"] = state
+            h["score"] = round(float(score), 3)
+            if reason:
+                h["reason"] = str(reason)
+
     def gauge(self, name: str, value) -> None:
         if not self.recording:
             return
@@ -883,6 +947,7 @@ class Tracer:
                 },
                 "hbm": {k: dict(v) for k, v in self._hbm.items()},
                 "quota": {k: dict(v) for k, v in self._quota.items()},
+                "health": {k: dict(v) for k, v in self._health.items()},
                 "events_recorded": self._n_recorded,
                 "events_retained": len(self._events),
                 "events_evicted": self._n_recorded - len(self._events),
@@ -902,6 +967,7 @@ class Tracer:
             self._compiles_dropped = 0
             self._hbm.clear()
             self._quota.clear()
+            self._health.clear()
             self._n_recorded = 0
 
     def reset_metrics(self) -> None:
@@ -917,6 +983,7 @@ class Tracer:
             self._compiles_dropped = 0
             self._hbm.clear()
             self._quota.clear()
+            self._health.clear()
 
     def absorb(self, other: "Tracer") -> None:
         """Merge another tracer's events + aggregates into this one
@@ -943,6 +1010,7 @@ class Tracer:
             compiles_dropped = other._compiles_dropped
             hbm = {k: dict(v) for k, v in other._hbm.items()}
             quota = {k: dict(v) for k, v in other._quota.items()}
+            health = {k: dict(v) for k, v in other._health.items()}
             n_rec = other._n_recorded
         with self._lock:
             self._events.extend(events)
@@ -1028,6 +1096,24 @@ class Tracer:
                     for bk in ("budget_bytes", "budget_compute_s"):
                         if q.get(bk) is not None:
                             mine[bk] = q[bk]
+            for k, hrow in health.items():
+                mine = self._health.get(k)
+                if mine is None:
+                    self._health[k] = dict(hrow)
+                else:
+                    # the absorbed tracer's view is the newer one (run
+                    # tracers fold into TRACE at run end): its last
+                    # state wins, transition counts SUM and nothing
+                    # else — every real transition was counted exactly
+                    # once by whichever tracer witnessed it live, and
+                    # run-end publishes carry transition=False, so a
+                    # state difference here is a stale last-known
+                    # state, not an uncounted movement
+                    mine["transitions"] += hrow["transitions"]
+                    mine["state"] = hrow["state"]
+                    mine["score"] = hrow["score"]
+                    if hrow.get("reason"):
+                        mine["reason"] = hrow["reason"]
 
     # ---- exports ----------------------------------------------------------
     def to_json(self, timers=None, include_events: bool = False) -> dict:
@@ -1132,6 +1218,7 @@ class Tracer:
             }
             hbm = {k: dict(v) for k, v in self._hbm.items()}
             quota = {k: dict(v) for k, v in self._quota.items()}
+            health = {k: dict(v) for k, v in self._health.items()}
             counters = dict(self._counters)
             gauges = {k: dict(v) for k, v in self._gauges.items()}
             n_rec = self._n_recorded
@@ -1150,6 +1237,7 @@ class Tracer:
             "compiles": compiles,
             "hbm": hbm,
             "quota": quota,
+            "health": health,
             "counters": counters,
             # gauges ride along too: the analyzer labels the resolve
             # stage (device vs host sort) and the execution mode off
@@ -1365,11 +1453,12 @@ def merge_snapshots(snaps: list) -> dict:
 #: NDJSON schema tag every heartbeat line carries.  /2 added the
 #: device-ledger fields (tunnel bytes + HBM); /3 appended the
 #: ``partitioner`` execution-mode field; /4 appended the cross-job
-#: batching fields (``batch_fill`` + ``batched_jobs``) — each older
-#: version's fields are a strict prefix of the next, so a consumer
-#: keying on field NAMES keeps working; ``adam-tpu top`` accepts all
-#: four.
-HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/4"
+#: batching fields (``batch_fill`` + ``batched_jobs``); /5 appended
+#: ``device_health`` (the per-device scoreboard states,
+#: utils/health.py) — each older version's fields are a strict prefix
+#: of the next, so a consumer keying on field NAMES keeps working;
+#: ``adam-tpu top`` accepts all five.
+HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/5"
 
 #: THE heartbeat line field set — a stable contract (documented in
 #: docs/OBSERVABILITY.md, lint-enforced by scripts/check-telemetry-names):
@@ -1406,10 +1495,28 @@ HEARTBEAT_FIELDS = (
     # grid fill rate (rows occupied / rows dispatched across every
     # fused dispatch so far; null when batching is off or nothing
     # coalesced yet) and the distinct-job count of the LAST fused
-    # dispatch.  Appended LAST so the /3 fields stay a strict prefix.
+    # dispatch.
     "batch_fill",
     "batched_jobs",
+    # /5: the device-health scoreboard's per-device states
+    # ({device key: healthy|suspect|probation|evicted} from
+    # utils/health.BOARD; null while no device has ever been tracked).
+    # Appended LAST so the /4 fields stay a strict prefix.
+    "device_health",
 )
+
+def _health_states_for_heartbeat():
+    """The /5 ``device_health`` field: the process-wide scoreboard's
+    per-device states, or None while nothing has been tracked (lazy
+    import — health.py imports this module at its top)."""
+    try:
+        from adam_tpu.utils import health as health_mod
+
+        states = health_mod.BOARD.states()
+        return states or None
+    except Exception:
+        return None
+
 
 _DEFAULT_HEARTBEAT_INTERVAL_S = 2.0
 
@@ -1772,6 +1879,7 @@ class Heartbeat:
                 if counters.get(C_BATCH_ROWS_DISPATCHED) else None
             ),
             "batched_jobs": gauges.get(G_BATCH_JOBS, {}).get("last"),
+            "device_health": _health_states_for_heartbeat(),
         }
         if self._provider is not None:
             try:

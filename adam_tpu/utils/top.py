@@ -9,8 +9,8 @@ pure *consumer*: it holds the file read-only, attaches to a run that is
 already mid-flight, survives the heartbeat's size-capped rotation
 (``ADAM_TPU_PROGRESS_MAX_BYTES`` — a truncate-to-zero reads as a fresh
 file), tolerates a torn last line (only newline-terminated lines are
-parsed; the line-buffered writer makes tears transient), accepts both
-``adam_tpu.heartbeat/1``, ``/2`` and ``/3`` lines, and exits 0 when the stream
+parsed; the line-buffered writer makes tears transient), accepts every
+``adam_tpu.heartbeat/1``–``/5`` line, and exits 0 when the stream
 carries ``done=true`` (non-zero when that final line says ``ok=false``).
 
 **Multi-job mode**: pointed at a *directory* (a ``adam-tpu serve``
@@ -39,11 +39,11 @@ from typing import Optional
 
 from adam_tpu.utils.telemetry import format_bytes as _fmt_bytes
 
-#: Heartbeat schema tags this dashboard understands (missing /2–/4
+#: Heartbeat schema tags this dashboard understands (missing /2–/5
 #: fields render as "-"; unknown future fields are ignored).
 ACCEPTED_SCHEMAS = (
     "adam_tpu.heartbeat/1", "adam_tpu.heartbeat/2", "adam_tpu.heartbeat/3",
-    "adam_tpu.heartbeat/4",
+    "adam_tpu.heartbeat/4", "adam_tpu.heartbeat/5",
 )
 
 _CLEAR = "\x1b[H\x1b[2J"
@@ -148,6 +148,18 @@ def render_frame(line: dict, source: str = "") -> str:
             f"batching {_bar(fill, 12)} fill {fill:.0%}"
             f"   jobs/dispatch {line.get('batched_jobs', '-')}"
         )
+    dh = line.get("device_health")
+    if dh:
+        # device-health scoreboard (/5): only non-healthy chips are
+        # worth a cell each; an all-healthy fleet renders one word
+        bad = {d: s for d, s in sorted(dh.items()) if s != "healthy"}
+        if bad:
+            out.append(
+                "health   "
+                + "  ".join(f"{d}:{s}" for d, s in bad.items())
+            )
+        else:
+            out.append(f"health   all {len(dh)} device(s) healthy")
     out.append(
         f"events   retries {line.get('retries', 0)}"
         f"   faults {line.get('faults', 0)}"

@@ -140,20 +140,32 @@ def _resident_device(x):
         return None
 
 
-def _fetch_chunked(x, threads: int) -> np.ndarray:
-    """One fetch attempt (the pre-resilience device_fetch body)."""
-    faults.point("device.fetch", device=_resident_device(x))
+def _fetch_chunked(x, threads: int, pass_name=None) -> np.ndarray:
+    """One fetch attempt (the pre-resilience device_fetch body).  The
+    fetched result passes through the fault grammar's data channel
+    (``corrupt`` clauses at ``device.fetch`` — the silent-data-
+    corruption injection the SDC audit must catch); the disabled cost
+    is one module-global branch.  ``pass_name`` is the caller thread's
+    telemetry pass scope (this body runs on the deadline watchdog
+    thread, which carries none of its own)."""
+    dev = _resident_device(x)
+    faults.point("device.fetch", device=dev, pass_name=pass_name)
     nbytes = getattr(x, "nbytes", 0)
     if nbytes < 2 * _MIN_CHUNK_BYTES or x.ndim == 0:
-        return np.asarray(x)
+        return faults.corrupt_array("device.fetch", np.asarray(x),
+                                    device=dev, pass_name=pass_name)
     n = x.shape[0]
     n_chunks = min(threads, max(1, int(nbytes // _MIN_CHUNK_BYTES)), n)
     if n_chunks <= 1:
-        return np.asarray(x)
+        return faults.corrupt_array("device.fetch", np.asarray(x),
+                                    device=dev, pass_name=pass_name)
     bounds = [n * i // n_chunks for i in range(n_chunks + 1)]
     slices = [x[bounds[i]: bounds[i + 1]] for i in range(n_chunks)]
     parts = _map_daemon(np.asarray, slices)
-    return np.concatenate(parts, axis=0)
+    return faults.corrupt_array(
+        "device.fetch", np.concatenate(parts, axis=0), device=dev,
+        pass_name=pass_name,
+    )
 
 
 def device_fetch(x, threads: int = _MAX_THREADS,
@@ -169,18 +181,46 @@ def device_fetch(x, threads: int = _MAX_THREADS,
         return x
     timeout = _fetch_timeout_s() if deadline_s is None else deadline_s
 
+    from adam_tpu.utils import telemetry as tele
+
+    # the pass scope is thread-local and the attempt body runs on the
+    # watchdog thread: capture it HERE so the fault grammar's pass=
+    # selector sees the pipeline pass this fetch belongs to
+    pass_name = tele.current_pass()
+
     def attempt():
         if timeout and timeout > 0:
             return retry_mod.call_with_deadline(
-                lambda: _fetch_chunked(x, threads), timeout,
+                lambda: _fetch_chunked(x, threads, pass_name), timeout,
                 site="device.fetch",
             )
-        return _fetch_chunked(x, threads)
+        return _fetch_chunked(x, threads, pass_name)
 
-    from adam_tpu.utils import telemetry as tele
+    def retryable(e: BaseException) -> bool:
+        # the health scoreboard remembers what the retry wrappers
+        # absorb: device-attributed transient failures and watchdog
+        # trips feed the per-device score (utils/health.py) before the
+        # backoff hides them.  Only REAL single-device attributions
+        # feed it: a None (indeterminable) or "mesh" (collective)
+        # source would accrue penalties on a phantom key no pool can
+        # ever probe or exclude.
+        ok = retry_mod.is_retryable(e)
+        if ok:
+            dev = _resident_device(x)
+            if dev is not None and getattr(dev, "id", None) is not None:
+                from adam_tpu.utils import health as health_mod
+
+                if isinstance(e, retry_mod.DeadlineExceeded):
+                    health_mod.BOARD.note_timeout(
+                        dev, site="device.fetch"
+                    )
+                else:
+                    health_mod.BOARD.note_retry(dev, site="device.fetch")
+        return ok
 
     if not tele.TRACE.recording:
-        return retry_mod.retry_call(attempt, site="device.fetch")
+        return retry_mod.retry_call(attempt, site="device.fetch",
+                                    retryable=retryable)
     # latency histogram over every device->host fetch (seconds,
     # retries included — the caller-visible latency): on a tunneled
     # link the barrier-2 and pass-C walls are governed by the fetch
@@ -191,7 +231,8 @@ def device_fetch(x, threads: int = _MAX_THREADS,
     t0 = time.monotonic()
     out = None
     try:
-        out = retry_mod.retry_call(attempt, site="device.fetch")
+        out = retry_mod.retry_call(attempt, site="device.fetch",
+                                   retryable=retryable)
         return out
     finally:
         dur = time.monotonic() - t0

@@ -38,7 +38,7 @@ from adam_tpu.utils import faults
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-HB = "adam_tpu.heartbeat/4"
+HB = "adam_tpu.heartbeat/5"
 
 
 def _parts_hash(d):

@@ -768,7 +768,7 @@ def test_heartbeat_v2_carries_tunnel_and_hbm_fields(tmp_path):
     hb.start()
     hb.stop()
     lines = [json.loads(l) for l in open(str(tmp_path / "hb.ndjson"))]
-    assert lines[-1]["schema"] == "adam_tpu.heartbeat/4"
+    assert lines[-1]["schema"] == "adam_tpu.heartbeat/5"
     assert lines[-1]["h2d_bytes"] == 12345
     assert lines[-1]["d2h_bytes"] == 54321
     assert lines[-1]["hbm_bytes_in_use"] == {}
