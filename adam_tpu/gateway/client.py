@@ -240,6 +240,33 @@ class GatewayClient:
                 )
             time.sleep(poll_s)
 
+    # ---- observability surfaces ----------------------------------------
+    def metrics(self) -> str:
+        """``GET /metrics``: the Prometheus text exposition body,
+        verbatim (it is NOT JSON — scrapers and the smoke test parse
+        the exposition format directly)."""
+        conn = self._connect()
+        try:
+            conn.request("GET", protocol.METRICS_PATH)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 400:
+                _raise_for(resp.status, resp.headers, data)
+            return data.decode("utf-8", errors="replace")
+        finally:
+            conn.close()
+
+    def job_trace(self, job: str) -> dict:
+        """``GET /v1/jobs/<job>/trace``: the job's Chrome-trace JSON
+        (``traceEvents`` + ledger sections + ``trace_id``), spanning
+        submit -> fused dispatch -> part write via fan-in links."""
+        return self._request_json("GET", self._job_path(job, "trace"))
+
+    def incidents(self) -> dict:
+        """``GET /incidents``: incident-bundle summaries under the
+        service's run root (oldest first)."""
+        return self._request_json("GET", protocol.INCIDENTS_PATH)
+
     # ---- event streaming -----------------------------------------------
     def poll_events(self, job: str, cursor: int = 0) -> tuple:
         """One non-following poll: ``(next_cursor, lines)`` of every

@@ -24,14 +24,28 @@ GATEWAY_SCHEMA = "adam_tpu.gateway/1"
 ERROR_SCHEMA = "adam_tpu.gateway_error/1"
 
 #: Route prefix; the full surface is documented in docs/SERVING.md:
-#:   PUT    /v1/jobs/<job>                submit (idempotency-keyed)
+#:   PUT    /v1/jobs/<job>                submit (idempotency-keyed;
+#:                                        mints + echoes trace_id)
 #:   GET    /v1/jobs                      service status
 #:   GET    /v1/jobs/<job>                job status
 #:   DELETE /v1/jobs/<job>                cancel at a window boundary
 #:   GET    /v1/jobs/<job>/events         NDJSON heartbeat stream
+#:   GET    /v1/jobs/<job>/trace          Chrome-trace JSON of the
+#:                                        job's trace (fan-in links
+#:                                        across fused batches)
 #:   GET    /v1/jobs/<job>/parts          part listing (name/bytes/sha)
 #:   GET    /v1/jobs/<job>/parts/<part>   part bytes (Range-resumable)
+#:   GET    /metrics                      Prometheus text exposition
+#:   GET    /incidents                    incident-bundle summaries
 JOBS_PREFIX = "/v1/jobs"
+
+#: Top-level observability routes (docs/OBSERVABILITY.md).
+METRICS_PATH = "/metrics"
+INCIDENTS_PATH = "/incidents"
+
+#: JSON body of ``GET /incidents`` (``incidents`` holds
+#: utils/incidents.summarize_bundle rows, oldest first).
+INCIDENTS_SCHEMA = "adam_tpu.incidents/1"
 
 #: Submission-manifest body cap: a JobSpec document is a few hundred
 #: bytes; anything past this is a client bug or an attack, refused

@@ -22,7 +22,7 @@ publish atomically.  What the gateway ADDS is the protocol surface
   grant cadence (gateway/protocol.retry_after_s), so clients back off
   at the pace the pool is actually draining windows.
 * **Resumable event streaming** — ``GET /v1/jobs/<job>/events`` tails
-  the job's ``adam_tpu.heartbeat/5`` NDJSON stream as a chunked
+  the job's ``adam_tpu.heartbeat/6`` NDJSON stream as a chunked
   response, resumable from a line ``cursor`` (a tailer that
   reconnects re-requests from its last count; a heartbeat-file
   rotation resets the cursor, exactly like ``adam-tpu top``'s
@@ -32,6 +32,12 @@ publish atomically.  What the gateway ADDS is the protocol surface
   sha256 + size, so a client SIGKILLed mid-download resumes byte-exact
   and verifies the assembly (the network twin of the PR 6 resume
   contract).
+* **Observability surfaces** (docs/OBSERVABILITY.md) — submission
+  mints the job's trace context (``trace_id`` echoed in the 201 and
+  persisted via JOB.json); ``GET /metrics`` serves Prometheus text
+  exposition off the live tracer snapshot; ``GET /v1/jobs/<job>/trace``
+  serves the job's Chrome-trace view across the fused-batch boundary;
+  ``GET /incidents`` lists the run root's incident bundles.
 
 Full citizenship in the cross-cutting subsystems: ``gateway.accept``/
 ``gateway.stream``/``gateway.fetch`` fault points (a ``transient``
@@ -176,12 +182,24 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- routing -------------------------------------------------------
     def _route(self, method: str, segs: list, query: dict) -> None:
+        if segs == ["metrics"]:
+            if method != "GET":
+                raise _HTTPError(405, "method", f"{method} on /metrics")
+            self._metrics()
+            return
+        if segs == ["incidents"]:
+            if method != "GET":
+                raise _HTTPError(405, "method",
+                                 f"{method} on /incidents")
+            self._incidents()
+            return
         if segs[:2] != ["v1", "jobs"]:
             raise _HTTPError(
                 404, "not_found",
                 f"unknown route {self.path!r} (the surface is "
-                f"{protocol.JOBS_PREFIX}[/<job>[/events|/parts[/"
-                "<part>]]]; docs/SERVING.md)",
+                f"{protocol.JOBS_PREFIX}[/<job>[/events|/trace|/parts"
+                "[/<part>]]], /metrics and /incidents; "
+                "docs/SERVING.md)",
             )
         rest = segs[2:]
         if not rest:
@@ -210,6 +228,8 @@ class _Handler(BaseHTTPRequestHandler):
                              f"{method} on {'/'.join(rest[1:])}")
         if rest[1] == "events" and len(rest) == 2:
             self._stream_events(job, query)
+        elif rest[1] == "trace" and len(rest) == 2:
+            self._job_trace(job)
         elif rest[1] == "parts" and len(rest) == 2:
             self._list_parts(job)
         elif rest[1] == "parts" and len(rest) == 3:
@@ -260,9 +280,21 @@ class _Handler(BaseHTTPRequestHandler):
                      kind="draining"),
             )
             return
-        got = self.gw.service.submit(spec)
+        # trace context is minted HERE (docs/OBSERVABILITY.md): the
+        # gateway is the job's entry point, so its submit span is the
+        # trace root; the id persists via JOB.json (spec round-trip)
+        # and is echoed below so the client can correlate
+        if spec.trace_id is None:
+            spec.trace_id = tele.mint_trace_id()
+        with tele.TRACE.span(tele.SPAN_GW_SUBMIT, job=job,
+                             tenant=spec.tenant, trace=spec.trace_id):
+            got = self.gw.service.submit(spec)
         if isinstance(got, Admitted):
-            self._send_json(201, {"job_id": job, "state": "pending"})
+            self._send_json(201, {
+                "job_id": job,
+                "state": "pending",
+                "trace_id": spec.trace_id,
+            })
             return
         if got.kind == "duplicate":
             # lost a submit race with another client retry: answer
@@ -290,13 +322,29 @@ class _Handler(BaseHTTPRequestHandler):
         view = self.gw.service.status()["jobs"].get(job)
         if view is None:
             return False
-        if view.get("spec") == spec.to_doc():
+        stored = dict(view.get("spec") or {})
+        incoming = spec.to_doc()
+        if incoming.get("trace_id") is None:
+            # the gateway minted the stored trace_id — a client retry
+            # that never saw the first response cannot echo it, so an
+            # absent incoming trace_id matches any stored one (an
+            # EXPLICIT mismatched trace_id is still a conflict)
+            stored.pop("trace_id", None)
+            incoming.pop("trace_id", None)
+        if stored == incoming:
             if view["state"] in ("interrupted", "quarantined"):
+                # deliberate re-PUT resume: keep the job's ORIGINAL
+                # trace — one job is one trace however many attempts
+                if spec.trace_id is None:
+                    spec.trace_id = (
+                        (view.get("spec") or {}).get("trace_id")
+                    )
                 return False
             self._send_json(200, {
                 "job_id": job,
                 "state": view["state"],
                 "duplicate": True,
+                "trace_id": (view.get("spec") or {}).get("trace_id"),
             })
             return True
         raise _HTTPError(
@@ -324,6 +372,63 @@ class _Handler(BaseHTTPRequestHandler):
                                retry_after=retry),
             headers={"Retry-After": str(retry)},
         )
+
+    # ---- observability surfaces ----------------------------------------
+    def _metrics(self) -> None:
+        """``GET /metrics``: Prometheus text exposition rendered from
+        the live global tracer snapshot.  The scrape counter bumps
+        BEFORE the snapshot, so a scraper always sees its own scrape
+        counted — two consecutive scrapes read strictly increasing
+        ``adam_tpu_gateway_metrics_scrapes`` (the smoke test's
+        monotonicity probe)."""
+        from adam_tpu.gateway import metrics as metrics_mod
+
+        tele.TRACE.count(tele.C_GW_SCRAPES)
+        body = metrics_mod.render_prometheus(
+            tele.TRACE.snapshot()
+        ).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         metrics_mod.PROMETHEUS_CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        tele.TRACE.count(tele.C_GW_BYTES_OUT, len(body))
+
+    def _incidents(self) -> None:
+        """``GET /incidents``: bundle summaries under the scheduler's
+        run root (the dir utils/incidents.py is armed on in serve
+        mode), oldest first."""
+        from adam_tpu.utils import incidents as incidents_mod
+
+        rows = incidents_mod.list_bundles(
+            self.gw.service.scheduler.run_root
+        )
+        self._send_json(200, {
+            "schema": protocol.INCIDENTS_SCHEMA,
+            "incidents": rows,
+        })
+
+    def _job_trace(self, job: str) -> None:
+        """``GET /v1/jobs/<job>/trace``: the job's trace as Chrome
+        trace-event JSON — events stamped with its trace_id plus fused
+        coalescer dispatches whose fan-in ``links`` name it, so the
+        view crosses the fused-batch boundary (submit -> fused
+        dispatch -> part write)."""
+        view = self.gw.service.status()["jobs"].get(job)
+        if view is None:
+            raise _HTTPError(404, "not_found", f"no job {job!r}")
+        trace_id = (view.get("spec") or {}).get("trace_id")
+        if not trace_id:
+            raise _HTTPError(
+                404, "not_found",
+                f"job {job!r} carries no trace context (submitted "
+                "before tracing existed?)",
+            )
+        doc = tele.TRACE.to_chrome_trace(trace_id)
+        doc["job_id"] = job
+        doc["trace_id"] = trace_id
+        self._send_json(200, doc)
 
     # ---- status / cancel -----------------------------------------------
     def _job_view(self, job: str) -> dict:

@@ -404,8 +404,15 @@ def _write_encoded(table: "pa.Table", path: str, compression: str,
     # still gets them at end of run via the tracer absorb
     tr = tracer if tracer is not None else tele.TRACE
     tmp = _staging_path(path)
+    # io-shard threads carry no trace_scope TLS, so the part-write span
+    # is stamped from the run tracer's job trace explicitly — the
+    # gateway /trace export must reach all the way to the part write
+    span_attrs = {"path": os.path.basename(path)}
+    job_trace = getattr(tracer, "trace", None)
+    if job_trace:
+        span_attrs["trace"] = job_trace
     with ins.TIMERS.time(ins.PARQUET_WRITE), tele.TRACE.span(
-        tele.SPAN_PART_WRITE, path=os.path.basename(path)
+        tele.SPAN_PART_WRITE, **span_attrs
     ):
         faults.point("parquet.write")
 
@@ -746,8 +753,14 @@ class PartWriterPool:
         def encode():
             try:
                 faults.point("parquet.encode")
+                # same reason as _write_encoded: encoder threads have no
+                # trace_scope TLS, so stamp the job trace explicitly
+                enc_attrs = {"rows": int(batch.n_rows)}
+                job_trace = getattr(self._tracer, "trace", None)
+                if job_trace:
+                    enc_attrs["trace"] = job_trace
                 with ins.TIMERS.time(ins.PARQUET_ENCODE), tele.TRACE.span(
-                    tele.SPAN_PART_ENCODE, rows=int(batch.n_rows)
+                    tele.SPAN_PART_ENCODE, **enc_attrs
                 ):
                     table = to_arrow_alignments(
                         batch, side, header, packed=packed

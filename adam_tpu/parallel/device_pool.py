@@ -789,19 +789,22 @@ def hedged_call(primary_fn, hedge_fn, threshold_s: float, tracer=None):
     box: list = []
     done = threading.Event()
     # the primary runs on a helper thread, which carries none of the
-    # caller's thread-local telemetry pass scope — capture and re-enter
-    # it there, so the transfer ledger's per-pass attribution (and the
-    # fault grammar's pass= selector) see the same pass the un-hedged
-    # call would have
+    # caller's thread-local telemetry pass/trace scopes — capture and
+    # re-enter them there, so the transfer ledger's per-pass attribution
+    # (and the fault grammar's pass= selector) see the same pass the
+    # un-hedged call would have, and the primary's spans stay stamped
+    # with the caller's job trace
     caller_pass = tele.current_pass()
+    caller_trace = tele.current_trace()
 
     def run_primary():
         try:
-            if caller_pass is not None:
-                with tele.pass_scope(caller_pass):
+            with tele.trace_scope(caller_trace):
+                if caller_pass is not None:
+                    with tele.pass_scope(caller_pass):
+                        box.append((True, primary_fn()))
+                else:
                     box.append((True, primary_fn()))
-            else:
-                box.append((True, primary_fn()))
         except BaseException as e:  # noqa: BLE001 — relayed below
             box.append((False, e))
         done.set()
@@ -816,6 +819,13 @@ def hedged_call(primary_fn, hedge_fn, threshold_s: float, tracer=None):
         raise val
     # the primary is officially late: speculate
     tr.count(tele.C_HEDGE_FIRED)
+    from adam_tpu.utils import incidents
+
+    incidents.maybe_record(
+        "hedge.fired", trace_id=caller_trace or tr.trace, tracer=tr,
+        reason="in-flight window exceeded its %.3fs hedge threshold"
+               % threshold_s,
+    )
     try:
         hedged = hedge_fn()
     except Exception as e:

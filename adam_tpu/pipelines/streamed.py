@@ -267,6 +267,7 @@ def transform_streamed(
     pacer=None,
     device_pool=None,
     coalescer=None,
+    trace: Optional[str] = None,
 ) -> dict:
     """Run the flagship transform as a streamed, overlapped pipeline.
 
@@ -327,13 +328,33 @@ def transform_streamed(
     pool partitioner only (the mesh already fuses the device set per
     window); a coalesced window that fails falls back to this run's own
     solo dispatch path — byte-identical output either way.
+
+    ``trace`` is the run's trace context (docs/OBSERVABILITY.md): the
+    job-scoped trace_id minted at gateway/scheduler admission.  Solo
+    runs mint their own, so every run is traceable.  The run tracer
+    stamps every span it records with it, and it selects this run's
+    events in the gateway ``/trace`` export and incident bundles.
+    Tracing changes attribution metadata only, never output bytes.
     """
+    from adam_tpu.utils import incidents
+
     # Per-run tracer, ALWAYS recording: the returned stats dict is a
     # derived view of its span data (telemetry.streamed_stats_view), so
     # the two can never disagree.  The handful of stage/window spans it
     # records per run is negligible next to the work; it folds into the
     # global TRACE at the end when telemetry is enabled.
     tr = tele.Tracer(recording=True)
+    if trace is None:
+        trace = tele.mint_trace_id()
+    tr.set_trace(trace)
+    tele.activate_trace(trace)
+    # solo runs with a durable run dir arm the incident recorder on it;
+    # under the scheduler it is already armed on the service run root
+    # (install-first wins — a job must not re-point the service's)
+    armed_incidents = False
+    if run_dir is not None and not incidents.installed():
+        incidents.install(run_dir)
+        armed_incidents = True
     # a paced run is a multi-job service job: its heartbeat must carry
     # job-scoped counters only (see _start_heartbeat's include_global)
     hb = _start_heartbeat(tr, progress, include_global=pacer is None)
@@ -361,6 +382,9 @@ def transform_streamed(
         # normal completion already stopped it (inside _finish_trace,
         # before the absorb); this is a no-op backstop
         _stop_heartbeat(hb)
+        tele.deactivate_trace(trace)
+        if armed_incidents:
+            incidents.uninstall()
 
 
 def _transform_streamed_impl(
@@ -1664,8 +1688,14 @@ def _transform_streamed_impl(
         counter.  Returns the (possibly replaced) ``(done,
         p_packed)``."""
         tr.count(tele.C_AUDIT_SAMPLED)
-        host_ds = _host_audit_apply(pre_ds)
-        if _audit_matches(done, p_packed, host_ds):
+        with tr.span(
+            tele.SPAN_AUDIT_CHECK, window=p_idx,
+            **(dp_mod.span_attrs(prod_dev) if prod_dev is not None
+               else {}),
+        ):
+            host_ds = _host_audit_apply(pre_ds)
+            matched = _audit_matches(done, p_packed, host_ds)
+        if matched:
             return done, p_packed
         tr.count(tele.C_AUDIT_MISMATCH)
         log.error(
@@ -1682,6 +1712,16 @@ def _transform_streamed_impl(
                 tracer=tr,
             )
             _drop_resident_on(prod_dev)
+        from adam_tpu.utils import incidents
+
+        incidents.maybe_record(
+            "audit.mismatch",
+            device=dp_mod._attr_id(prod_dev)
+            if prod_dev is not None else None,
+            window=p_idx, trace_id=tr.trace, tracer=tr,
+            reason="SDC dual-compute mismatch on window %d; host bytes "
+                   "published" % p_idx,
+        )
         return host_ds, None
 
     def _apply_parts_mesh(plist):

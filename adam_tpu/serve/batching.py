@@ -138,17 +138,18 @@ class _Future:
 class _Ticket:
     __slots__ = (
         "kind", "key", "job", "tenant", "window", "seq", "t_submit",
-        "n", "g", "gl", "payload", "fut",
+        "n", "g", "gl", "payload", "fut", "trace",
     )
 
     def __init__(self, kind, key, job, tenant, window, seq, n, g, gl,
-                 payload):
+                 payload, trace=None):
         self.kind = kind
         self.key = key
         self.job = job
         self.tenant = tenant
         self.window = window
         self.seq = seq
+        self.trace = trace  # the submitting job's trace_id (fan-in link)
         self.t_submit = time.monotonic()
         self.n = n          # real rows
         self.g = g          # grid rows (the block's leading dim)
@@ -287,10 +288,11 @@ class CoalescerClient:
     pipeline never needs to know its own job identity."""
 
     def __init__(self, coalescer: "WindowCoalescer", job: str,
-                 tenant: str):
+                 tenant: str, trace: Optional[str] = None):
         self._c = coalescer
         self.job = job
         self.tenant = tenant
+        self.trace = trace
 
     def submit_markdup(self, window, batch, resident=None) -> _Future:
         return self._c.submit_markdup(
@@ -332,6 +334,7 @@ class WindowCoalescer:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._jobs: dict = {}   # job -> tenant (the eligible set)
+        self._job_traces: dict = {}  # job -> trace_id (fan-in links)
         self._pending: list = []
         self._seq = 0
         self._rr = 0            # fused-dispatch round-robin cursor
@@ -350,19 +353,26 @@ class WindowCoalescer:
         self._thread.start()
 
     # ---- job lifecycle (scheduler-side) --------------------------------
-    def client(self, job: str, tenant: str = "default") -> CoalescerClient:
+    def client(self, job: str, tenant: str = "default",
+               trace: Optional[str] = None) -> CoalescerClient:
         """Register a job as coalesce-eligible and return its bound
-        client (the scheduler calls this at admission)."""
+        client (the scheduler calls this at admission).  ``trace`` is
+        the job's trace_id: every ticket the client submits carries it,
+        and the fused-dispatch span links back to it (the fan-in edge
+        a job-scoped trace export follows across the batch)."""
         with self._lock:
             self._jobs[job] = tenant
+            if trace is not None:
+                self._job_traces[job] = trace
             self._cond.notify_all()
-        return CoalescerClient(self, job, tenant)
+        return CoalescerClient(self, job, tenant, trace)
 
     def deregister(self, job: str) -> None:
         """Drop a job from the eligible set (idempotent); groups
         waiting on its windows flush at their next check."""
         with self._lock:
             self._jobs.pop(job, None)
+            self._job_traces.pop(job, None)
             self._cond.notify_all()
 
     def stop(self) -> None:
@@ -381,7 +391,8 @@ class WindowCoalescer:
                 raise CoalesceError("coalescer is stopped")
             self._seq += 1
             t = _Ticket(kind, key, job, tenant, window, self._seq,
-                        n, g, gl, payload)
+                        n, g, gl, payload,
+                        trace=self._job_traces.get(job))
             self._pending.append(t)
             self._cond.notify_all()
         return t.fut
@@ -643,11 +654,23 @@ class WindowCoalescer:
         solo path, which owns eviction/replay)."""
         grp.sort(key=self._wfq_rank)
         kind = grp[0].kind
+        # the fan-in span: a fused dispatch serves MANY job traces at
+        # once, so instead of claiming one it links every contributing
+        # (job, window, trace) — events_for_trace / the gateway /trace
+        # surface resolve these links so each job's export crosses the
+        # fused-batch boundary (docs/OBSERVABILITY.md "Trace context")
+        links = [
+            {"job": t.job, "window": t.window, "trace": t.trace}
+            for t in grp
+        ]
         try:
             faults.point("sched.batch", device=kind)
             # chaos-harness kill point: one arrival per fused dispatch
             faults.point("proc.kill", device="batch")
-            with tele.pass_scope("batch"):
+            with self.tracer.span(
+                tele.SPAN_BATCH_FUSED, kind=kind, windows=len(grp),
+                links=links,
+            ), tele.pass_scope("batch"):
                 if kind == "markdup":
                     results, wall = self._fuse_markdup(grp)
                 elif kind == "observe":

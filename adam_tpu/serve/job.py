@@ -62,6 +62,13 @@ class JobSpec:
     window_reads: int = 262_144
     compression: str = "zstd"
     partitioner: Optional[str] = None
+    #: job-scoped trace context (docs/OBSERVABILITY.md "Trace
+    #: context"): minted at gateway submission (or by the scheduler
+    #: for direct submits), echoed to the client, and — because the
+    #: spec round-trips through JOB.json — stable across SIGKILL/
+    #: recovery replay, so a job's trace stays ONE trace however many
+    #: attempts it took
+    trace_id: Optional[str] = None
 
     def validate(self) -> None:
         if not _JOB_ID_RE.match(self.job_id or ""):

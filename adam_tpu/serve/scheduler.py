@@ -31,7 +31,7 @@ multi-job scheduling"):
   would crash-loop the pool).
 
 Every job runs in its own thread with its own run tracer and its own
-``adam_tpu.heartbeat/5`` stream at ``<run-root>/<job>/heartbeat.ndjson``
+``adam_tpu.heartbeat/6`` stream at ``<run-root>/<job>/heartbeat.ndjson``
 (``adam-tpu top <run-root>`` aggregates them).  The ``sched.*`` fault
 points (``sched.admit`` / ``sched.dispatch`` / ``sched.drain`` /
 ``sched.job_crash``, job id in the ``device`` selector slot) extend the
@@ -64,6 +64,7 @@ from adam_tpu.serve.job import (
     JobSpec,
 )
 from adam_tpu.utils import faults
+from adam_tpu.utils import incidents
 from adam_tpu.utils import retry as retry_mod
 from adam_tpu.utils import telemetry as tele
 from adam_tpu.utils.durability import atomic_write_json
@@ -106,6 +107,11 @@ class JobScheduler:
 
         self.run_root = os.path.abspath(run_root)
         os.makedirs(self.run_root, exist_ok=True)
+        # arm the incident recorder on the service's durable root:
+        # anomaly triggers anywhere in this process (health transition,
+        # hedge, SDC mismatch, retry exhaustion, quota 429 burst) drop
+        # bundles under <run-root>/incidents/ (utils/incidents.py)
+        incidents.install(self.run_root)
         self.max_jobs = max(1, max_jobs)
         self.devices = devices
         self.partitioner = partitioner
@@ -286,6 +292,11 @@ class JobScheduler:
                             hint = max(hint, rh)
                     tele.TRACE.count(tele.C_SCHED_REJECTED)
                     tele.TRACE.count(tele.C_QUOTA_REJECTED)
+                    # burst detection: N quota 429s inside the rolling
+                    # window fire one "quota.burst" incident bundle
+                    # (cooldown-limited, so at most one bundle write
+                    # ever lands under this admission lock)
+                    incidents.note_quota_rejected(spec.tenant)
                     return Busy(
                         exceeded.reason, kind="quota",
                         retry_after_s=hint,
@@ -297,6 +308,12 @@ class JobScheduler:
                     "retry when a slot frees",
                     kind="capacity",
                 )
+            if spec.trace_id is None:
+                # direct (non-gateway) submission: mint the job's trace
+                # here so every admitted job carries one; a recovered
+                # spec keeps the id JOB.json round-tripped (one job =
+                # one trace across SIGKILL/recovery attempts)
+                spec.trace_id = tele.mint_trace_id()
             rec = JobRecord(spec, state=PENDING, recovered=recovered)
             if prior is not None:
                 # re-admission of a terminal job resumes its journal
@@ -476,7 +493,9 @@ class JobScheduler:
                 # delay instead of flushing early.
                 coal = self._ensure_coalescer()
                 if coal is not None:
-                    coal_client = coal.client(spec.job_id, spec.tenant)
+                    coal_client = coal.client(
+                        spec.job_id, spec.tenant, trace=spec.trace_id,
+                    )
             known_snps = known_indels = None
             while True:
                 try:
@@ -486,7 +505,7 @@ class JobScheduler:
                         known_snps, known_indels = _load_known_sites(spec)
                     with tele.TRACE.span(
                         tele.SPAN_SCHED_JOB, job=spec.job_id,
-                        tenant=spec.tenant,
+                        tenant=spec.tenant, trace=spec.trace_id,
                     ):
                         stats = streamed_mod.transform_streamed(
                             spec.input, spec.output,
@@ -508,6 +527,7 @@ class JobScheduler:
                             pacer=self._job_pacer(spec),
                             device_pool=lease,
                             coalescer=coal_client,
+                            trace=spec.trace_id,
                         )
                     with self._lock:
                         rec.stats = stats
@@ -686,6 +706,11 @@ class JobScheduler:
         # if it is still ours (a newer scheduler may have re-registered)
         retry_mod.clear_cancel_event(self._drain_ev)
         tele.TRACE.recording = self._restore_recording
+        # disarm the incident recorder, but only if it is still armed
+        # on OUR run-root (a newer scheduler may have re-armed it)
+        if incidents.incidents_dir() == os.path.join(
+                self.run_root, incidents.INCIDENTS_DIRNAME):
+            incidents.uninstall()
 
     # ---- whole-process crash recovery ----------------------------------
     def recover(self) -> list:

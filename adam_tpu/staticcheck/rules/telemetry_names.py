@@ -34,6 +34,13 @@ from adam_tpu.staticcheck.rules._astutil import terminal_name
 REGISTRY_MODULE = "adam_tpu/utils/telemetry.py"
 DOC_FILE = "docs/OBSERVABILITY.md"
 
+#: Prometheus mangling contract (gateway/metrics.py mirrors
+#: utils/telemetry.prometheus_name/prometheus_name_valid; kept as
+#: literals here so the rule lints foreign trees without importing
+#: them — tests pin the two in sync).
+PROMETHEUS_PREFIX = "adam_tpu_"
+_PROM_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+
 _TRACER_RECEIVERS = frozenset({"TRACE", "tr", "tracer"})
 _TRACER_METHODS = frozenset({"span", "count", "gauge", "observe",
                              "add_span"})
@@ -166,3 +173,33 @@ class TelemetryContractRule(Rule):
                     "heartbeat schema",
                     "",
                 )
+        # Prometheus exposition contract (gateway GET /metrics): every
+        # dotted contract name must mangle ('.' -> '_' under the
+        # adam_tpu_ prefix) to a VALID metric name, and no two distinct
+        # names may collide once mangled — a collision would silently
+        # merge two series in every scraper.  Display-style
+        # instrumentation timer names (spaces/parens) sit outside the
+        # dotted contract; the renderer sanitizes them instead.
+        mangled: dict = {}
+        for name in sorted(declared):
+            if not (re.fullmatch(r"[a-z0-9_.]+", name) and "." in name):
+                continue
+            prom = PROMETHEUS_PREFIX + name.replace(".", "_")
+            if not _PROM_NAME_RE.fullmatch(prom):
+                yield Finding(
+                    self.name, REGISTRY_MODULE, 1, 0,
+                    f"registry name '{name}' mangles to '{prom}', not a "
+                    "valid Prometheus metric name",
+                    "",
+                )
+            prior = mangled.get(prom)
+            if prior is not None:
+                yield Finding(
+                    self.name, REGISTRY_MODULE, 1, 0,
+                    f"registry names '{prior}' and '{name}' collide as "
+                    f"Prometheus metric '{prom}' — every scraper would "
+                    "merge their series",
+                    "",
+                )
+            else:
+                mangled[prom] = name

@@ -37,6 +37,7 @@ key) so every bench artifact lands with attribution built in.
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional
 
 from adam_tpu.utils import telemetry as tele
@@ -651,6 +652,10 @@ def analyze(doc: dict) -> dict:
         # device health scoreboard + hedged dispatch + SDC audit
         # (utils/health.py)
         "health": _health_report(doc, counters),
+        # incident bundles recorded beside the artifact
+        # (utils/incidents.py; analyze_path folds the sibling
+        # incidents/ dir's summaries into the doc)
+        "incidents": list(doc.get("incidents") or []),
         "counters": {
             k: counters[k]
             for k in (
@@ -902,6 +907,22 @@ def render_report(report: dict) -> str:
                 "the offending device was quarantined and every "
                 "mismatched window republished from the host recompute"
             )
+    incidents = report.get("incidents") or []
+    if incidents:
+        out += ["", f"Incidents ({len(incidents)} bundle(s))"]
+        for inc in incidents:
+            where = [
+                f"device {inc['device']}" if inc.get("device") else "",
+                f"window {inc['window']}"
+                if inc.get("window") is not None else "",
+                f"trace {inc['trace_id']}" if inc.get("trace_id") else "",
+            ]
+            where_s = ", ".join(w for w in where if w)
+            out.append(
+                f"  {inc.get('id', '?')}: {inc.get('trigger', '?')}"
+                + (f" ({where_s})" if where_s else "")
+                + (f" — {inc['reason']}" if inc.get("reason") else "")
+            )
     hbm = report.get("hbm") or {}
     if hbm:
         out += ["", "HBM footprint"]
@@ -976,5 +997,22 @@ def render_report(report: dict) -> str:
 
 
 def analyze_path(path: str) -> dict:
-    """Convenience: load + analyze one artifact file."""
-    return analyze(load_document(path))
+    """Convenience: load + analyze one artifact file.  When the
+    artifact sits in (or beside) a run dir with an ``incidents/``
+    subdirectory, the bundles' summaries fold into the report's
+    "Incidents" section — the post-hoc view of what the anomaly
+    triggers captured while the run was live."""
+    from adam_tpu.utils import incidents as incidents_mod
+
+    doc = load_document(path)
+    found = []
+    probe = os.path.dirname(os.path.abspath(path))
+    for _ in range(2):  # the artifact's dir, then its parent
+        found = incidents_mod.list_bundles(probe)
+        if found:
+            break
+        probe = os.path.dirname(probe)
+    if found and not doc.get("incidents"):
+        doc = dict(doc)
+        doc["incidents"] = found
+    return analyze(doc)

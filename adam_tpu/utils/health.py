@@ -213,6 +213,11 @@ class HealthBoard:
         )
         self._lock = threading.Lock()
         self._dev: dict[str, _Device] = {}
+        # state transitions staged under the lock, fired as
+        # "health.transition" incident bundles AFTER release (the
+        # bundle snapshots this very board via status(), which takes
+        # the lock — firing inline would deadlock)
+        self._pending_incidents: list = []
         # per-kernel pooled latency histogram (telemetry's fixed
         # log-spaced buckets, so the p99 math is the shared machinery)
         self._lat: dict[str, dict] = {}
@@ -258,6 +263,7 @@ class HealthBoard:
             d.reason = reason
             tracer.count(tele.C_HEALTH_DEMOTED)
             tracer.record_health(key, SUSPECT, d.score, reason)
+            self._pending_incidents.append((key, SUSPECT, reason, tracer))
             log.warning(
                 "device %s health: healthy -> suspect (score %.1f, %s)",
                 key, d.score, reason,
@@ -273,11 +279,30 @@ class HealthBoard:
         )
         tracer.count(tele.C_HEALTH_PROBATION)
         tracer.record_health(key, PROBATION, d.score, reason)
+        self._pending_incidents.append((key, PROBATION, reason, tracer))
         log.error(
             "device %s health: PROBATION (score %.1f, %s) — excluded "
             "from placement; re-admission probe after %.0fs cooldown",
             key, d.score, reason, self.cooldown_s,
         )
+
+    def _flush_incidents(self) -> None:
+        """Fire the staged ``health.transition`` incident bundles.
+        Called by every public feed AFTER its lock release — the bundle
+        writer snapshots this board (``status()`` takes the lock) and
+        must never run under it.  Best-effort like all recording."""
+        with self._lock:
+            if not self._pending_incidents:
+                return
+            pending = self._pending_incidents
+            self._pending_incidents = []
+        from adam_tpu.utils import incidents
+
+        for key, state, reason, tracer in pending:
+            incidents.maybe_record(
+                "health.transition", device=key, tracer=tracer,
+                reason=f"device {key} -> {state}: {reason}",
+            )
 
     # ---- signal feeds --------------------------------------------------
     def note_retry(self, device, site: str = "", tracer=None) -> None:
@@ -289,6 +314,7 @@ class HealthBoard:
                 f"retried failure at {site or 'device rpc'}",
                 tracer if tracer is not None else tele.TRACE,
             )
+        self._flush_incidents()
 
     def note_timeout(self, device, site: str = "", tracer=None) -> None:
         """A fetch-deadline watchdog trip attributed to ``device``."""
@@ -298,6 +324,7 @@ class HealthBoard:
                 f"deadline exceeded at {site or 'device.fetch'}",
                 tracer if tracer is not None else tele.TRACE,
             )
+        self._flush_incidents()
 
     def observe_latency(self, kernel: str, device, seconds: float,
                         tracer=None) -> None:
@@ -372,6 +399,7 @@ class HealthBoard:
                     f"{self.latency_factor:g}x {breach}",
                     tracer if tracer is not None else tele.TRACE,
                 )
+        self._flush_incidents()
 
     def note_hedge_lost(self, device, kernel: str = "", tracer=None) -> None:
         """``device`` lost a hedge race: its window re-dispatched COLD
@@ -390,6 +418,7 @@ class HealthBoard:
                 f"lost hedge race on {kernel or 'dispatch'}",
                 tracer if tracer is not None else tele.TRACE,
             )
+        self._flush_incidents()
 
     def quarantine(self, device, reason: str = "", tracer=None) -> None:
         """Straight to probation — the SDC audit's verdict (wrong bits
@@ -407,6 +436,7 @@ class HealthBoard:
                 key, d, now, reason or "quarantined",
                 tracer if tracer is not None else tele.TRACE,
             )
+        self._flush_incidents()
 
     def mark_evicted(self, device, tracer=None) -> None:
         """The pool evicted this chip (spent retry budget or failed
@@ -418,9 +448,12 @@ class HealthBoard:
                 return
             d.state = EVICTED
             d.since = self._clock()
-            (tracer if tracer is not None else tele.TRACE).record_health(
-                key, EVICTED, d.score, d.reason
+            tr = tracer if tracer is not None else tele.TRACE
+            tr.record_health(key, EVICTED, d.score, d.reason)
+            self._pending_incidents.append(
+                (key, EVICTED, d.reason or "evicted by the pool", tr)
             )
+        self._flush_incidents()
 
     # ---- placement queries --------------------------------------------
     def state(self, device) -> str:
@@ -541,6 +574,10 @@ class HealthBoard:
             tr.count(tele.C_HEALTH_PROBE_FAILED)
             tr.record_health(key, EVICTED, d.score,
                              "re-admission probe failed")
+            self._pending_incidents.append(
+                (key, EVICTED, "re-admission probe failed", tr)
+            )
+        self._flush_incidents()
         log.error(
             "device %s health: re-admission probe FAILED — evicting",
             key,
@@ -590,6 +627,7 @@ class HealthBoard:
         with self._lock:
             self._dev.clear()
             self._lat.clear()
+            self._pending_incidents.clear()
             self.next_probe_due = float("inf")
 
 

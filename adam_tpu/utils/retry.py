@@ -277,6 +277,19 @@ def retry_call(
             return fn()
         except BaseException as e:
             if attempt >= policy.attempts or not retryable(e):
+                if attempt >= policy.attempts and retryable(e):
+                    # the budget was genuinely spent on retryable
+                    # failures (a permanent error on attempt 1 is NOT
+                    # an incident — it never consumed the budget):
+                    # snapshot the evidence before the eviction path
+                    # the caller runs next churns the ring
+                    from adam_tpu.utils import incidents
+
+                    incidents.maybe_record(
+                        "retry.exhausted",
+                        reason="site=%s attempts=%d last=%s"
+                               % (site, attempt, e),
+                    )
                 raise
             from adam_tpu.utils import telemetry as tele
 

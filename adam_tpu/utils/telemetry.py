@@ -58,6 +58,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import sys
 import threading
 import time
@@ -133,6 +134,16 @@ SPAN_POOL_REPLAY = _span("device.pool.replay")
 # attributed — the SLO view of how long each tenant's job actually held
 # a slot, resumed attempts included. ----
 SPAN_SCHED_JOB = _span("sched.job.run")
+
+# ---- job-scoped distributed traces (docs/OBSERVABILITY.md "Trace
+# context").  One span per gateway admission, ``job=`` + ``trace=``
+# attributed — the root of a job's trace (submit -> fused dispatch ->
+# part write).  One span per FUSED coalescer dispatch
+# (serve/batching.py) whose ``links`` arg names every contributing
+# ticket's {job, window, trace} — the fan-in edge that lets a per-job
+# trace export cross the fused-batch boundary. ----
+SPAN_GW_SUBMIT = _span("gateway.job.submit")
+SPAN_BATCH_FUSED = _span("sched.batch.fused")
 
 # ---- barrier-2 per-fetch spans (pipelines/bqsr.merge_observations):
 # one per device-resident observe histogram fetched at the merge
@@ -266,6 +277,20 @@ C_HEDGE_WON = _metric("device.hedge.won")
 C_HEDGE_WASTED = _metric("device.hedge.wasted")
 C_AUDIT_SAMPLED = _metric("device.audit.sampled")
 C_AUDIT_MISMATCH = _metric("device.audit.mismatch")
+# one span per SDC dual-compute comparison (pipelines/streamed.py
+# _audit_result), ``device=`` + ``window=`` attributed — an incident
+# bundle's embedded trace shows the audit interval itself next to the
+# dispatch/fetch spans of the window it checked
+SPAN_AUDIT_CHECK = _span("device.audit.check")
+
+# ---- incident recorder (utils/incidents.py; docs/OBSERVABILITY.md
+# "Incident bundles"): bundles actually written (trigger-cooldowns and
+# the bounded-count prune mean this can lag the trigger counters), and
+# ``/metrics`` scrapes served by the gateway — the heartbeat's
+# ``metrics_scrapes`` field, so `adam-tpu top` can show whether a
+# scraper is actually reaching the process. ----
+C_INCIDENT_RECORDED = _metric("incident.recorded")
+C_GW_SCRAPES = _metric("gateway.metrics.scrapes")
 
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
@@ -531,6 +556,144 @@ def current_pass() -> str | None:
     return stack[-1] if stack else None
 
 
+# --------------------------------------------------------------------------
+# Trace context — job-scoped distributed traces
+# --------------------------------------------------------------------------
+# A trace context is one hex trace_id minted at job submission (the
+# gateway, the scheduler, or transform_streamed itself for solo runs),
+# persisted in JOB.json so recovery replays keep the SAME id, and
+# attached to every span recorded while it is in scope.  Two carriers,
+# by design (the Dapper model, adapted to the in-process pool):
+#
+# * :class:`trace_scope` — thread-local, for code running ON a thread
+#   that belongs to one job (the pass_scope shape; helper threads must
+#   re-enter it explicitly, exactly like hedged_call re-enters the
+#   caller's pass_scope).
+# * :meth:`Tracer.set_trace` — a per-tracer default.  A streamed run
+#   tracer is ALREADY job-scoped (one Tracer per transform_streamed
+#   call), so stamping its default onto every event it records covers
+#   worker threads without any TLS plumbing.
+#
+# The explicit ``trace=`` span attr wins over both — the coalescer's
+# fused dispatch serves MANY traces at once and links them via its
+# ``links`` arg instead of claiming any single one.
+_TRACE_TLS = threading.local()
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace id (crypto-random: ids minted by
+    concurrent gateway submissions must never collide)."""
+    import binascii
+
+    return binascii.hexlify(os.urandom(8)).decode("ascii")
+
+
+class trace_scope:
+    """Marks the current thread as working for one trace (reentrant;
+    inner scopes shadow outer).  ``trace_scope(None)`` is a no-op frame
+    so callers can re-enter a captured-maybe-None context untested —
+    the hedged_call helper-thread pattern."""
+
+    def __init__(self, trace_id: str | None):
+        self._trace = trace_id
+
+    def __enter__(self):
+        stack = getattr(_TRACE_TLS, "stack", None)
+        if stack is None:
+            stack = _TRACE_TLS.stack = []
+        stack.append(self._trace)
+        return self
+
+    def __exit__(self, *exc):
+        _TRACE_TLS.stack.pop()
+        return False
+
+
+def current_trace() -> str | None:
+    """The innermost active :class:`trace_scope` id, or None."""
+    stack = getattr(_TRACE_TLS, "stack", None)
+    for tid in reversed(stack or ()):
+        if tid is not None:
+            return tid
+    return None
+
+
+# Active-trace registry: the heartbeat's ``active_traces`` field.  A
+# trace activates when its job's run starts and deactivates in the
+# run's finally — refcounted, because a recovery replay can briefly
+# overlap the original registration.
+_ACTIVE_TRACES_LOCK = threading.Lock()
+_ACTIVE_TRACES: dict = {}  # trace_id -> activation count
+
+
+def activate_trace(trace_id: str | None) -> None:
+    if not trace_id:
+        return
+    with _ACTIVE_TRACES_LOCK:
+        _ACTIVE_TRACES[trace_id] = _ACTIVE_TRACES.get(trace_id, 0) + 1
+
+
+def deactivate_trace(trace_id: str | None) -> None:
+    if not trace_id:
+        return
+    with _ACTIVE_TRACES_LOCK:
+        n = _ACTIVE_TRACES.get(trace_id, 0) - 1
+        if n <= 0:
+            _ACTIVE_TRACES.pop(trace_id, None)
+        else:
+            _ACTIVE_TRACES[trace_id] = n
+
+
+def active_traces() -> tuple:
+    """The currently-active trace ids (sorted, for stable output)."""
+    with _ACTIVE_TRACES_LOCK:
+        return tuple(sorted(_ACTIVE_TRACES))
+
+
+def event_in_trace(ev: dict, trace_id: str) -> bool:
+    """True when a flight-recorder event belongs to ``trace_id`` —
+    either stamped directly (``ev["trace"]``) or linked through a
+    fused-dispatch fan-in edge (``args.links[*].trace``).  The one
+    membership predicate the /trace export, the incident recorder and
+    the tests all share."""
+    if ev.get("trace") == trace_id:
+        return True
+    links = (ev.get("args") or {}).get("links")
+    if not links:
+        return False
+    try:
+        return any(l.get("trace") == trace_id for l in links)
+    except (AttributeError, TypeError):
+        return False
+
+
+# --------------------------------------------------------------------------
+# Prometheus name mangling — shared by gateway/metrics.py and the
+# telemetry-names staticcheck rule
+# --------------------------------------------------------------------------
+#: Prefix every exposed series carries (`reads.ingested` ->
+#: `adam_tpu_reads_ingested`).
+PROMETHEUS_PREFIX = "adam_tpu_"
+
+#: The exposition-format metric-name grammar (no leading digit).
+_PROM_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+
+
+def prometheus_name(name: str) -> str:
+    """Mangle a registered metric name into its Prometheus series name
+    (``.`` -> ``_``, prefixed).  Total function — validation is the
+    lint's job (:mod:`adam_tpu.staticcheck.rules.telemetry_names`
+    asserts every registered name mangles to a VALID, collision-free
+    series name, so the gateway's render path never has to)."""
+    return PROMETHEUS_PREFIX + name.replace(".", "_")
+
+
+def prometheus_name_valid(mangled: str) -> bool:
+    """Whether a mangled series name satisfies the Prometheus
+    exposition grammar ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    return bool(_PROM_NAME_OK.match(mangled))
+
+
 #: Ring bound on retained compile-ledger entries: every entry is one
 #: real XLA compile (seconds each), so a run can't plausibly exceed
 #: this — it exists so a pathological shape explosion degrades to
@@ -627,8 +790,27 @@ class Tracer:
         # {state, score, reason, transitions} — the snapshot's `health`
         # section, rendered by `adam-tpu analyze` as "Device health"
         self._health: dict = {}
+        # job-scoped trace context: the per-tracer default trace id
+        # (set_trace) and the per-trace aggregate ledger the snapshot's
+        # `traces` section reports: trace_id -> [events, total span ns]
+        self._trace = None
+        self._traces: dict = {}
         self._tls = threading.local()
         self._n_recorded = 0
+
+    # ---- trace context ----------------------------------------------------
+    def set_trace(self, trace_id: str | None) -> None:
+        """Set this tracer's default trace id: every event recorded
+        with no explicit ``trace=`` attr and no active
+        :class:`trace_scope` is stamped with it.  The streamed run
+        tracer is job-scoped, so its default covers every worker
+        thread recording into it — no TLS plumbing required."""
+        self._trace = trace_id
+
+    @property
+    def trace(self) -> str | None:
+        """This tracer's default trace id (None when unset)."""
+        return self._trace
 
     # ---- recording --------------------------------------------------------
     def span(self, name: str, **attrs):
@@ -655,6 +837,12 @@ class Tracer:
             ev["parent"] = parent
         if attrs:
             ev["args"] = dict(attrs)
+        # trace attribution: explicit span attr > thread's trace_scope >
+        # the tracer's own default (a streamed run tracer is job-scoped,
+        # so its default covers worker threads with no TLS plumbing)
+        trace = (attrs or {}).get("trace") or current_trace() or self._trace
+        if trace:
+            ev["trace"] = trace
         dev = (attrs or {}).get("device")
         if (
             dev is not None and (attrs or {}).get("replay")
@@ -687,6 +875,17 @@ class Tracer:
             if h is None:
                 h = self._hists[name] = _new_hist()
             _hist_observe(h, dur / 1e9)
+            if trace:
+                # per-trace aggregate: survives ring eviction, merges
+                # additively (absorb / merge_snapshots) — "how much
+                # recorded work does trace T have" stays answerable
+                # even after the events themselves age out
+                tagg = self._traces.get(trace)
+                if tagg is None:
+                    self._traces[trace] = [1, dur]
+                else:
+                    tagg[0] += 1
+                    tagg[1] += dur
             if dev is not None:
                 # per-device aggregate: the snapshot's device_spans
                 # section (chip occupancy + skew; time-sliced chips are
@@ -905,6 +1104,17 @@ class Tracer:
         with self._lock:
             return [dict(e) for e in self._events]
 
+    def events_for_trace(self, trace_id: str) -> list:
+        """The flight recorder filtered to one trace: events stamped
+        with the id plus fused-dispatch events whose ``links`` name it
+        (:func:`event_in_trace`) — the query the ``/jobs/<id>/trace``
+        gateway surface and the incident recorder are built on."""
+        with self._lock:
+            return [
+                dict(e) for e in self._events
+                if event_in_trace(e, trace_id)
+            ]
+
     def snapshot(self) -> dict:
         """Aggregate view (spans/counters/gauges), safe to call
         concurrently with recording.  Does NOT include the event ring —
@@ -948,6 +1158,10 @@ class Tracer:
                 "hbm": {k: dict(v) for k, v in self._hbm.items()},
                 "quota": {k: dict(v) for k, v in self._quota.items()},
                 "health": {k: dict(v) for k, v in self._health.items()},
+                "traces": {
+                    k: {"events": v[0], "total_s": v[1] / 1e9}
+                    for k, v in self._traces.items()
+                },
                 "events_recorded": self._n_recorded,
                 "events_retained": len(self._events),
                 "events_evicted": self._n_recorded - len(self._events),
@@ -968,6 +1182,7 @@ class Tracer:
             self._hbm.clear()
             self._quota.clear()
             self._health.clear()
+            self._traces.clear()
             self._n_recorded = 0
 
     def reset_metrics(self) -> None:
@@ -1011,6 +1226,7 @@ class Tracer:
             hbm = {k: dict(v) for k, v in other._hbm.items()}
             quota = {k: dict(v) for k, v in other._quota.items()}
             health = {k: dict(v) for k, v in other._health.items()}
+            traces = {k: list(v) for k, v in other._traces.items()}
             n_rec = other._n_recorded
         with self._lock:
             self._events.extend(events)
@@ -1114,6 +1330,13 @@ class Tracer:
                     mine["score"] = hrow["score"]
                     if hrow.get("reason"):
                         mine["reason"] = hrow["reason"]
+            for k, (c, ns) in traces.items():
+                tagg = self._traces.get(k)
+                if tagg is None:
+                    self._traces[k] = [c, ns]
+                else:
+                    tagg[0] += c
+                    tagg[1] += ns
 
     # ---- exports ----------------------------------------------------------
     def to_json(self, timers=None, include_events: bool = False) -> dict:
@@ -1139,7 +1362,7 @@ class Tracer:
             doc["events"] = self.events()
         return doc
 
-    def to_chrome_trace(self) -> dict:
+    def to_chrome_trace(self, trace_id: str | None = None) -> dict:
         """Flight recorder -> Chrome trace-event JSON (Perfetto /
         chrome://tracing).  Each recording thread gets its own track, so
         the streamed tokenize/dispatch/fetch/encode/write overlap is
@@ -1147,8 +1370,17 @@ class Tracer:
         attribution (the multi-chip pool's dispatch/fetch/prewarm spans)
         are additionally mirrored onto a ``device:<k>`` track — one
         track per chip, so per-device queue occupancy and skew are
-        visible next to the host threads."""
-        evs = self.events()
+        visible next to the host threads.
+
+        ``trace_id`` filters the export to one job's trace (stamped
+        events plus fused dispatches linking it — the
+        ``GET /jobs/<id>/trace`` gateway view): same shape, fewer
+        events, so anything that loads the full export loads the
+        per-job one."""
+        evs = (
+            self.events() if trace_id is None
+            else self.events_for_trace(trace_id)
+        )
         pid = os.getpid()
         tids: dict = {}
         out = []
@@ -1177,6 +1409,8 @@ class Tracer:
             args = dict(e.get("args") or {})
             if "parent" in e:
                 args["parent"] = e["parent"]
+            if "trace" in e:
+                args["trace"] = e["trace"]
             if args:
                 ev["args"] = args
             out.append(ev)
@@ -1219,6 +1453,11 @@ class Tracer:
             hbm = {k: dict(v) for k, v in self._hbm.items()}
             quota = {k: dict(v) for k, v in self._quota.items()}
             health = {k: dict(v) for k, v in self._health.items()}
+            trace_aggs = {
+                k: {"events": v[0], "total_s": v[1] / 1e9}
+                for k, v in self._traces.items()
+                if trace_id is None or k == trace_id
+            }
             counters = dict(self._counters)
             gauges = {k: dict(v) for k, v in self._gauges.items()}
             n_rec = self._n_recorded
@@ -1243,6 +1482,11 @@ class Tracer:
             # stage (device vs host sort) and the execution mode off
             # them, from either artifact kind
             "gauges": gauges,
+            # per-trace aggregates (filtered when the export is):
+            # a per-job export states how much recorded work its trace
+            # has IN TOTAL, so a consumer can tell a complete export
+            # from one whose events aged out of the ring
+            "traces": trace_aggs,
             "events_recorded": n_rec,
             "events_evicted": n_rec - n_ret,
         }
@@ -1427,9 +1671,14 @@ def merge_snapshots(snaps: list) -> dict:
     min/max total wall across hosts — the Spark-listener per-executor
     skew view.  Histograms merge across hosts too (fixed global bucket
     edges make the merge a plain bucket sum, so host order is
-    irrelevant) into combined p50/p90/p99 under ``histograms``."""
+    irrelevant) into combined p50/p90/p99 under ``histograms``.  The
+    per-trace aggregates merge the same way (plain event/second sums
+    per trace_id — a job whose windows executed on several hosts reads
+    as one combined row), associatively, so gathering host snapshots
+    in any grouping yields the same ``traces`` section."""
     skew = {}
     hists: dict = {}
+    traces: dict = {}
     for snap in snaps:
         for name, e in snap.get("spans", {}).items():
             sk = skew.setdefault(
@@ -1439,11 +1688,16 @@ def merge_snapshots(snaps: list) -> dict:
             sk["max_s"] = max(sk["max_s"], e["total_s"])
         for name, h in snap.get("histograms", {}).items():
             hists[name] = merge_histograms(hists.get(name, {}), h)
+        for tid, t in snap.get("traces", {}).items():
+            agg = traces.setdefault(tid, {"events": 0, "total_s": 0.0})
+            agg["events"] += t.get("events", 0)
+            agg["total_s"] += t.get("total_s", 0.0)
     return {
         "n_hosts": len(snaps),
         "hosts": snaps,
         "span_skew": skew,
         "histograms": hists,
+        "traces": traces,
     }
 
 
@@ -1455,10 +1709,13 @@ def merge_snapshots(snaps: list) -> dict:
 #: ``partitioner`` execution-mode field; /4 appended the cross-job
 #: batching fields (``batch_fill`` + ``batched_jobs``); /5 appended
 #: ``device_health`` (the per-device scoreboard states,
-#: utils/health.py) — each older version's fields are a strict prefix
-#: of the next, so a consumer keying on field NAMES keeps working;
-#: ``adam-tpu top`` accepts all five.
-HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/5"
+#: utils/health.py); /6 appended the trace/incident activity fields
+#: (``active_traces``, ``metrics_scrapes``, ``last_incident``,
+#: ``last_incident_age_s`` — utils/incidents.py) — each older
+#: version's fields are a strict prefix of the next, so a consumer
+#: keying on field NAMES keeps working; ``adam-tpu top`` accepts all
+#: six.
+HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/6"
 
 #: THE heartbeat line field set — a stable contract (documented in
 #: docs/OBSERVABILITY.md, lint-enforced by scripts/check-telemetry-names):
@@ -1501,8 +1758,17 @@ HEARTBEAT_FIELDS = (
     # /5: the device-health scoreboard's per-device states
     # ({device key: healthy|suspect|probation|evicted} from
     # utils/health.BOARD; null while no device has ever been tracked).
-    # Appended LAST so the /4 fields stay a strict prefix.
     "device_health",
+    # /6: trace/incident activity (utils/incidents.py) — the count of
+    # currently-active job traces, the count of gateway /metrics
+    # scrapes served so far (a scraper-is-actually-reaching-us
+    # signal for `adam-tpu top`), and the id + age of the newest
+    # incident bundle recorded by THIS process (both null until one
+    # fires).  Appended LAST so the /5 fields stay a strict prefix.
+    "active_traces",
+    "metrics_scrapes",
+    "last_incident",
+    "last_incident_age_s",
 )
 
 def _health_states_for_heartbeat():
@@ -1516,6 +1782,23 @@ def _health_states_for_heartbeat():
         return states or None
     except Exception:
         return None
+
+
+def _incident_for_heartbeat():
+    """The /6 ``last_incident`` + ``last_incident_age_s`` fields: the
+    newest bundle this process recorded, as ``(id, age_s)`` — both
+    None until one fires (lazy import — incidents.py imports this
+    module at its top)."""
+    try:
+        from adam_tpu.utils import incidents as incidents_mod
+
+        last = incidents_mod.last_incident()
+        if not last:
+            return None, None
+        age = time.monotonic() - last["ts_monotonic"]
+        return last["id"], round(max(0.0, age), 1)
+    except Exception:
+        return None, None
 
 
 _DEFAULT_HEARTBEAT_INTERVAL_S = 2.0
@@ -1881,6 +2164,14 @@ class Heartbeat:
             "batched_jobs": gauges.get(G_BATCH_JOBS, {}).get("last"),
             "device_health": _health_states_for_heartbeat(),
         }
+        # trace/incident activity (/6): live registry + the newest
+        # bundle recorded by this process (both process-wide, like the
+        # health scoreboard)
+        inc_id, inc_age = _incident_for_heartbeat()
+        line["active_traces"] = len(active_traces())
+        line["metrics_scrapes"] = counters.get(C_GW_SCRAPES, 0)
+        line["last_incident"] = inc_id
+        line["last_incident_age_s"] = inc_age
         if self._provider is not None:
             try:
                 for k, v in (self._provider() or {}).items():

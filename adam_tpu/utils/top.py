@@ -43,7 +43,7 @@ from adam_tpu.utils.telemetry import format_bytes as _fmt_bytes
 #: fields render as "-"; unknown future fields are ignored).
 ACCEPTED_SCHEMAS = (
     "adam_tpu.heartbeat/1", "adam_tpu.heartbeat/2", "adam_tpu.heartbeat/3",
-    "adam_tpu.heartbeat/4", "adam_tpu.heartbeat/5",
+    "adam_tpu.heartbeat/4", "adam_tpu.heartbeat/5", "adam_tpu.heartbeat/6",
 )
 
 _CLEAR = "\x1b[H\x1b[2J"
@@ -160,6 +160,19 @@ def render_frame(line: dict, source: str = "") -> str:
             )
         else:
             out.append(f"health   all {len(dh)} device(s) healthy")
+    if "active_traces" in line or line.get("last_incident"):
+        # observability cell (/6): live trace count, /metrics scrape
+        # activity, and the newest incident bundle with its age
+        li = line.get("last_incident")
+        out.append(
+            f"observe  traces {line.get('active_traces', 0)}"
+            f"   scrapes {line.get('metrics_scrapes', 0)}"
+            + (
+                f"   incident {li}"
+                f" ({_fmt_s(line.get('last_incident_age_s'))} ago)"
+                if li else "   incidents none"
+            )
+        )
     out.append(
         f"events   retries {line.get('retries', 0)}"
         f"   faults {line.get('faults', 0)}"
@@ -328,6 +341,17 @@ def render_multi_frame(jobs: dict, root: str = "",
                 if fill is not None else ""
             )
         )
+        if "active_traces" in pool or pool.get("last_incident"):
+            li = pool.get("last_incident")
+            rows.append(
+                f"observe  traces {pool.get('active_traces', 0)}   "
+                f"scrapes {pool.get('metrics_scrapes', 0)}"
+                + (
+                    f"   incident {li}"
+                    f" ({_fmt_s(pool.get('last_incident_age_s'))} ago)"
+                    if li else "   incidents none"
+                )
+            )
     if jobs and all(j.get("done") for j in jobs.values()):
         rows.append(
             "all jobs finished" if not tot["failed"] else

@@ -503,7 +503,7 @@ def test_heartbeat_batch_fields():
     hb = tele.Heartbeat([tr], sink="stderr", interval_s=60.0)
     line = hb.sample()
     assert tuple(line.keys()) == tele.HEARTBEAT_FIELDS
-    assert line["schema"] == "adam_tpu.heartbeat/5"
+    assert line["schema"] == "adam_tpu.heartbeat/6"
     assert line["batch_fill"] == 0.75
     assert line["batched_jobs"] == 3
     # no batching counters -> explicit nulls, never fabricated zeros
@@ -566,3 +566,50 @@ def test_analyzer_batching_section():
     solo = analyzer.analyze(tele.Tracer(recording=True).to_json())
     assert solo["batching"] == {}
     assert "Batching" not in analyzer.render_report(solo)
+
+
+def test_fused_dispatch_fanin_links_resolve_per_job(device_backend,
+                                                    batch_input):
+    """The fused dispatch claims NO single trace: its span links every
+    contributing job's {job, window, trace}, and each job's trace
+    query resolves the SHARED span through its own link — the fan-in
+    edge the /trace surface crosses the batch boundary on."""
+    from adam_tpu.io import sam as sam_io
+
+    tid1, tid2 = tele.mint_trace_id(), tele.mint_trace_id()
+    it = sam_io.iter_sam_batches(batch_input["input"], batch_reads=512)
+    b1 = next(it)[0]
+    b2 = next(it)[0]
+    tele.TRACE.recording = True
+    tele.TRACE.reset()  # earlier tests' fused spans must not leak in
+    coal = WindowCoalescer(wait_ms=2000.0)
+    try:
+        c1 = coal.client("j1", trace=tid1)
+        c2 = coal.client("j2", trace=tid2)
+        f1 = c1.submit_markdup(0, b1)
+        f2 = c2.submit_markdup(7, b2)
+        f1.result(timeout=120)
+        f2.result(timeout=120)
+        fused = [e for e in tele.TRACE.events()
+                 if e["name"] == tele.SPAN_BATCH_FUSED]
+        assert len(fused) == 1
+        links = fused[0]["args"]["links"]
+        assert sorted(
+            (l["job"], l["window"], l["trace"]) for l in links
+        ) == [("j1", 0, tid1), ("j2", 7, tid2)]
+        # the shared span is IN both traces, via its links...
+        for tid in (tid1, tid2):
+            assert any(
+                e["name"] == tele.SPAN_BATCH_FUSED
+                for e in tele.TRACE.events_for_trace(tid)
+            ), tid
+        # ...and in neither job's export does the OTHER job's link
+        # grant membership to a third trace
+        assert not tele.TRACE.events_for_trace(tele.mint_trace_id())
+        # a deregistered job's trace stops flowing into NEW tickets
+        coal.deregister("j1")
+        assert coal._job_traces.get("j1") is None
+    finally:
+        coal.stop()
+        tele.TRACE.recording = False
+        tele.TRACE.reset()

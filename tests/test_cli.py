@@ -19,10 +19,11 @@ def test_registry_matches_reference():
     observability extensions (``analyze`` — the post-hoc run report —
     and ``top`` — the live heartbeat dashboard), the contract
     tooling (``check`` — the static analyzer, docs/STATIC_ANALYSIS.md)
-    the multi-job service front (``serve`` — adam_tpu/serve) and the
+    the multi-job service front (``serve`` — adam_tpu/serve), the
     HTTP gateway's client verbs (``submit``/``status``/``fetch``/
-    ``cancel`` — adam_tpu/gateway, docs/SERVING.md); none has a
-    reference analog."""
+    ``cancel`` — adam_tpu/gateway, docs/SERVING.md) and the incident
+    recorder's reader (``incidents`` — utils/incidents,
+    docs/OBSERVABILITY.md); none has a reference analog."""
     names = {c.name for _, cmds in command_groups() for c in cmds}
     assert names == {
         "depth", "count_kmers", "count_contig_kmers", "transform",
@@ -32,7 +33,7 @@ def test_registry_matches_reference():
         "features2adam", "wigfix2bed",
         "print", "print_genes", "flagstat", "print_tags", "listdict",
         "allelecount", "buildinfo", "view",
-        "analyze", "top", "check",
+        "analyze", "top", "check", "incidents",
     }
 
 

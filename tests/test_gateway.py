@@ -38,7 +38,7 @@ from adam_tpu.utils import faults
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-HB = "adam_tpu.heartbeat/5"
+HB = "adam_tpu.heartbeat/6"
 
 
 def _parts_hash(d):
@@ -149,6 +149,8 @@ def test_submit_idempotent_and_conflict(gateway, stub_transform):
     c = gateway["client"]
     tmp = gateway["tmp"]
     got = c.submit("j1", _doc(tmp, "j1"))
+    # the reply also echoes the minted trace_id (docs/OBSERVABILITY.md)
+    assert got.pop("trace_id")
     assert got == {"job_id": "j1", "state": "pending"}
     # identical re-PUT (a client retry whose first response was lost):
     # success, carrying the job's current state
@@ -619,6 +621,9 @@ def test_reput_resumes_interrupted_job(gateway, monkeypatch):
     assert gateway["svc"].wait(timeout=30)
     assert c.status("ij")["state"] == "interrupted"
     again = c.submit("ij", doc)  # NOT a duplicate: a resume
+    # the resume KEEPS the original trace — one job, one trace across
+    # attempts
+    assert again.pop("trace_id")
     assert again == {"job_id": "ij", "state": "pending"}
     assert gateway["svc"].wait(timeout=30)
     assert c.status("ij")["state"] == "done"
@@ -827,3 +832,110 @@ def test_serve_listen_sigterm_drain_exit0(tmp_path, gw_input):
     assert _parts_hash(out) == gw_input["baseline"]
     doc = json.load(open(os.path.join(root, "sj", "JOB.json")))
     assert doc["state"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# Observability surfaces: /metrics, /v1/jobs/<id>/trace, /incidents
+# (docs/OBSERVABILITY.md "Gateway observability surfaces")
+# ---------------------------------------------------------------------------
+def _scrape_value(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split(" ", 1)[1])
+    return None
+
+
+def test_metrics_scrapes_exposition_and_monotonic(gateway,
+                                                  stub_transform):
+    from adam_tpu.utils import telemetry as tele
+
+    c = gateway["client"]
+    first = c.metrics()
+    second = c.metrics()
+    for text in (first, second):
+        assert text.endswith("\n")
+        # every non-comment sample line is name[{labels}] value, the
+        # name valid per the exposition grammar
+        for line in text.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            assert tele.prometheus_name_valid(name), line
+        assert "# TYPE adam_tpu_gateway_metrics_scrapes counter" in text
+        assert "adam_tpu_traces_active" in text
+    # each scrape counts ITSELF before snapshotting, so consecutive
+    # scrapes read strictly increasing adam_tpu_gateway_metrics_scrapes
+    s1 = _scrape_value(first, "adam_tpu_gateway_metrics_scrapes")
+    s2 = _scrape_value(second, "adam_tpu_gateway_metrics_scrapes")
+    assert s1 is not None and s2 is not None and s2 > s1
+    # gateway.requests surfaces too — it counts in the handler's
+    # finally AFTER the response is written, so allow the bump from an
+    # earlier scrape a moment to land
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        v = _scrape_value(c.metrics(), "adam_tpu_gateway_requests")
+        if v is not None and v >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("gateway.requests never surfaced in /metrics")
+
+
+def test_job_trace_endpoint_and_trace_id_echo(gateway, stub_transform):
+    import re
+
+    from adam_tpu.utils import telemetry as tele
+
+    c = gateway["client"]
+    tmp = gateway["tmp"]
+    got = c.submit("tj", _doc(tmp, "tj"))
+    tid = got["trace_id"]
+    assert re.fullmatch(r"[0-9a-f]{16}", tid)
+    # a duplicate-safe re-PUT echoes the SAME trace: one job, one trace
+    again = c.submit("tj", _doc(tmp, "tj"))
+    assert again["duplicate"] is True and again["trace_id"] == tid
+    stub_transform["release"].set()
+    assert gateway["svc"].wait(timeout=30)
+    doc = c.job_trace("tj")
+    assert doc["job_id"] == "tj" and doc["trace_id"] == tid
+    names = {e["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "X"}
+    # the trace spans the job's lifecycle: the gateway submit root and
+    # the scheduler's run umbrella (the stub replaces the streamed leg)
+    assert tele.SPAN_GW_SUBMIT in names
+    assert tele.SPAN_SCHED_JOB in names
+    # every X event in the filtered view belongs to this trace —
+    # stamped or linked, never a stranger
+    for e in doc["traceEvents"]:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        assert args.get("trace") == tid or any(
+            l.get("trace") == tid for l in args.get("links") or []
+        ), e
+    # unknown job: typed 404
+    with pytest.raises(GatewayError) as ei:
+        c.job_trace("nope")
+    assert ei.value.status == 404
+
+
+def test_incidents_endpoint_lists_run_root_bundles(gateway,
+                                                   stub_transform):
+    from adam_tpu.utils import incidents as incidents_mod
+
+    c = gateway["client"]
+    empty = c.incidents()
+    assert empty["schema"] == protocol.INCIDENTS_SCHEMA
+    assert empty["incidents"] == []
+    # the serve ctor armed the recorder on its run root: a trigger
+    # fired anywhere in-process surfaces on the wire
+    assert incidents_mod.incidents_dir() == os.path.join(
+        gateway["root"], incidents_mod.INCIDENTS_DIRNAME
+    )
+    path = incidents_mod.maybe_record(
+        "hedge.fired", reason="wire-visibility probe"
+    )
+    assert path is not None
+    rows = c.incidents()["incidents"]
+    assert [r["trigger"] for r in rows] == ["hedge.fired"]
+    assert rows[0]["reason"] == "wire-visibility probe"

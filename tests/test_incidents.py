@@ -1,0 +1,257 @@
+"""Anomaly-triggered incident bundles (utils/incidents.py,
+docs/OBSERVABILITY.md "Incident bundles").
+
+The contract under test: armed on a run dir, a trigger snapshots the
+flight-recorder tail + metrics + health board + the triggering trace
+into ONE self-contained, atomically-written JSON bundle; recording is
+cooldown-limited per trigger, bounded in count, best-effort (never
+raises into the run), and disarmed costs one predicate.  Bundles list
+via `adam-tpu incidents`, fold into `adam-tpu analyze` reports, and
+feed the heartbeat's last_incident fields.
+"""
+
+import json
+import os
+
+import pytest
+
+from adam_tpu.utils import incidents
+from adam_tpu.utils import telemetry as tele
+
+TID = "ab" * 8
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh recorder state per test; cooldown off unless a test opts
+    back in."""
+    incidents._reset_for_tests()
+    monkeypatch.setenv("ADAM_TPU_INCIDENT_COOLDOWN_S", "0")
+    yield
+    incidents._reset_for_tests()
+
+
+def _traced_tracer():
+    """A recording tracer carrying the spans an audit bundle must
+    embed: dispatch, fetch, audit-check on the implicated window."""
+    tr = tele.Tracer(recording=True)
+    tr.set_trace(TID)
+    with tr.span(tele.SPAN_APPLY_DISPATCH, window=12, device=0):
+        pass
+    with tr.span(tele.SPAN_APPLY_FETCH, window=12, device=0):
+        pass
+    with tr.span(tele.SPAN_AUDIT_CHECK, window=12, device=0):
+        pass
+    return tr
+
+
+def test_disarmed_records_nothing(tmp_path):
+    assert not incidents.installed()
+    assert incidents.maybe_record("hedge.fired", reason="x") is None
+    assert incidents.last_incident() is None
+    assert list((tmp_path).iterdir()) == []
+
+
+def test_bundle_contents_and_listing(tmp_path):
+    incidents.install(str(tmp_path))
+    tr = _traced_tracer()
+    path = incidents.maybe_record(
+        "audit.mismatch", device="0", window=12, tracer=tr,
+        reason="SDC dual-compute mismatch on window 12",
+    )
+    assert path and os.path.isfile(path)
+    doc = json.load(open(path))
+    assert doc["schema"] == incidents.INCIDENT_SCHEMA
+    assert doc["trigger"] == "audit.mismatch"
+    assert doc["device"] == "0" and doc["window"] == 12
+    assert doc["trace_id"] == TID  # defaulted from the tracer
+    assert doc["events"] and doc["events_dropped"] == 0
+    assert doc["metrics"]["events_recorded"] >= 3
+    # the embedded trace is the /trace-shaped view of the implicated
+    # window: dispatch + fetch + audit spans present (the chaos-run
+    # acceptance criterion reads exactly these)
+    names = {e["name"] for e in doc["trace"]["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {tele.SPAN_APPLY_DISPATCH, tele.SPAN_APPLY_FETCH,
+            tele.SPAN_AUDIT_CHECK} <= names
+    # listing: run dir and the incidents dir itself both resolve
+    for probe in (str(tmp_path), os.path.join(str(tmp_path),
+                                              "incidents")):
+        rows = incidents.list_bundles(probe)
+        assert [r["trigger"] for r in rows] == ["audit.mismatch"]
+        assert rows[0]["trace_id"] == TID and rows[0]["window"] == 12
+    last = incidents.last_incident()
+    assert last["id"] == doc["id"] and last["trigger"] == "audit.mismatch"
+
+
+def test_cooldown_limits_per_trigger(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAM_TPU_INCIDENT_COOLDOWN_S", "3600")
+    incidents.install(str(tmp_path))
+    assert incidents.maybe_record("hedge.fired", reason="a")
+    assert incidents.maybe_record("hedge.fired", reason="b") is None
+    # a DIFFERENT trigger has its own cooldown clock
+    assert incidents.maybe_record("health.transition", reason="c")
+    assert len(incidents.list_bundles(str(tmp_path))) == 2
+
+
+def test_master_toggle_disables(tmp_path, monkeypatch):
+    incidents.install(str(tmp_path))
+    monkeypatch.setenv("ADAM_TPU_INCIDENTS", "0")
+    assert incidents.maybe_record("hedge.fired", reason="x") is None
+    monkeypatch.setenv("ADAM_TPU_INCIDENTS", "1")
+    assert incidents.maybe_record("hedge.fired", reason="x")
+
+
+def test_bundle_count_is_bounded(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAM_TPU_INCIDENT_MAX", "3")
+    incidents.install(str(tmp_path))
+    paths = [incidents.maybe_record("hedge.fired", reason=str(i))
+             for i in range(6)]
+    assert all(paths)
+    rows = incidents.list_bundles(str(tmp_path))
+    assert len(rows) == 3
+    # oldest pruned first: the survivors are the NEWEST three
+    assert [r["path"] for r in rows] == sorted(paths)[-3:]
+
+
+def test_event_cap_keeps_newest_tail(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAM_TPU_INCIDENT_EVENTS", "4")
+    incidents.install(str(tmp_path))
+    tr = tele.Tracer(recording=True)
+    for i in range(10):
+        with tr.span(tele.SPAN_TOKENIZE, window=i):
+            pass
+    doc = json.load(open(incidents.maybe_record(
+        "hedge.fired", tracer=tr, reason="x")))
+    assert len(doc["events"]) == 4
+    assert doc["events_dropped"] == 6
+    assert [e["args"]["window"] for e in doc["events"]] == [6, 7, 8, 9]
+
+
+def test_quota_burst_detector(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAM_TPU_INCIDENT_QUOTA_BURST", "3")
+    monkeypatch.setenv("ADAM_TPU_INCIDENT_QUOTA_WINDOW_S", "60")
+    incidents.install(str(tmp_path))
+    incidents.note_quota_rejected("acme")
+    incidents.note_quota_rejected("acme")
+    assert incidents.list_bundles(str(tmp_path)) == []
+    incidents.note_quota_rejected("globex")
+    rows = incidents.list_bundles(str(tmp_path))
+    assert [r["trigger"] for r in rows] == ["quota.burst"]
+    assert "acme" in rows[0]["reason"] and "globex" in rows[0]["reason"]
+    # the window drained on fire: the next rejection starts fresh
+    incidents.note_quota_rejected("acme")
+    assert len(incidents.list_bundles(str(tmp_path))) == 1
+
+
+def test_recording_is_best_effort(tmp_path, monkeypatch):
+    """A broken bundle write is logged and swallowed — never raised
+    into the triggering run."""
+    incidents.install(str(tmp_path))
+
+    def boom(*a, **kw):
+        raise OSError("disk on fire")
+
+    import adam_tpu.utils.durability as dur
+
+    monkeypatch.setattr(dur, "atomic_write_json", boom)
+    assert incidents.maybe_record("hedge.fired", reason="x") is None
+
+
+def test_listing_skips_malformed_and_foreign(tmp_path):
+    incidents.install(str(tmp_path))
+    incidents.maybe_record("hedge.fired", reason="good")
+    d = incidents.incidents_dir()
+    with open(os.path.join(d, "inc-0-0000-torn.json"), "w") as fh:
+        fh.write("{not json")
+    with open(os.path.join(d, "inc-0-0001-alien.json"), "w") as fh:
+        json.dump({"schema": "other/9"}, fh)
+    rows = incidents.list_bundles(str(tmp_path))
+    assert [r["trigger"] for r in rows] == ["hedge.fired"]
+
+
+def test_retry_exhausted_trigger_fires(tmp_path):
+    """A genuinely spent retry budget records a retry.exhausted bundle
+    (utils/retry.retry_call's hook); a permanent failure on attempt 1
+    never consumed the budget, so it records nothing."""
+    from adam_tpu.utils.retry import (PermanentFault, RetryPolicy,
+                                      TransientFault, retry_call)
+
+    incidents.install(str(tmp_path))
+    policy = RetryPolicy(attempts=2, backoff_s=0.0)
+
+    def permanent():
+        raise PermanentFault("not an incident")
+
+    with pytest.raises(PermanentFault):
+        retry_call(permanent, site="test.perm", policy=policy)
+    assert incidents.list_bundles(str(tmp_path)) == []
+
+    def always_transient():
+        raise TransientFault("injected")
+
+    with pytest.raises(TransientFault):
+        retry_call(always_transient, site="test.spent", policy=policy)
+    rows = incidents.list_bundles(str(tmp_path))
+    assert [r["trigger"] for r in rows] == ["retry.exhausted"]
+    assert "test.spent" in rows[0]["reason"]
+
+
+def test_health_transition_trigger_fires(tmp_path):
+    """A health-board demotion staged under the board lock fires its
+    bundle AFTER release — and the bundle embeds the board snapshot
+    (the deadlock this ordering exists to avoid)."""
+    from adam_tpu.utils import health
+
+    incidents.install(str(tmp_path))
+    board = health.BOARD  # the global: the bundle snapshots it too
+    tr = tele.Tracer(recording=True)
+    try:
+        for _ in range(8):  # enough retry weight to cross suspect
+            board.note_retry(0, site="test", tracer=tr)
+        rows = incidents.list_bundles(str(tmp_path))
+        assert [r["trigger"] for r in rows] == ["health.transition"]
+        doc = json.load(open(rows[0]["path"]))
+        assert "suspect" in doc["reason"]
+        assert doc["health"], "bundle missing the board snapshot"
+    finally:
+        board.reset()
+
+
+def test_cli_incidents_table_and_json(tmp_path, capsys):
+    from adam_tpu.cli.main import main
+
+    incidents.install(str(tmp_path))
+    tr = _traced_tracer()
+    incidents.maybe_record("audit.mismatch", device="0", window=12,
+                           tracer=tr, reason="bitflip")
+    assert main(["incidents", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "TRIGGER" in out and "audit.mismatch" in out
+    assert TID in out and "bitflip" in out
+    assert main(["incidents", str(tmp_path), "-json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == incidents.INCIDENT_SCHEMA + "+list"
+    assert doc["incidents"][0]["window"] == 12
+    # empty dir: clean exit, explicit "none"
+    assert main(["incidents", str(tmp_path / "empty")]) == 0
+    assert "none" in capsys.readouterr().out
+
+
+def test_analyzer_folds_sibling_incidents(tmp_path):
+    """`adam-tpu analyze` on an artifact next to an incidents/ dir
+    renders the Incidents section (trigger, device, window, trace)."""
+    from adam_tpu.utils import analyzer
+
+    incidents.install(str(tmp_path))
+    tr = _traced_tracer()
+    incidents.maybe_record("audit.mismatch", device="0", window=12,
+                           tracer=tr, reason="bitflip caught")
+    art = tmp_path / "m.json"
+    art.write_text(json.dumps(tr.snapshot()))
+    report = analyzer.analyze_path(str(art))
+    assert report["incidents"], "incidents not folded into the report"
+    text = analyzer.render_report(report)
+    assert "Incidents (1 bundle(s))" in text
+    assert "audit.mismatch" in text and "window 12" in text
+    assert TID in text and "bitflip caught" in text

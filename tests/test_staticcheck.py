@@ -668,6 +668,71 @@ def test_telemetry_docs_gaps(tmp_path):
     assert any("undocumented_field" in m for m in msgs)
 
 
+def test_telemetry_prometheus_collision(tmp_path):
+    """Two dotted names that merge under '.' -> '_' mangling — the
+    silent-series-merge the Prometheus leg of the rule exists to
+    catch."""
+    _write(tmp_path, "adam_tpu/utils/telemetry.py", """\
+        _R = set()
+
+        def _metric(name):
+            _R.add(name)
+            return name
+
+        C_A = _metric("sched.batch.fill")
+        C_B = _metric("sched.batch_fill")
+        HEARTBEAT_FIELDS = ("schema",)
+    """)
+    _write(tmp_path, "docs/OBSERVABILITY.md",
+           "`sched.batch.fill` `sched.batch_fill` `schema`\n")
+    rep = _run(tmp_path, ["telemetry-contract"])
+    msgs = [f["message"] for f in _new(rep, "telemetry-contract")]
+    assert any(
+        "collide" in m and "adam_tpu_sched_batch_fill" in m for m in msgs
+    ), msgs
+
+
+def test_telemetry_prometheus_display_names_exempt(tmp_path):
+    """Display-style instrumentation timer names (spaces, parens) sit
+    outside the dotted contract: the renderer sanitizes them, the
+    mangling lint must not flag them."""
+    _write(tmp_path, "adam_tpu/utils/telemetry.py", """\
+        _R = set()
+
+        def _metric(name):
+            _R.add(name)
+            return name
+
+        C_A = _metric("reads.ingested")
+        C_B = _metric("BGZF Codec (native)")
+        HEARTBEAT_FIELDS = ("schema",)
+    """)
+    _write(tmp_path, "docs/OBSERVABILITY.md",
+           "`reads.ingested` `schema`\n")
+    rep = _run(tmp_path, ["telemetry-contract"])
+    assert _new(rep, "telemetry-contract") == []
+
+
+def test_telemetry_rule_literals_pin_registry():
+    """The rule keeps its own PROMETHEUS_PREFIX / validity-regex
+    literals (so it lints foreign trees without importing them) — pin
+    them against the registry's, and pin the regex against
+    telemetry.prometheus_name_valid on both sides of the grammar."""
+    from adam_tpu.staticcheck.rules import telemetry_names as rule_mod
+    from adam_tpu.utils import telemetry as tele
+
+    assert rule_mod.PROMETHEUS_PREFIX == tele.PROMETHEUS_PREFIX
+    for probe, ok in (
+        ("adam_tpu_reads_ingested", True),
+        ("adam_tpu_x:y", True),
+        ("9leading_digit", False),
+        ("adam_tpu_bad name", False),
+        ("adam_tpu_bad-name", False),
+    ):
+        assert bool(rule_mod._PROM_NAME_RE.fullmatch(probe)) == ok == \
+            tele.prometheus_name_valid(probe), probe
+
+
 # -------------------------------------------------------------------------
 # the clean-repo gate + CLI
 # -------------------------------------------------------------------------

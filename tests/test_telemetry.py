@@ -768,7 +768,7 @@ def test_heartbeat_v2_carries_tunnel_and_hbm_fields(tmp_path):
     hb.start()
     hb.stop()
     lines = [json.loads(l) for l in open(str(tmp_path / "hb.ndjson"))]
-    assert lines[-1]["schema"] == "adam_tpu.heartbeat/5"
+    assert lines[-1]["schema"] == "adam_tpu.heartbeat/6"
     assert lines[-1]["h2d_bytes"] == 12345
     assert lines[-1]["d2h_bytes"] == 54321
     assert lines[-1]["hbm_bytes_in_use"] == {}
@@ -815,3 +815,238 @@ def test_heartbeat_rotation_caps_file_size(tmp_path, monkeypatch):
     assert tele.progress_max_bytes() == 64 * 1024 * 1024
     monkeypatch.setenv("ADAM_TPU_PROGRESS_MAX_BYTES", "0")
     assert tele.progress_max_bytes() == 0
+
+
+# --------------------------------------------------------------------------
+# trace context: job-scoped distributed traces (docs/OBSERVABILITY.md)
+# --------------------------------------------------------------------------
+def test_mint_trace_id_shape_and_uniqueness():
+    ids = {tele.mint_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    for tid in ids:
+        assert re.fullmatch(r"[0-9a-f]{16}", tid), tid
+
+
+def test_trace_attribution_precedence():
+    """Explicit span attr > thread-local trace_scope > tracer default;
+    trace_scope(None) is a no-op frame that does NOT shadow an outer
+    scope."""
+    tr = tele.Tracer(recording=True)
+    tr.set_trace("d" * 16)
+    with tr.span(tele.SPAN_TOKENIZE, window=0):
+        pass
+    with tele.trace_scope("5" * 16):
+        with tr.span(tele.SPAN_TOKENIZE, window=1):
+            pass
+        with tele.trace_scope(None):  # no-op frame
+            with tr.span(tele.SPAN_TOKENIZE, window=2):
+                pass
+        with tr.span(tele.SPAN_TOKENIZE, window=3, trace="e" * 16):
+            pass
+    by_window = {e["args"]["window"]: e.get("trace")
+                 for e in tr.events() if e.get("name") != "process_name"}
+    assert by_window == {
+        0: "d" * 16, 1: "5" * 16, 2: "5" * 16, 3: "e" * 16,
+    }
+    assert tele.current_trace() is None  # scopes unwound
+
+
+def test_event_in_trace_matches_stamp_and_fanin_links():
+    tid_a, tid_b = "a" * 16, "b" * 16
+    tr = tele.Tracer(recording=True)
+    with tr.span(tele.SPAN_APPLY_DISPATCH, window=0, trace=tid_a):
+        pass
+    # the fused cross-job dispatch claims NO single trace; it links
+    # every contributing job's {job, window, trace} instead
+    with tr.span(tele.SPAN_BATCH_FUSED, kind="markdup", links=[
+        {"job": "j1", "window": 0, "trace": tid_a},
+        {"job": "j2", "window": 3, "trace": tid_b},
+    ]):
+        pass
+    ev_a = tr.events_for_trace(tid_a)
+    ev_b = tr.events_for_trace(tid_b)
+    names_a = {e["name"] for e in ev_a}
+    assert tele.SPAN_APPLY_DISPATCH in names_a
+    assert tele.SPAN_BATCH_FUSED in names_a
+    # job B sees the SHARED fused span but not job A's private span
+    assert {e["name"] for e in ev_b if e["name"] != "process_name"} \
+        == {tele.SPAN_BATCH_FUSED}
+    assert not tr.events_for_trace("c" * 16)
+
+
+def test_per_trace_aggregates_survive_ring_eviction():
+    tid = "f" * 16
+    tr = tele.Tracer(recording=True, capacity=8)
+    for i in range(64):
+        with tr.span(tele.SPAN_TOKENIZE, window=i, trace=tid):
+            pass
+    assert len(tr.events_for_trace(tid)) <= 8  # ring evicted most
+    agg = tr.snapshot()["traces"][tid]
+    assert agg["events"] == 64  # ...but the ledger kept counting
+    assert agg["total_s"] >= 0.0
+
+
+def test_chrome_trace_export_filters_to_one_trace():
+    tid_a, tid_b = "a" * 16, "b" * 16
+    tr = tele.Tracer(recording=True)
+    with tr.span(tele.SPAN_APPLY_DISPATCH, window=0, trace=tid_a):
+        pass
+    with tr.span(tele.SPAN_APPLY_FETCH, window=9, trace=tid_b):
+        pass
+    with tr.span(tele.SPAN_BATCH_FUSED, links=[
+        {"job": "j1", "window": 0, "trace": tid_a},
+    ]):
+        pass
+    doc = tr.to_chrome_trace(tid_a)
+    names = {e["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "X"}
+    assert tele.SPAN_APPLY_DISPATCH in names
+    assert tele.SPAN_BATCH_FUSED in names
+    assert tele.SPAN_APPLY_FETCH not in names  # job B's private span
+    # the per-trace ledger is filtered with the export
+    assert set(doc["traces"]) == {tid_a}
+    # the unfiltered export carries both jobs' aggregates
+    assert set(tr.to_chrome_trace()["traces"]) == {tid_a, tid_b}
+
+
+def test_absorb_carries_per_trace_aggregates():
+    """A job-scoped run tracer folds into the global tracer without
+    losing its trace ledger (the /trace surface reads the global)."""
+    tid = "1" * 16
+    run = tele.Tracer(recording=True)
+    run.set_trace(tid)
+    with run.span(tele.SPAN_TOKENIZE, window=0):
+        pass
+    glob = tele.Tracer(recording=True)
+    glob.absorb(run)
+    assert glob.snapshot()["traces"][tid]["events"] == 1
+    assert len(glob.events_for_trace(tid)) == 1
+
+
+def test_merge_snapshots_traces_associative():
+    """Per-trace aggregates are plain sums: any grouping of host
+    snapshots yields the same traces section (the satellite's
+    associativity requirement)."""
+    def host(tid_events):
+        tr = tele.Tracer(recording=True)
+        for tid, n in tid_events:
+            for i in range(n):
+                with tr.span(tele.SPAN_TOKENIZE, window=i, trace=tid):
+                    pass
+        return tr.snapshot()
+
+    a = host([("a" * 16, 2)])
+    b = host([("a" * 16, 3), ("b" * 16, 1)])
+    c = host([("b" * 16, 5)])
+    flat = tele.merge_snapshots([a, b, c])["traces"]
+    left = tele.merge_snapshots(
+        [tele.merge_snapshots([a, b]), c])["traces"]
+    right = tele.merge_snapshots(
+        [a, tele.merge_snapshots([b, c])])["traces"]
+    for merged in (left, right):
+        assert set(merged) == set(flat)
+        for tid in flat:
+            assert merged[tid]["events"] == flat[tid]["events"]
+            assert merged[tid]["total_s"] == \
+                pytest.approx(flat[tid]["total_s"])
+    assert flat["a" * 16]["events"] == 5
+    assert flat["b" * 16]["events"] == 6
+
+
+def test_active_trace_registry_is_refcounted():
+    tid = "9" * 16
+    assert tid not in tele.active_traces()
+    tele.activate_trace(tid)
+    tele.activate_trace(tid)  # re-entrant (recovery re-runs)
+    assert tid in tele.active_traces()
+    tele.deactivate_trace(tid)
+    assert tid in tele.active_traces()
+    tele.deactivate_trace(tid)
+    assert tid not in tele.active_traces()
+    tele.activate_trace(None)  # no-op, never raises
+    tele.deactivate_trace(None)
+
+
+def test_prometheus_exposition_from_snapshot():
+    """gateway/metrics.render_prometheus: valid exposition text off a
+    live snapshot — counters, gauges, cumulative histogram buckets,
+    and the trace gauges; every series name valid per the grammar."""
+    from adam_tpu.gateway import metrics as gw_metrics
+
+    tr = tele.Tracer(recording=True)
+    tr.count(tele.C_READS_INGESTED, 7)
+    tr.gauge(tele.G_POOL_DEPTH, 3)
+    for v in (0.001, 0.01, 0.1):
+        tr.observe(tele.H_FETCH_SECONDS, v)
+    text = gw_metrics.render_prometheus(tr.snapshot())
+    assert text.endswith("\n")
+    assert "adam_tpu_reads_ingested 7" in text
+    assert "adam_tpu_parquet_pool_queue_depth 3" in text
+    assert "adam_tpu_device_fetch_seconds_count 3" in text
+    assert 'le="+Inf"' in text
+    assert "adam_tpu_traces_active" in text
+    # grammar: every sample line's metric name is valid; buckets are
+    # cumulative (non-decreasing per series)
+    bucket_acc = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        assert tele.prometheus_name_valid(name), line
+        if "_bucket" in line:
+            v = float(line.rsplit(" ", 1)[1])
+            assert v >= bucket_acc.get(name, 0.0), line
+            bucket_acc[name] = v
+    # HELP/TYPE precede every series
+    assert text.index("# TYPE adam_tpu_reads_ingested counter") \
+        < text.index("adam_tpu_reads_ingested 7")
+
+
+def test_prometheus_exposition_sanitizes_display_timer_names():
+    """The 8 legacy display-style timer names ('BGZF Codec (native)')
+    can reach a snapshot via span-duration auto-histograms; the
+    renderer sanitizes them rather than emitting invalid series."""
+    from adam_tpu.gateway import metrics as gw_metrics
+
+    snap = {"counters": {}, "gauges": {},
+            "histograms": {"BGZF Codec (native)": {
+                "count": 1, "sum": 0.5, "min": 0.5, "max": 0.5,
+                "buckets": {"0": 1},
+            }}}
+    text = gw_metrics.render_prometheus(snap)
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        assert tele.prometheus_name_valid(name), line
+
+
+def test_heartbeat_v6_trace_and_incident_fields(tmp_path):
+    """/6 appends active_traces / metrics_scrapes / last_incident /
+    last_incident_age_s — populated from the live registries."""
+    from adam_tpu.utils import incidents
+
+    tid = "c" * 16
+    tr = tele.Tracer(recording=True)
+    tr.count(tele.C_GW_SCRAPES, 4)
+    incidents.install(str(tmp_path))
+    tele.activate_trace(tid)
+    try:
+        incidents.maybe_record("hedge.fired", tracer=tr,
+                               reason="test bundle")
+        p = str(tmp_path / "hb.ndjson")
+        hb = tele.Heartbeat([tr], sink=p, interval_s=60.0)
+        hb.set_devices([])
+        hb.start()
+        hb.stop()
+        lines = [json.loads(raw) for raw in open(p)]
+    finally:
+        tele.deactivate_trace(tid)
+        incidents.uninstall()
+    line = lines[-1]
+    assert line["schema"] == "adam_tpu.heartbeat/6"
+    assert list(line) == list(tele.HEARTBEAT_FIELDS)
+    assert line["active_traces"] >= 1
+    assert line["metrics_scrapes"] == 4
+    assert line["last_incident"].startswith("inc-")
+    assert line["last_incident_age_s"] >= 0.0
