@@ -206,6 +206,15 @@ class Transform(Command):
                        help="local path to dump BQSR observations to (CSV)")
         p.add_argument("-known_snps", default=None,
                        help="sites-only VCF giving location of known SNPs")
+        p.add_argument(
+            "-known_recalibration_table", default=None,
+            help="npz with 'table' (u8[n_rg, qual, cycle, dinuc]) and "
+            "'gl' — apply this pre-solved recalibration table instead "
+            "of solving one at barrier 2 (the known-sites workflow; a "
+            "previous run's --run-dir table sidecar is directly "
+            "reusable).  Arms the fused B→C megakernel tier "
+            "(docs/PERF.md); -streaming only",
+        )
         p.add_argument("-realign_indels", action="store_true")
         p.add_argument("-known_indels", default=None,
                        help="VCF of known INDELs; without it the consensus-from-reads model is used")
@@ -450,6 +459,15 @@ class Transform(Command):
                         print(f"transform: cannot write --report "
                               f"{args.report}: {e}", file=sys.stderr)
                         return 2
+                known_tbl = None
+                if getattr(args, "known_recalibration_table", None):
+                    import numpy as _np
+
+                    with _np.load(args.known_recalibration_table) as z:
+                        known_tbl = (
+                            _np.asarray(z["table"], _np.uint8),
+                            int(z["gl"]),
+                        )
                 transform_streamed(
                     args.input, args.output,
                     window_reads=args.window_reads,
@@ -457,7 +475,8 @@ class Transform(Command):
                     partitioner=getattr(args, "partitioner", None),
                     progress=getattr(args, "progress", None),
                     run_dir=getattr(args, "run_dir", None),
-                    resume=bool(getattr(args, "resume", False)), **kw,
+                    resume=bool(getattr(args, "resume", False)),
+                    known_table=known_tbl, **kw,
                 )
                 if getattr(args, "report", None):
                     # the analyzer view of THIS run: trace-grade (gap

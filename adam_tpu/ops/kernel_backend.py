@@ -1,0 +1,112 @@
+"""Kernel backend selection — the ``ADAM_TPU_KERNEL_BACKEND`` knob.
+
+PR 18 adds Pallas ports of the two memory-bound inner loops (the
+observe scatter-add and the row-prefix pack scatter).  Both live behind
+this selector: ``xla`` (the default) keeps the original ``.at[].add``/
+``.at[].set`` bodies — the bit-parity reference — while ``pallas``
+swaps in the hand-written TPU kernels at *trace* time.  The switch is
+read inside the traceable bodies, so every jit cache that can hold a
+traced body must key on :func:`kernel_backend` (``bqsr.jit_variant``,
+the mesh jit registry, the compile ledger and the prewarm dedupe all
+do — see the PR 18 compile-ledger key fix).
+
+Resolution precedence follows the repo's tuning-var contract
+(``utils/retry``-style warn-and-default):
+
+* an explicit ``override`` argument wins and must be valid — a typo in
+  *code* is a bug, so it raises;
+* else ``ADAM_TPU_KERNEL_BACKEND`` (``xla``/``pallas``; ``auto`` and
+  unset mean ``xla``) — an unrecognized *environment* value warns once
+  and falls back to ``xla`` rather than killing a long run;
+* a :func:`backend_scope` context override (used by the microbench
+  harness and the parity tests) sits between the two: stronger than
+  the environment, weaker than an explicit argument.
+
+Off-TPU (CPU tests, interpret mode) the Pallas kernels run with
+``interpret=True`` so the parity matrix stays hermetic — see
+:func:`pallas_interpret`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import warnings
+
+KERNEL_BACKENDS = ("xla", "pallas")
+
+_ENV_VAR = "ADAM_TPU_KERNEL_BACKEND"
+
+_lock = threading.Lock()
+_warned: set = set()
+
+# backend_scope() override — process-wide, not thread-local, because
+# the device pool's dispatch executors must see the same backend as
+# the submitting thread (a per-thread override would let one window
+# trace pallas while its prewarm traced xla).
+_OVERRIDE: list = []
+
+
+def kernel_backend(override: str | None = None) -> str:
+    """Resolve the active kernel backend (``"xla"`` or ``"pallas"``)."""
+    if override is not None:
+        if override not in KERNEL_BACKENDS:
+            raise ValueError(
+                f"unknown kernel backend {override!r}; expected one of "
+                f"{KERNEL_BACKENDS}"
+            )
+        return override
+    if _OVERRIDE:
+        return _OVERRIDE[-1]
+    raw = os.environ.get(_ENV_VAR, "").strip().lower()
+    if raw in ("", "auto", "xla"):
+        return "xla"
+    if raw in KERNEL_BACKENDS:
+        return raw
+    with _lock:
+        if raw not in _warned:
+            _warned.add(raw)
+            warnings.warn(
+                f"{_ENV_VAR}={raw!r} is not one of {KERNEL_BACKENDS}; "
+                "using 'xla'",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+    return "xla"
+
+
+@contextlib.contextmanager
+def backend_scope(backend: str):
+    """Temporarily force the kernel backend (parity tests, kernelbench).
+
+    Process-wide; nesting stacks.  The traceable bodies read
+    :func:`kernel_backend` at trace time and every jit cache keys on
+    it, so flipping the scope retraces rather than reusing a stale
+    executable."""
+    if backend not in KERNEL_BACKENDS:
+        raise ValueError(
+            f"unknown kernel backend {backend!r}; expected one of "
+            f"{KERNEL_BACKENDS}"
+        )
+    _OVERRIDE.append(backend)
+    try:
+        yield backend
+    finally:
+        _OVERRIDE.pop()
+
+
+def pallas_interpret() -> bool:
+    """True when Pallas must run in interpret mode (no TPU attached).
+
+    CPU test runs (``JAX_PLATFORMS=cpu``) have no Mosaic compiler, so
+    the Pallas kernels execute through the interpreter — bit-parity
+    with the compiled path, just slow.  The kernelbench rows carry
+    ``mode: interpret`` so nobody reads interpreter timings as chip
+    numbers."""
+    try:
+        import jax
+
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover - jax always importable here
+        return True

@@ -1,0 +1,119 @@
+"""Pallas port of the BQSR observe scatter-add.
+
+The observe pass is memory-bound: per residue it reads one i32
+covariate key plus two *bits* (residue-ok / is-mismatch, shipped
+bit-packed by the resident-window dispatch) and bumps two histogram
+counters.  The XLA lowering materializes the unpacked boolean masks
+and runs a generic scatter; the Pallas kernel here instead streams the
+bit-packed masks straight out of HBM — the grid pipeline double-buffers
+each row block's DMA while the previous block accumulates — unpacks
+bits in-register, and accumulates the (total, mism) histogram in VMEM,
+which is revisited across grid steps and only written back once.
+
+Bit-parity contract: given the same i32 keys and masks this produces
+exactly the histograms of ``bqsr.observe_kernel``'s scatter-add (i32
+accumulation, cast to i64 by the caller).  The selector in
+``ops/kernel_backend.py`` keeps XLA the default; off-TPU the kernel
+runs with ``interpret=True`` so the parity tests stay hermetic on CPU.
+
+Keys are precomputed by the caller (``bqsr.observe_packed_body``'s
+pallas branch) because the covariate math — cycles, dinucs, read-group
+fold — is compute-light and fuses fine under XLA; only the
+scatter-add inner loop is worth hand-scheduling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from adam_tpu.ops.kernel_backend import pallas_interpret
+
+
+def _block_rows(n: int) -> int:
+    """Largest row-block size in {8, 4, 2, 1} dividing ``n`` — pallas
+    grid blocks must tile the row axis exactly (the grid quantization
+    in ``formats/batch.grid_rows`` makes 8 the common case)."""
+    for br in (8, 4, 2, 1):
+        if n % br == 0:
+            return br
+    return 1
+
+
+def _hist_block_kernel(keys_ref, res_ref, mm_ref, rdok_ref,
+                       total_ref, mism_ref):
+    """One grid step: accumulate one row block into the VMEM histogram.
+
+    ``total_ref``/``mism_ref`` map the full histogram every step
+    (revisited output block): zeroed at step 0, accumulated across
+    steps, flushed once at the end."""
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        total_ref[...] = jnp.zeros_like(total_ref)
+        mism_ref[...] = jnp.zeros_like(mism_ref)
+
+    br, lmax = keys_ref.shape
+
+    def row_body(r, carry):
+        rd = rdok_ref[r, 0]
+
+        def col_body(j, carry):
+            byte_r = res_ref[r, j // 8].astype(jnp.int32)
+            byte_m = mm_ref[r, j // 8].astype(jnp.int32)
+            shift = 7 - (j % 8)
+            res_bit = (byte_r >> shift) & 1
+            mm_bit = (byte_m >> shift) & 1
+            inc = (res_bit != 0) & (rd != 0)
+            k = keys_ref[r, j]
+
+            @pl.when(inc)
+            def _bump_total():
+                total_ref[k] = total_ref[k] + 1
+
+            @pl.when(inc & (mm_bit != 0))
+            def _bump_mism():
+                mism_ref[k] = mism_ref[k] + 1
+
+            return carry
+
+        return jax.lax.fori_loop(0, lmax, col_body, carry)
+
+    jax.lax.fori_loop(0, br, row_body, 0)
+
+
+def observe_hist_pallas(flat_key, res_bits, mm_bits, read_ok,
+                        size: int):
+    """(total, mism) i32[size] histograms over bit-packed masks.
+
+    ``flat_key``: i32[N, L] fused covariate keys (always in-range —
+    the covariate math bounds every factor; excluded residues are
+    simply never added).  ``res_bits``/``mm_bits``: u8[N, ceil(L/8)]
+    from ``colpack.pack_mask_bits``.  ``read_ok``: bool[N].
+    """
+    n, lmax = flat_key.shape
+    if n == 0 or lmax == 0:
+        z = jnp.zeros(size, jnp.int32)
+        return z, z
+    br = _block_rows(n)
+    lb = res_bits.shape[1]
+    rdok = read_ok.astype(jnp.int32).reshape(n, 1)
+    return pl.pallas_call(
+        _hist_block_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((size,), jnp.int32),
+            jax.ShapeDtypeStruct((size,), jnp.int32),
+        ),
+        grid=(n // br,),
+        in_specs=[
+            pl.BlockSpec((br, lmax), lambda i: (i, 0)),
+            pl.BlockSpec((br, lb), lambda i: (i, 0)),
+            pl.BlockSpec((br, lb), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((size,), lambda i: (0,)),
+            pl.BlockSpec((size,), lambda i: (0,)),
+        ),
+        interpret=pallas_interpret(),
+    )(flat_key, res_bits, mm_bits, rdok)

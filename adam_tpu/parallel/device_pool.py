@@ -595,9 +595,13 @@ class DevicePool:
             # inside the window it rejoins on.  Replayed windows
             # re-prewarm on survivors via the same process-wide cache
             # (already-warm triples dedupe to no-ops).
+            # the dedupe key carries the kernel backend (like the
+            # compile ledger): an XLA-warmed shape says nothing about
+            # the pallas executable of the same shape
+            backend = compile_ledger.active_backend()
             for key, fn in entries:
                 for dev in self.survivors():
-                    cache_key = (key, _device_key(dev))
+                    cache_key = (key, _device_key(dev), backend)
                     if cache_key not in _PREWARMED and cache_key not in claimed:
                         claimed.add(cache_key)
                         todo.append((key, fn, dev, cache_key))
@@ -920,6 +924,7 @@ def apply_dummy_args(b, g: int, gl: int) -> tuple:
 def streamed_prewarm_entries(
     b, n_rg: int, *, mark_duplicates: bool = True, recalibrate: bool = True,
     packed_apply: bool = False, resident: bool = False,
+    fused_n_cyc: int | None = None,
 ) -> list[tuple]:
     """The grid-quantized kernel set the streamed device path dispatches,
     as prewarm entries derived from the first window's numpy view ``b``
@@ -932,7 +937,9 @@ def streamed_prewarm_entries(
     actually dispatch — the bit-packed-mask observe, the fused
     bases+quals pack2 apply, and (where :func:`donation_ok`) the
     donating twins — ALONGSIDE the plain kernels, which stay warm as
-    the replay/fallback path.
+    the replay/fallback path.  ``fused_n_cyc`` (the known table's cycle
+    width) additionally warms the fused B→C megakernel the known-table
+    tier dispatches (docs/PERF.md "Megakernel tier").
     """
     import jax
 
@@ -1000,6 +1007,11 @@ def streamed_prewarm_entries(
         entries.append(_apply_entry(
             b, n_rg, g, gl, 2 * gl + 1, resident=resident
         ))
+        if fused_n_cyc is not None and resident:
+            # the fused B→C megakernel, at the KNOWN table's real
+            # cycle width (never 2*gl+1: the known table's geometry is
+            # the cohort's, not this window's)
+            entries.append(fused_bc_prewarm_entry(b, n_rg, fused_n_cyc))
     return entries
 
 
@@ -1085,6 +1097,59 @@ def observe_packed_prewarm_entry(b, n_rg: int) -> tuple:
             jax.block_until_ready(out)
 
     return (("bqsr.observe_packed", g, gl, n_rg), warm_observe_packed)
+
+
+def fused_bc_dummy_args(b, g: int, gl: int) -> tuple:
+    """fused_bc_body's 10 per-row args at grid (g rows, gl lanes) —
+    the observe signature's resident five + bit-packed masks + read
+    filter, then the apply side's ``has_qual``/``valid``; the u8 table
+    dummy and the statics (n_rg, gl, g*gl) follow at the call site."""
+    base = observe_dummy_args(b, g, gl)
+    npk = gl // 8 + (1 if gl % 8 else 0)
+    return base[:5] + (
+        np.zeros((g, npk), np.uint8), np.zeros((g, npk), np.uint8),
+        base[7],
+        np.zeros((g,), bool), np.zeros((g,), bool),
+    )
+
+
+def fused_bc_prewarm_entry(b, n_rg: int, table_n_cyc: int) -> tuple:
+    """Prewarm entry for the fused B→C megakernel
+    (``bqsr.fused_bc_body``) keyed by the known table's real cycle
+    width — dispatched when the recalibration table is available at
+    ingest (known-sites runs, discovered-table resumes).  Warms the
+    donating twin where :func:`donation_ok` plus the plain twin beside
+    it (a consumed-handle retry re-dispatches without donation)."""
+    import jax
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+
+    g = grid_rows(b.n_rows)
+    gl = grid_cols(b.lmax)
+
+    def warm_fused_bc(dev, g=g, gl=gl):
+        from adam_tpu.pipelines.bqsr import N_DINUC, N_QUAL, jit_variant
+
+        def placed_args():
+            args = fused_bc_dummy_args(b, g, gl) + (
+                np.zeros(
+                    (n_rg, N_QUAL, table_n_cyc, N_DINUC), np.uint8
+                ),
+            )
+            return tuple(jax.device_put(a, dev) for a in args)
+
+        donate = donation_ok(dev)
+        out = jit_variant("fused_bc", donate)(
+            *placed_args(), n_rg, gl, g * gl
+        )
+        jax.block_until_ready(out)
+        if donate:
+            out = jit_variant("fused_bc", False)(
+                *placed_args(), n_rg, gl, g * gl
+            )
+            jax.block_until_ready(out)
+
+    return (("bqsr.fused_bc", g, gl, n_rg, table_n_cyc), warm_fused_bc)
 
 
 def apply_prewarm_entry(b, n_rg: int, table_n_cyc: int,

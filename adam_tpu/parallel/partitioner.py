@@ -449,6 +449,54 @@ def _mesh_markdup_jit_builder():
     return run
 
 
+def _mesh_fused_bc_jit_builder(donate: bool):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from adam_tpu.parallel.mesh import BATCH_AXIS, shard_map
+
+    def run(bases, quals, lengths, flags, rg, res_pk, mm_pk, rd_ok,
+            has_qual, valid, table, n_rg, lmax, mesh):
+        from adam_tpu.pipelines.bqsr import (
+            apply_pack2_body, observe_packed_body,
+        )
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=_mesh_specs(10) + (P(),),
+            out_specs=(P(), P(), P(BATCH_AXIS), P(BATCH_AXIS)),
+            check_vma=False,
+        )
+        def body(b, q, le, fl, r, rp, mp, ok, hq, v, tbl):
+            # the megakernel tier's mesh twin: each shard runs the
+            # bit-packed-mask observe AND the fused apply+pack over its
+            # own row block in ONE collective; histograms psum i64
+            # (order-free), the two flat packed outputs stay
+            # row-sharded in shard order (== row order)
+            total, mism = observe_packed_body(
+                b, q, le, fl, r, rp, mp, ok, n_rg, lmax
+            )
+            pq, pb = apply_pack2_body(
+                b, q, le, fl, r, hq, v, tbl, lmax,
+                b.shape[0] * b.shape[1],
+            )
+            return (
+                jax.lax.psum(total, BATCH_AXIS),
+                jax.lax.psum(mism, BATCH_AXIS),
+                pq, pb,
+            )
+
+        return body(bases, quals, lengths, flags, rg, res_pk, mm_pk,
+                    rd_ok, has_qual, valid, table)
+
+    kw = {"static_argnames": ("n_rg", "lmax", "mesh")}
+    if donate:
+        # same aliases as the separate passes: resident bases/quals
+        # become the packed columns, the bit-packed masks are dead
+        # after the in-kernel unpack
+        kw["donate_argnums"] = (0, 1, 5, 6)
+    return partial(jax.jit, **kw)(run)
+
+
 _MESH_JITS: dict = {}
 _MESH_JITS_LOCK = threading.Lock()
 
@@ -456,8 +504,13 @@ _MESH_JITS_LOCK = threading.Lock()
 def _mesh_jit(kind: str, donate: bool = False):
     """Lazily-built module-level mesh jits (one executable cache each,
     shared by prewarm and dispatch — the device_pool get_columns_jit
-    discipline)."""
-    key = (kind, donate)
+    discipline).  Keyed by the kernel backend alongside (kind, donate):
+    the shard bodies branch Pallas/XLA at trace time
+    (``ops/kernel_backend``), so a backend flip must reach a fresh
+    jit."""
+    from adam_tpu.ops.kernel_backend import kernel_backend
+
+    key = (kind, donate, kernel_backend())
     fn = _MESH_JITS.get(key)
     if fn is None:
         with _MESH_JITS_LOCK:
@@ -475,6 +528,8 @@ def _mesh_jit(kind: str, donate: bool = False):
                     fn = _mesh_apply_pack_jit_builder(donate)
                 elif kind == "apply_pack2":
                     fn = _mesh_apply_pack2_jit_builder(donate)
+                elif kind == "fused_bc":
+                    fn = _mesh_fused_bc_jit_builder(donate)
                 else:
                     fn = _mesh_apply_jit_builder(donate)
                 _MESH_JITS[key] = fn
@@ -724,6 +779,35 @@ class MeshPartitioner:
         )
         return self.apply_pack2_placed(placed, table_dev, gl)
 
+    def fused_bc_placed(self, placed: tuple, table_dev, n_rg: int,
+                        gl: int):
+        """Dispatch the fused B→C megakernel collective over
+        already-placed arrays (resident dispatch and prewarm share this
+        seam) -> lazy ``(total, mism, packed_quals, packed_bases)`` —
+        replicated i64 histograms plus the two row-sharded flat
+        payloads."""
+        # adam-tpu: noqa[dispatch-ledger] reason=every caller (bqsr.fused_bc_dispatch mesh branch and the mesh prewarm) wraps this dispatch in its own track keyed mesh.fused_bc
+        return _mesh_jit(
+            "fused_bc", donate=self.apply_supports_donation()
+        )(*placed, table_dev, n_rg=n_rg, lmax=gl, mesh=self.mesh)
+
+    def fused_bc_window(self, rw, res_pk, mm_pk, read_ok, has_qual,
+                        valid, table_dev, n_rg: int, gl: int):
+        """Resident-window fused B→C: bases/quals/lengths/flags/rg come
+        from ``rw``; the bit-packed masks, read filter and post-split
+        bools are the only per-window h2d, and ONE collective yields
+        the window's histograms AND both packed columns."""
+        if isinstance(table_dev, np.ndarray):
+            table_dev = self.put_replicated(
+                np.ascontiguousarray(table_dev, np.uint8)
+            )
+        placed = rw.args() + (
+            self.put_rows(res_pk), self.put_rows(mm_pk),
+            self.put_rows(read_ok), self.put_rows(has_qual),
+            self.put_rows(valid),
+        )
+        return self.fused_bc_placed(placed, table_dev, n_rg, gl)
+
     def packed_payload_slices(self, packed, lens_gm: np.ndarray,
                               gl: int) -> list:
         """Lazy ``(device slice, true bytes)`` pairs covering each
@@ -762,8 +846,12 @@ class MeshPartitioner:
         tr = tracer if tracer is not None else tele.TRACE
         todo = []
         with dp._PREWARM_LOCK:
+            # backend in the dedupe key, like the pool prewarm and the
+            # compile ledger: an XLA-warmed shape says nothing about
+            # the pallas executable of the same shape
+            backend = compile_ledger.active_backend()
             for key, fn in entries:
-                cache_key = (key, self.ledger_key())
+                cache_key = (key, self.ledger_key(), backend)
                 if cache_key not in dp._PREWARMED:
                     dp._PREWARMED.add(cache_key)
                     todo.append((key, fn, cache_key))
@@ -937,3 +1025,31 @@ def mesh_apply_prewarm_entry(b, n_rg: int, n_cyc: int,
     if pack:
         return (("mesh.apply_pack", g, gl, n_rg, n_cyc), warm)
     return (("mesh.apply", g, gl, n_rg, n_cyc), warm)
+
+
+def mesh_fused_bc_prewarm_entry(b, n_rg: int, n_cyc: int,
+                                part: MeshPartitioner) -> tuple:
+    """Prewarm entry for the mesh fused B→C megakernel keyed by the
+    known table's real cycle width (``device_pool.fused_bc_dummy_args``
+    — the single dummy-construction idiom per kernel signature)."""
+    import jax
+
+    from adam_tpu.formats.batch import grid_cols, grid_rows
+    from adam_tpu.parallel.device_pool import fused_bc_dummy_args
+    from adam_tpu.pipelines.bqsr import N_DINUC, N_QUAL
+
+    g = part.rows_for(grid_rows(b.n_rows))
+    gl = grid_cols(b.lmax)
+
+    def warm(_dev, g=g, gl=gl):
+        tbl = part.put_replicated(
+            np.zeros((n_rg, N_QUAL, n_cyc, N_DINUC), np.uint8)
+        )
+        placed = tuple(
+            part.put_rows(a) for a in fused_bc_dummy_args(b, g, gl)
+        )
+        jax.block_until_ready(
+            part.fused_bc_placed(placed, tbl, n_rg, gl)
+        )
+
+    return (("mesh.fused_bc", g, gl, n_rg, n_cyc), warm)

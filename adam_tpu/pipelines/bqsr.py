@@ -239,7 +239,39 @@ def observe_packed_body(
     device arrays, and the only per-residue h2d payload is the two
     packed masks (8x smaller than the booleans the plain kernel
     ships).  Unpacks on device, then runs the exact scatter-add of
-    :func:`observe_kernel` — bitwise the same histograms."""
+    :func:`observe_kernel` — bitwise the same histograms.
+
+    Backend-selected at trace time (``ops/kernel_backend``): under
+    ``pallas`` the covariate keys stay XLA (cheap, fusible) and the
+    memory-bound scatter-add over the bit-packed masks runs in
+    :func:`adam_tpu.ops.pallas_observe.observe_hist_pallas` — bits
+    unpack in-register, the histogram accumulates in VMEM.  Every jit
+    holding this body keys its cache on the backend."""
+    from adam_tpu.ops.kernel_backend import kernel_backend
+
+    if kernel_backend() == "pallas":
+        from adam_tpu.ops.pallas_observe import observe_hist_pallas
+
+        n_cyc = 2 * lmax + 1
+        cycles = compute_cycles(lengths, flags, lmax)
+        dinucs = compute_dinucs(bases, lengths, flags, lmax)
+        q = jnp.clip(quals.astype(jnp.int32), 0, N_QUAL - 1)
+        rg = jnp.where(
+            read_group_idx >= 0, read_group_idx, n_rg - 1
+        ).astype(jnp.int32)
+        flat_key = (
+            ((rg[:, None] * N_QUAL + q) * n_cyc + (cycles + lmax))
+            * N_DINUC + dinucs
+        ).astype(jnp.int32)
+        size = n_rg * N_QUAL * n_cyc * N_DINUC
+        total, mism = observe_hist_pallas(
+            flat_key, res_bits, mm_bits, read_ok, size
+        )
+        shape = (n_rg, N_QUAL, n_cyc, N_DINUC)
+        return (
+            total.reshape(shape).astype(jnp.int64),
+            mism.reshape(shape).astype(jnp.int64),
+        )
     from adam_tpu.ops.colpack import unpack_mask_body
 
     residue_ok = unpack_mask_body(res_bits, lmax)
@@ -263,14 +295,22 @@ _JIT_VARIANTS_LOCK = threading.Lock()
 
 def jit_variant(kind: str, donate: bool = False):
     """The jit for one kernel ``kind`` (``observe_packed`` / ``apply``
-    / ``apply_pack`` / ``apply_pack2``) with or without buffer
-    donation.  Donation aliases the dead-after-apply inputs into the
-    outputs (the resident quals buffer becomes the packed qual column,
-    the resident bases buffer the packed base column; the observe
-    variant donates its per-pass mask temporaries), halving pass-C's
-    per-window HBM footprint — only offered where the runtime honors
-    it (``device_pool.donation_ok``; CPU runtimes warn and copy)."""
-    key = (kind, bool(donate))
+    / ``apply_pack`` / ``apply_pack2`` / ``fused_bc``) with or without
+    buffer donation.  Donation aliases the dead-after-apply inputs into
+    the outputs (the resident quals buffer becomes the packed qual
+    column, the resident bases buffer the packed base column; the
+    observe variant donates its per-pass mask temporaries), halving
+    pass-C's per-window HBM footprint — only offered where the runtime
+    honors it (``device_pool.donation_ok``; CPU runtimes warn and
+    copy).
+
+    Keyed by ``(kind, donate, kernel_backend())``: the bodies branch on
+    the Pallas/XLA backend at *trace* time, so a backend flip must
+    reach a fresh jit rather than a stale executable (and the compile
+    ledger keys the same way — see utils/compile_ledger)."""
+    from adam_tpu.ops.kernel_backend import kernel_backend
+
+    key = (kind, bool(donate), kernel_backend())
     fn = _JIT_VARIANTS.get(key)
     if fn is not None:
         return fn
@@ -279,11 +319,9 @@ def jit_variant(kind: str, donate: bool = False):
         if fn is not None:
             return fn
         if not donate and kind == "apply":
+            # apply_table_body has no backend branch: the module-level
+            # jit stays the one executable either way
             fn = apply_table_kernel
-        elif not donate and kind == "apply_pack":
-            fn = apply_pack_kernel
-        elif not donate and kind == "apply_pack2":
-            fn = apply_pack2_kernel
         else:
             body, statics, donums = {
                 "observe_packed": (
@@ -293,6 +331,9 @@ def jit_variant(kind: str, donate: bool = False):
                 "apply_pack": (apply_pack_body, ("lmax", "size"), (1,)),
                 "apply_pack2": (
                     apply_pack2_body, ("lmax", "size"), (0, 1)
+                ),
+                "fused_bc": (
+                    fused_bc_body, ("n_rg", "lmax", "size"), (0, 1, 5, 6)
                 ),
             }[kind]
             kw = {"static_argnames": statics}
@@ -958,7 +999,6 @@ def apply_pack_body(
     return pack_rows_body(sanger_body(new_q), pack_lens, size)
 
 
-@partial(jax.jit, static_argnames=("lmax", "size"))
 def apply_pack_kernel(
     bases, quals, lengths, flags, read_group_idx, has_qual, valid,
     phred_table, lmax: int, size: int,
@@ -967,8 +1007,10 @@ def apply_pack_kernel(
     pass-C dispatch when packed columns are on; the mesh path fuses the
     same body per shard in ``parallel/partitioner``).  ``size`` is the
     window's dense grid area — static per (g, gl), so the packed
-    variant adds no compile-cache shapes."""
-    return apply_pack_body(
+    variant adds no compile-cache shapes.  Resolves through
+    :func:`jit_variant` so the executable is per kernel backend (the
+    pack scatter inside branches Pallas/XLA at trace time)."""
+    return jit_variant("apply_pack", False)(
         bases, quals, lengths, flags, read_group_idx, has_qual, valid,
         phred_table, lmax, size,
     )
@@ -1004,17 +1046,65 @@ def apply_pack2_body(
     )
 
 
-@partial(jax.jit, static_argnames=("lmax", "size"))
 def apply_pack2_kernel(
     bases, quals, lengths, flags, read_group_idx, has_qual, valid,
     phred_table, lmax: int, size: int,
 ):
     """Jit entry point over :func:`apply_pack2_body` (the
     resident-window pass-C dispatch when packed columns are on; the
-    donating twin lives in :func:`jit_variant`)."""
-    return apply_pack2_body(
+    donating twin lives in :func:`jit_variant`, as does the per-backend
+    executable — the pack scatter branches Pallas/XLA at trace
+    time)."""
+    return jit_variant("apply_pack2", False)(
         bases, quals, lengths, flags, read_group_idx, has_qual, valid,
         phred_table, lmax, size,
+    )
+
+
+def fused_bc_body(
+    bases, quals, lengths, flags, read_group_idx,
+    res_bits, mm_bits, read_ok, has_qual, valid,
+    phred_table, n_rg: int, lmax: int, size: int,
+):
+    """Traceable fused pass B→C — the megakernel tier's body.
+
+    When the solved recalibration table is already known at dispatch
+    time (known-sites runs; discovered-table resumes re-observing for
+    the observation dump), the observe scatter-add and the fused
+    apply+pack compose into ONE executable over the window's resident
+    arrays: the window's histograms AND both flat encode-ready columns
+    come out of a single dispatch, and the barrier-2 host round-trip
+    (fetch table → re-dispatch apply) disappears from the per-window
+    path.  Functionally pure composition of
+    :func:`observe_packed_body` (which sees the ORIGINAL quals — same
+    as the unfused ordering) and :func:`apply_pack2_body`, so the
+    outputs are bitwise the separate passes' outputs.
+
+    Returns ``(total, mism, packed_quals, packed_bases)``."""
+    total, mism = observe_packed_body(
+        bases, quals, lengths, flags, read_group_idx,
+        res_bits, mm_bits, read_ok, n_rg, lmax,
+    )
+    pq, pb = apply_pack2_body(
+        bases, quals, lengths, flags, read_group_idx, has_qual, valid,
+        phred_table, lmax, size,
+    )
+    return total, mism, pq, pb
+
+
+def fused_bc_kernel(
+    bases, quals, lengths, flags, read_group_idx,
+    res_bits, mm_bits, read_ok, has_qual, valid,
+    phred_table, n_rg: int, lmax: int, size: int,
+):
+    """Jit entry point over :func:`fused_bc_body` (the donating twin —
+    resident bases/quals become the packed columns, the mask
+    temporaries are consumed — lives in :func:`jit_variant`, keyed per
+    kernel backend like every other variant)."""
+    return jit_variant("fused_bc", False)(
+        bases, quals, lengths, flags, read_group_idx,
+        res_bits, mm_bits, read_ok, has_qual, valid,
+        phred_table, n_rg, lmax, size,
     )
 
 
@@ -1200,6 +1290,183 @@ def _apply_pack_lens_bases(b) -> np.ndarray:
     from adam_tpu.ops.colpack import pack_lengths
 
     return pack_lengths(b.lengths, b.valid)
+
+
+def fused_bc_enabled(default: bool = True) -> bool:
+    """Resolve the ``ADAM_TPU_FUSED_BC`` toggle for the megakernel
+    tier: ``auto``/unset -> ``default`` (on wherever a window is
+    eligible), ``1/on/true`` and ``0/off/false`` force; a typo warns
+    and keeps the default (``utils/retry.env_toggle``, the shared
+    tuning-var contract).  The off position is the smoke harness's
+    unfused A/B leg."""
+    from adam_tpu.utils.retry import env_toggle
+
+    return env_toggle("ADAM_TPU_FUSED_BC", default)
+
+
+def fused_bc_dispatch(
+    ds: AlignmentDataset, phred_table: np.ndarray,
+    known_snps: Optional[SnpTable] = None, backend: Optional[str] = None,
+    device=None, mesh=None, resident=None,
+):
+    """One fused B→C dispatch for a window whose recalibration table is
+    already solved (known-sites runs; discovered-table resumes that
+    re-observe for the dump) -> ``(handle, (total, mism, rg_names,
+    gl))``, or ``None`` when the fused tier can't take this window.
+
+    The handle is exactly :func:`apply_recalibration_dispatch`'s
+    ``packed2`` shape (finished by
+    :func:`apply_recalibration_finish_packed`); the histograms are the
+    lazy device arrays the observe path would have produced — both out
+    of ONE donated executable over the window's ingest-resident
+    arrays, so the separate observe dispatch, the barrier-2 apply
+    re-dispatch and the round-trip between them all collapse.
+
+    Eligibility: device backend, a live matching ``ResidentWindow``
+    handle, and a table at least as wide as the window's column grid
+    (``n_cyc >= 2*gl+1`` — the merged table always is for tables
+    discovered from the same input).  Anything else returns ``None``
+    and the caller falls back to the separate-pass path, which is
+    bitwise identical by construction (:func:`fused_bc_body` is a pure
+    composition of the two pass bodies)."""
+    backend = bqsr_backend(backend)
+    if backend != "device" or resident is None:
+        return None
+    from adam_tpu.formats.batch import grid_cols, grid_rows, pad_rows_np
+    from adam_tpu.ops.colpack import fetch_grid, pack_mask_bits
+    from adam_tpu.parallel.device_pool import (
+        donation_ok, putter, span_attrs,
+    )
+    from adam_tpu.utils import compile_ledger, faults
+    from adam_tpu.utils import retry as _retry
+
+    b = ds.batch.to_numpy()
+    n = b.n_rows
+    L = b.lmax
+    g = grid_rows(n)
+    glc = grid_cols(L)
+    n_rg = len(ds.read_groups) + 1
+    if phred_table.shape[0] != n_rg or phred_table.shape[2] < 2 * glc + 1:
+        return None
+    n_cyc = phred_table.shape[2]
+    rw = resident
+    rg_names = ds.read_groups.names + ["null"]
+
+    attrs = {"device": "mesh"} if mesh is not None else span_attrs(device)
+    with _tele.TRACE.span(
+        _tele.SPAN_FUSED_BC, backend=backend,
+        reads=int(ds.batch.n_rows), **attrs,
+    ):
+        is_mm, _, has_md = batch_md_arrays(
+            ds.batch, ds.sidecar, need_ref_codes=False
+        )
+        read_ok = observe_read_mask(b, has_md)
+        residue_ok = observe_residue_mask(ds, b, known_snps)
+        pack_lens_q = _apply_pack_lens(b)
+        pack_lens_b = _apply_pack_lens_bases(b)
+
+        if mesh is not None:
+            gm = mesh.rows_for(g)
+            if not (rw.alive and rw.device == "mesh"
+                    and rw.g == gm and rw.gl == glc):
+                return None
+            res_pk = pack_mask_bits(
+                pad_rows_np(residue_ok, gm, False, cols=glc)
+            )
+            mm_pk = pack_mask_bits(pad_rows_np(is_mm, gm, False, cols=glc))
+            rd_pad = pad_rows_np(read_ok, gm, False)
+            hq_pad = pad_rows_np(b.has_qual, gm, False)
+            vd_pad = pad_rows_np(b.valid, gm, False)
+            lens_q_pad = pad_rows_np(pack_lens_q, gm, 0)
+            lens_b_pad = pad_rows_np(pack_lens_b, gm, 0)
+
+            def dispatch_mesh_fused():
+                faults.point("device.dispatch")
+                if not rw.alive:
+                    # donated shards died under a half-run attempt:
+                    # the caller re-runs the separate passes host-ship
+                    return None
+                try:
+                    total, mism, pq, pb = mesh.fused_bc_window(
+                        rw, res_pk, mm_pk, rd_pad, hq_pad, vd_pad,
+                        phred_table, n_rg, glc,
+                    )
+                except BaseException:
+                    if mesh.apply_supports_donation():
+                        rw.mark_consumed()
+                    raise
+                if mesh.apply_supports_donation():
+                    rw.mark_consumed()
+                return total, mism, (
+                    mesh.packed_payload_slices(pq, lens_q_pad, glc),
+                    mesh.packed_payload_slices(pb, lens_b_pad, glc),
+                )
+
+            with compile_ledger.track(
+                ("mesh.fused_bc", gm, glc, n_rg, n_cyc),
+                mesh.ledger_key(),
+            ):
+                got = _retry.retry_call(
+                    dispatch_mesh_fused, site="bqsr.fused_bc.dispatch"
+                )
+            if got is None:
+                return None
+            total, mism, (q_slices, b_slices) = got
+            handle = (ds, b, ("packed2", q_slices, pack_lens_q,
+                              b_slices, pack_lens_b))
+            return handle, (total, mism, rg_names, glc)
+
+        if not (rw.alive and rw.device is device
+                and rw.g == g and rw.gl == glc):
+            return None
+        _put = putter(device)
+        res_pk = pack_mask_bits(pad_rows_np(residue_ok, g, False, cols=glc))
+        mm_pk = pack_mask_bits(pad_rows_np(is_mm, g, False, cols=glc))
+        rd_pad = pad_rows_np(read_ok, g, False)
+        hq_pad = pad_rows_np(b.has_qual, g, False)
+        vd_pad = pad_rows_np(b.valid, g, False)
+        total_q = int(pack_lens_q.sum())
+        total_b = int(pack_lens_b.sum())
+        cut_q = min(g * glc, fetch_grid(total_q))
+        cut_b = min(g * glc, fetch_grid(total_b))
+
+        def _placed_table():
+            if isinstance(phred_table, np.ndarray):
+                return _put(np.ascontiguousarray(phred_table, np.uint8))
+            return phred_table  # device-resident (pool-replicated)
+
+        def dispatch_fused():
+            faults.point("device.dispatch", device=device)
+            if not rw.alive:
+                return None
+            donate = donation_ok(device)
+            try:
+                total, mism, pq, pb = jit_variant("fused_bc", donate)(
+                    *rw.args(), _put(res_pk), _put(mm_pk), _put(rd_pad),
+                    _put(hq_pad), _put(vd_pad), _placed_table(),
+                    n_rg, glc, g * glc,
+                )
+            except BaseException:
+                if donate:
+                    rw.mark_consumed()
+                raise
+            if donate:
+                rw.mark_consumed()
+            return total, mism, pq[:cut_q], pb[:cut_b]
+
+        # ledger key == fused_bc_prewarm_entry's key
+        with compile_ledger.track(
+            ("bqsr.fused_bc", g, glc, n_rg, n_cyc), device
+        ):
+            got = _retry.retry_call(
+                dispatch_fused, site="bqsr.fused_bc.dispatch"
+            )
+        if got is None:
+            return None
+        total, mism, pq, pb = got
+        handle = (ds, b, ("packed2", [(pq, total_q)], pack_lens_q,
+                          [(pb, total_b)], pack_lens_b))
+        return handle, (total, mism, rg_names, glc)
 
 
 def _apply_dispatch_impl(

@@ -62,6 +62,7 @@ should be doing.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import queue
@@ -259,6 +260,7 @@ def transform_streamed(
     lod_threshold: float | None = None,
     max_target_size: int | None = None,
     dump_observations: Optional[str] = None,
+    known_table: Optional[tuple] = None,
     devices: Optional[int] = None,
     partitioner: Optional[str] = None,
     progress: Optional[str] = None,
@@ -335,6 +337,20 @@ def transform_streamed(
     stamps every span it records with it, and it selects this run's
     events in the gateway ``/trace`` export and incident bundles.
     Tracing changes attribution metadata only, never output bytes.
+
+    ``known_table`` is a pre-solved recalibration table ``(u8[n_rg,
+    N_QUAL, n_cyc, N_DINUC] ndarray, gl)`` — the known-sites workflow,
+    where the table shipped with the cohort instead of being discovered
+    from this input.  It REPLACES the solved table at barrier 2 (the
+    observe pass and the histogram merge still run, so
+    ``dump_observations`` and the resume sidecars see the same
+    artifacts), and it arms the fused B→C megakernel tier
+    (docs/PERF.md "Megakernel tier"): with the applied table known at
+    ingest, each eligible window's observe scatter-add and apply+pack
+    gather compose into ONE donated dispatch
+    (``bqsr.fused_bc_dispatch``), eliminating the per-window barrier-2
+    round-trip.  Output bytes are identical fused or not
+    (``ADAM_TPU_FUSED_BC=0`` is the unfused A/B leg).
     """
     from adam_tpu.utils import incidents
 
@@ -368,7 +384,8 @@ def transform_streamed(
             n_writers=n_writers, max_indel_size=max_indel_size,
             max_consensus_number=max_consensus_number,
             lod_threshold=lod_threshold, max_target_size=max_target_size,
-            dump_observations=dump_observations, devices=devices,
+            dump_observations=dump_observations, known_table=known_table,
+            devices=devices,
             partitioner=partitioner, run_dir=run_dir, resume=resume,
             pacer=pacer, device_pool=device_pool, coalescer=coalescer,
         )
@@ -407,6 +424,7 @@ def _transform_streamed_impl(
     lod_threshold: float | None,
     max_target_size: int | None,
     dump_observations: Optional[str],
+    known_table: Optional[tuple],
     devices: Optional[int],
     partitioner: Optional[str],
     run_dir: Optional[str],
@@ -507,6 +525,17 @@ def _transform_streamed_impl(
 
     use_packed = use_device and packed_columns_enabled()
     stats["packed_columns"] = use_packed
+    # kernel backend (ADAM_TPU_KERNEL_BACKEND, ops/kernel_backend): the
+    # Pallas/XLA selector every per-residue body reads at trace time.
+    # Gauged once — the backend is a process-wide decision, and the
+    # analyzer/bench artifacts attribute kernel walls against it.
+    from adam_tpu.ops.kernel_backend import kernel_backend
+
+    stats["kernel_backend"] = kernel_backend()
+    tr.gauge(
+        tele.G_KERNEL_BACKEND,
+        1 if stats["kernel_backend"] == "pallas" else 0,
+    )
     # device-resident windows (ADAM_TPU_RESIDENT, default on for the
     # device backend; docs/PERF.md "Device-resident windows"): each
     # window's bases/quals/lengths/flags/rg land on device ONCE at
@@ -542,6 +571,11 @@ def _transform_streamed_impl(
     obs_parts: list = []
     obs_replays: list = []
     obs_windows: list = []
+    # megakernel tier (docs/PERF.md): window idx -> (producing device |
+    # "mesh", packed2 apply handle) stashed by the fused B→C dispatch in
+    # pass B — pass C pops and FETCHES these instead of dispatching an
+    # apply.  A window absent here takes the separate-pass path.
+    fused_handles: dict = {}
     if use_device:
         tr.gauge(tele.G_POOL_DEVICES, stats["n_devices"])
     if hb is not None:
@@ -708,6 +742,10 @@ def _transform_streamed_impl(
         # path takes their windows over by re-shipping from the
         # host-retained ingest copy (docs/ROBUSTNESS.md)
         _drop_all_resident()
+        # fused B→C outputs sharded over the dying mesh are no longer
+        # trustworthy either: forget them, so pass C re-applies those
+        # windows through the separate-pass pool/host path
+        fused_handles.clear()
         tr.count(tele.C_MESH_DEGRADED)
         log.error(
             "mesh partitioner failed%s (%s); degrading to the pool path"
@@ -761,10 +799,53 @@ def _transform_streamed_impl(
             "max_target_size": mts,
             "known_snps": known_snps,
             "known_indels": known_indels,
+            # a known-sites table changes the applied (= output) bytes:
+            # content-digested into the fingerprint, so a resume under a
+            # different table is refused instead of mixing output.  The
+            # key is absent (not None) without one — discovered-table
+            # journals keep their pre-existing fingerprints.
+            **({"known_table": (
+                hashlib.sha256(
+                    np.ascontiguousarray(known_table[0], np.uint8)
+                    .tobytes()
+                ).hexdigest(),
+                int(known_table[1]),
+            )} if known_table is not None else {}),
         })
         journal = ck_mod.RunJournal(
             run_dir, fp, out_path, resume=resume, tracer=tr
         )
+
+    # ---- megakernel tier (docs/PERF.md "Megakernel tier") -------------
+    # With the applied table known BEFORE pass B — a known-sites run, or
+    # a -dump_observations resume whose journal already holds the solved
+    # table (re-observing only for the merge artifacts) — each eligible
+    # window's observe and apply+pack fuse into one donated dispatch.
+    # Eligibility mirrors the packed2 fast path (device backend + packed
+    # columns + resident windows); the cross-job coalescer owns its own
+    # fusion, so a coalesced run keeps the separate passes.
+    fused_table = None
+    if (
+        recalibrate and use_device and use_packed and use_resident
+        and coalescer is None and bqsr_mod.fused_bc_enabled()
+    ):
+        if known_table is not None:
+            fused_table = (
+                np.ascontiguousarray(known_table[0], np.uint8),
+                int(known_table[1]),
+            )
+        elif journal is not None and journal.resumed and dump_observations:
+            # the dump forces a full re-observe (resume_table stays
+            # None below), but the journal's solved table — identical
+            # to what this merge will re-solve, same input + sidecar
+            # histograms — is already the applied table
+            lt = journal.load_table()
+            if lt is not None:
+                fused_table = (
+                    np.ascontiguousarray(lt[0], np.uint8), int(lt[1])
+                )
+    stats["fused_bc"] = fused_table is not None
+    tr.gauge(tele.G_FUSED_BC, 1 if fused_table is not None else 0)
 
     # ---- pass A: ingest || summaries + events --------------------------
     in_q: queue.Queue = queue.Queue(maxsize=3)
@@ -937,6 +1018,14 @@ def _transform_streamed_impl(
                                 b, n_rg, mp
                             )
                         )
+                    if fused_table is not None:
+                        # the megakernel the fused tier will dispatch,
+                        # at the KNOWN table's cycle width
+                        entries.append(
+                            part_mod.mesh_fused_bc_prewarm_entry(
+                                b, n_rg, fused_table[0].shape[2], mp
+                            )
+                        )
                 mp.prewarm(entries, tracer=tr)
             else:
                 from adam_tpu.parallel.device_pool import (
@@ -949,6 +1038,10 @@ def _transform_streamed_impl(
                         recalibrate=recalibrate,
                         packed_apply=use_packed,
                         resident=use_resident,
+                        fused_n_cyc=(
+                            fused_table[0].shape[2]
+                            if fused_table is not None else None
+                        ),
                     ),
                     tracer=tr,
                 )
@@ -982,12 +1075,24 @@ def _transform_streamed_impl(
                             b, n_rg, mp
                         )
                     )
+                if fused_table is not None:
+                    entries.append(
+                        part_mod.mesh_fused_bc_prewarm_entry(
+                            b, n_rg, fused_table[0].shape[2], mp
+                        )
+                    )
                 mp.prewarm(entries, tracer=tr)
             else:
                 entries = [dp_mod.observe_prewarm_entry(b, n_rg)]
                 if use_resident:
                     entries.append(
                         dp_mod.observe_packed_prewarm_entry(b, n_rg)
+                    )
+                if fused_table is not None:
+                    entries.append(
+                        dp_mod.fused_bc_prewarm_entry(
+                            b, n_rg, fused_table[0].shape[2]
+                        )
                     )
                 dpool.prewarm(entries, tracer=tr)
         finally:
@@ -1247,6 +1352,69 @@ def _transform_streamed_impl(
                         got[2]), None
         if not use_device:
             return _observe_host(w), None
+        # megakernel tier: with the applied table already known, this
+        # window's observe AND its pass-C apply+pack ride ONE donated
+        # dispatch — the packed2 handle parks in fused_handles for pass
+        # C to FETCH (no second dispatch).  Any ineligibility (no live
+        # resident handle, table narrower than the window's grid)
+        # returns None from the dispatch and the window falls through
+        # to the separate passes below, bitwise identical by
+        # construction (fused_bc_body is a pure composition of the two
+        # pass bodies).
+        if fused_table is not None and not res["device_lost"]:
+            rw = resident_map.get(i)
+            if rw is not None:
+                # chaos-harness kill point: the mid-fused-dispatch leg
+                # of the kill-and-resume matrix (nothing persisted yet
+                # — a resume replays the window, fused or not)
+                faults.point("proc.kill", device="fused_bc")
+                mp_f = exec_state["mesh"]
+                if mp_f is not None:
+                    try:
+                        with tele.pass_scope("observe"):
+                            got = bqsr_mod.fused_bc_dispatch(
+                                w, fused_table[0], known_snps, backend,
+                                mesh=mp_f, resident=rw,
+                            )
+                            if got is not None:
+                                handle, (total, mism, _rg, g) = got
+                                mp_f.accumulate(total, mism, g)
+                    except Exception as e:
+                        _mesh_degrade(e, "pass-B fused dispatch")
+                        # fall through: separate passes on the pool
+                    else:
+                        if got is not None:
+                            mesh_obs.append((i, w))
+                            fused_handles[i] = ("mesh", handle)
+                            tr.count(tele.C_DEVICE_DISPATCHED)
+                            tr.count(tele.C_MESH_DISPATCHED)
+                            tr.count(tele.C_FUSED_DISPATCHED)
+                            return None
+                else:
+                    try:
+                        with tele.pass_scope("observe"):
+                            got = bqsr_mod.fused_bc_dispatch(
+                                w, fused_table[0], known_snps, backend,
+                                device=rw.device, resident=rw,
+                            )
+                    except Exception as e:
+                        # past the retry budget: evict the pinned chip
+                        # (its resident handles drop with it) and fall
+                        # through to the separate-pass survivor walk
+                        _evict_or_lose(rw.device, e)
+                    else:
+                        if got is not None:
+                            handle, (total, mism, _rg, g) = got
+                            fused_handles[i] = (rw.device, handle)
+                            tr.count(tele.C_DEVICE_DISPATCHED)
+                            tr.count(tele.C_FUSED_DISPATCHED)
+                            # histograms merge exactly like the solo
+                            # observe's; a failed barrier fetch evicts
+                            # and recomputes through the same hook
+                            return (
+                                (total, mism, g),
+                                _obs_replay(i, w, rw.device),
+                            )
         mp = exec_state["mesh"]
         if mp is not None:
             try:
@@ -1541,7 +1709,17 @@ def _transform_streamed_impl(
                     total, mism, header.read_groups.names + ["null"], gl,
                     dump_observations,
                 )
-            table = bqsr_mod.solve_recalibration_table(total, mism)
+            if known_table is not None:
+                # known-sites run: the supplied table IS the applied
+                # table, fused or not — the solve is skipped, while the
+                # merge above still produced the sidecars/CSV a
+                # discovered-table run would.  The table's own grid
+                # width replaces the merge's (its cycle axis geometry,
+                # not this input's, centers the apply gather).
+                table = np.ascontiguousarray(known_table[0], np.uint8)
+                gl = int(known_table[1])
+            else:
+                table = bqsr_mod.solve_recalibration_table(total, mism)
         if journal is not None:
             try:
                 journal.save_table(table, gl)
@@ -1551,6 +1729,11 @@ def _transform_streamed_impl(
         # resume goes straight into pass C)
         faults.point("proc.kill", device="barrier2")
     else:
+        if recalibrate and known_table is not None:
+            # no observations to merge (e.g. every window resumed), but
+            # the known table still applies in pass C
+            table = np.ascontiguousarray(known_table[0], np.uint8)
+            gl = int(known_table[1])
         tr.add_span(tele.SPAN_SOLVE, time.monotonic_ns(), 0)
 
     # ---- pass C: apply || encode || part writes ------------------------
@@ -1805,6 +1988,17 @@ def _transform_streamed_impl(
             # double buffer is the whole pipeline depth
             if k < len(plist) and len(pend) < 2:
                 idx, w = plist[k]
+                fh = fused_handles.pop(idx, None)
+                if fh is not None:
+                    # megakernel tier: pass B's fused dispatch already
+                    # produced this window's packed columns — the
+                    # handle joins the in-flight queue FETCH-ONLY (no
+                    # second dispatch, no dispatch count)
+                    pend.append((idx, "mesh", fh[1]))
+                    tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend))
+                    plist[k] = None
+                    k += 1
+                    continue
                 try:
                     with tr.span(
                         tele.SPAN_APPLY_DISPATCH, window=idx,
@@ -2199,6 +2393,23 @@ def _transform_streamed_impl(
                     if len(pend_q) >= apply_depth:
                         _fetch_one()
                     continue
+
+            fh = fused_handles.pop(idx, None)
+            if fh is not None:
+                # megakernel tier: the packed columns are already on
+                # the producing chip from pass B's fused dispatch —
+                # fetch-only (no second dispatch, no dispatch count).
+                # A failed fetch takes _fetch_one's normal replay path:
+                # evict and re-apply separately on a survivor/host,
+                # byte-identical by the parity contract.
+                pend_q.append((idx, fh[0], fh[1]))
+                tr.gauge(tele.G_DEVICE_INFLIGHT, len(pend_q))
+                del w
+                if idx < len(windows):
+                    windows[idx] = None  # free as we go
+                if len(pend_q) >= apply_depth:
+                    _fetch_one()
+                continue
 
             def _dispatch_one(dev, idx=idx, w=w):
                 with tr.span(

@@ -10,11 +10,12 @@ with a key the prewarm registry also builds.  PR 7 caught the
 realigned-tail observe gap only at runtime; this rule catches the next
 one at review time.
 
-Two checks:
+Three checks:
 
 * **coverage** — a call to a jit-compiled callable (``@jax.jit``
   functions, ``jax.jit(...)`` bindings, ``*_kernel`` names, the mesh
-  ``observe_window``/``apply_window``/``markdup_window`` collectives)
+  ``observe_window``/``apply_window``/``markdup_window``/
+  ``fused_bc_window`` collectives)
   in a streamed-path module must sit inside ``with
   compile_ledger.track(...)``.  The dominant idiom nests the dispatch
   in a local ``def dispatch(): ...`` retried via ``retry_call`` inside
@@ -26,7 +27,13 @@ Two checks:
   a prewarm entry key built in ``parallel/`` (the ``*_entry``/
   ``*prewarm*`` builders in ``device_pool.py``/``partitioner.py``),
   keeping the ledger's key space and the prewarm's in lockstep by
-  construction."""
+  construction.
+* **pallas containment** — a ``pl.pallas_call`` anywhere in the
+  package must sit inside a ``*_body``/``*_kernel``/``*_pallas``
+  function: those are the surfaces the kernel-backend selector
+  (``ops/kernel_backend``) branches on at trace time, so a stray
+  pallas site elsewhere would dodge both the backend toggle and the
+  ledger keys."""
 
 from __future__ import annotations
 
@@ -60,8 +67,16 @@ PREWARM_FILES = ("adam_tpu/parallel/device_pool.py",
                  "adam_tpu/parallel/partitioner.py")
 
 MESH_WINDOW_METHODS = frozenset(
-    {"observe_window", "apply_window", "markdup_window"}
+    {"observe_window", "apply_window", "markdup_window",
+     "fused_bc_window"}
 )
+
+#: Function-name suffixes a ``pl.pallas_call`` site may live under:
+#: the jit-able math (``*_body``), a dispatchable binding
+#: (``*_kernel``) or the Pallas port itself (``*_pallas``).  Anywhere
+#: else the call escapes the backend selector (ops/kernel_backend) and
+#: the ledger/prewarm machinery that keys on it.
+PALLAS_HOST_SUFFIXES = ("_body", "_kernel", "_pallas")
 
 
 def _is_track_call(expr) -> bool:
@@ -108,6 +123,11 @@ class DispatchLedgerRule(Rule):
                         self._tracked[k] = (ctx.relpath, node.lineno)
         if ctx.relpath in PREWARM_FILES:
             self._collect_prewarm_kernels(ctx.tree)
+        # pallas containment (package-wide): a pallas_call outside a
+        # *_body/*_kernel/*_pallas function is a dispatch surface the
+        # backend selector and the ledger cannot key on
+        if ctx.relpath.startswith("adam_tpu/"):
+            yield from self._check_pallas_sites(ctx)
         if ctx.relpath not in SCOPE_FILES:
             return
         dispatchables = collect_jit_callables(ctx.tree)
@@ -145,6 +165,23 @@ class DispatchLedgerRule(Rule):
                 f"jit dispatch '{name}' outside compile_ledger.track — "
                 "the compile ledger (and the in_window == 0 invariant) "
                 "cannot see this site",
+            )
+
+    def _check_pallas_sites(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "pallas_call"):
+                continue
+            fn = enclosing_function(ctx, node)
+            if fn is not None and fn.name.endswith(PALLAS_HOST_SUFFIXES):
+                continue
+            where = fn.name if fn is not None else "module scope"
+            yield ctx.finding(
+                self.name, node,
+                f"pallas_call in '{where}' — Pallas call sites must "
+                "live inside a *_body/*_kernel/*_pallas function so the "
+                "kernel-backend selector and the compile ledger key on "
+                "them (ops/kernel_backend.py)",
             )
 
     @staticmethod

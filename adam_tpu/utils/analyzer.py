@@ -286,6 +286,30 @@ def _stage_decomposition(span_totals: dict, wall: Optional[float],
         out["barrier1_resolve"]["sort"] = (
             "device" if g.get("last") else "host"
         )
+    # megakernel tier (docs/PERF.md): with the fused B→C path armed,
+    # per-window observe and the pass-C apply rode ONE dispatch — two
+    # separate stage rows would misread as two device passes.  Render
+    # them as one combined stage; the rows are disjoint and the merged
+    # row is their sum, so the stage fractions still sum to the run
+    # wall exactly as before.
+    gf = (gauges or {}).get(tele.G_FUSED_BC)
+    if gf is not None and gf.get("last") and (
+        "observe" in out or "pass_c_apply" in out
+    ):
+        t = sum(
+            out.get(k, {}).get("total_s", 0.0)
+            for k in ("observe", "pass_c_apply")
+        )
+        row = {"total_s": round(t, 6)}
+        if wall:
+            row["frac"] = round(t / wall, 4)
+        merged: dict = {}
+        for k, v in out.items():
+            if k in ("observe", "pass_c_apply"):
+                merged.setdefault("fused_bc_apply", row)
+            else:
+                merged[k] = v
+        out = merged
     return out
 
 

@@ -42,8 +42,9 @@ import time
 
 from adam_tpu.utils import telemetry as tele
 
-#: (kernel key, device key) triples whose executable this process has
-#: already built — mirrors device_pool._PREWARMED, which seeds it.
+#: (kernel key, device key, kernel backend) triples whose executable
+#: this process has already built — mirrors device_pool._PREWARMED,
+#: which seeds it.
 _SEEN: set = set()
 _LOCK = threading.Lock()
 
@@ -90,17 +91,31 @@ def device_cache_key(device) -> str:
     return _device_key(device)
 
 
+def active_backend() -> str:
+    """The kernel backend half of the ledger key.  The Pallas/XLA
+    selector (``ops/kernel_backend``) swaps kernel *bodies* at trace
+    time, so an XLA-warmed ``(kernel, *dims, device)`` says nothing
+    about the pallas executable of the same shape — without the
+    backend in the key, a backend flip's first dispatch would read as
+    a cache hit while a cold compile serialized in-window.  Prewarm
+    dedupe caches (device_pool._PREWARMED, the mesh prewarm) key the
+    same way."""
+    from adam_tpu.ops.kernel_backend import kernel_backend
+
+    return kernel_backend()
+
+
 def claim(key: tuple, device=None) -> None:
-    """Assert a (kernel, shape, device) triple warm without recording
-    anything — the prewarm's dedupe-skip path calls this so the ledger
-    seen-set re-agrees with the prewarm cache.  The two can diverge
-    after a faulted run: a dispatch that RAISES gives its track claim
-    back (so the retry re-measures) while the jit executable it built
-    stays cached and the prewarm cache keeps the triple — without this
-    re-seed, the next clean run's first dispatch of the triple would
-    read as a false in-window cold compile."""
+    """Assert a (kernel, shape, backend, device) triple warm without
+    recording anything — the prewarm's dedupe-skip path calls this so
+    the ledger seen-set re-agrees with the prewarm cache.  The two can
+    diverge after a faulted run: a dispatch that RAISES gives its track
+    claim back (so the retry re-measures) while the jit executable it
+    built stays cached and the prewarm cache keeps the triple — without
+    this re-seed, the next clean run's first dispatch of the triple
+    would read as a false in-window cold compile."""
     with _LOCK:
-        _SEEN.add((key, device_cache_key(device)))
+        _SEEN.add((key, device_cache_key(device), active_backend()))
 
 
 class track:
@@ -127,7 +142,9 @@ class track:
         # membership maintenance is unconditional (a warmup run without
         # --metrics-json still warms the jit cache, and the timed run's
         # ledger must know that); only counters/entries gate on recording
-        self._cache_key = (self._key, device_cache_key(self._dev))
+        self._cache_key = (
+            self._key, device_cache_key(self._dev), active_backend()
+        )
         with _LOCK:
             self._miss = self._cache_key not in _SEEN
             _SEEN.add(self._cache_key)

@@ -110,6 +110,14 @@ SPAN_BQSR_APPLY_DISPATCH = _span("bqsr.apply.dispatch")
 SPAN_BQSR_APPLY_FETCH = _span("bqsr.apply.fetch")
 SPAN_BQSR_APPLY_HOST = _span("bqsr.apply.host")
 SPAN_MD_COLUMNS = _span("markdup.columns.dispatch")
+# the megakernel tier (PR 18): one fused B→C dispatch per window when
+# the recalibration table is known up front; the gauges record the
+# tier decision (streamed.fused_bc 1/0) and the resolved kernel
+# backend (kernel.backend 0=xla 1=pallas) once per run
+SPAN_FUSED_BC = _span("bqsr.fused_bc")
+G_FUSED_BC = _metric("streamed.fused_bc")
+G_KERNEL_BACKEND = _metric("kernel.backend")
+C_FUSED_DISPATCHED = _metric("device.windows.fused")
 
 # ---- device pool (parallel/device_pool.py): multi-chip round-robin
 # dispatch + per-device compile prewarm.  Dispatch/fetch spans carry a

@@ -687,10 +687,21 @@ def main() -> None:
     # independently, so the per-device spread is the skew context for
     # the pool's round-robin dispatch
     dev_info = _device_info()
+    # per-kernel microbench (utils/kernelbench.py) — isolates the
+    # observe/pack/apply/fused_bc inner loops per backend so a Pallas
+    # port can't regress one of them invisibly inside the e2e number;
+    # bench-diff flattens rows to kernels.<kernel>.<backend>.g<g>x<gl>.*
+    try:
+        from adam_tpu.utils.kernelbench import run_kernelbench
+
+        kernels_doc = run_kernelbench(iters=3)
+    except Exception as e:
+        kernels_doc = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             _denan({
                 "metric": "secondary",
+                "kernels": kernels_doc,
                 "devices": {
                     "chip": dev_info,
                     "cpu_baseline": cpu_stats.get("devices")
