@@ -720,6 +720,19 @@ class Serve(Command):
             "Default: ADAM_TPU_QUOTA, none",
         )
         p.add_argument(
+            "--slo", dest="slo", default=None, metavar="SPEC",
+            help="declarative service-level objectives, e.g. "
+            "'tenantA:p99(sched.job.run)<30s;*:avail>=0.99' "
+            "(utils/slo.py, docs/OBSERVABILITY.md): per-tenant or "
+            "service-wide (*) latency/availability/throughput "
+            "objectives judged over rolling windows "
+            "(ADAM_TPU_SLO_WINDOW_S, default 300 s short / 12x long); "
+            "error-budget state persists in RUN_ROOT/SLO_BUDGET.json, "
+            "a corroborated fast burn fires an slo.burn incident "
+            "bundle, and GET /slo + /metrics expose compliance and "
+            "burn.  Default: ADAM_TPU_SLO, none",
+        )
+        p.add_argument(
             "--listen", dest="listen", default=None, metavar="HOST:PORT",
             help="serve the HTTP gateway on HOST:PORT (port 0 = OS-"
             "assigned; the bound address publishes durably to "
@@ -768,6 +781,7 @@ class Serve(Command):
             job_retries=args.job_retries,
             batching=args.batch,
             quota=args.quota,
+            slo=args.slo,
         )
         gw = None
         if listen is not None:

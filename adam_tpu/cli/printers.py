@@ -332,8 +332,10 @@ class Analyze(Command):
         from adam_tpu.utils import analyzer
 
         try:
-            doc = analyzer.load_document(args.input)
-            report = analyzer.analyze(doc)
+            # analyze_path folds sibling incidents/, SLO_BUDGET.json
+            # and PERF_LEDGER.ndjson into the report's Incidents/SLO/
+            # Perf-trend sections
+            report = analyzer.analyze_path(args.input)
         except (OSError, ValueError) as e:
             print(f"analyze: {e}", file=sys.stderr)
             return 2
@@ -389,9 +391,11 @@ class Top(Command):
             help="refresh period in seconds (default 0.5)",
         )
         p.add_argument(
-            "-once", action="store_true",
+            "-once", "--once", dest="once", action="store_true",
             help="render a single frame from the newest line and exit "
-            "(scripting/CI mode; exit 2 when the file has no lines)",
+            "(scripting/CI mode; exit 2 when the file has no lines) — "
+            "the usual 0/1/2 codes, so CI legs and incident-bundle "
+            "captures can gate on it",
         )
         p.add_argument(
             "-max_wait", type=float, default=None, metavar="S",

@@ -267,6 +267,12 @@ class GatewayClient:
         service's run root (oldest first)."""
         return self._request_json("GET", protocol.INCIDENTS_PATH)
 
+    def slo(self) -> dict:
+        """``GET /slo``: the service's SLO compliance document —
+        ``enabled`` plus, when an engine is armed, per-objective
+        compliance, burn rates, and error-budget remaining."""
+        return self._request_json("GET", protocol.SLO_PATH)
+
     # ---- event streaming -----------------------------------------------
     def poll_events(self, job: str, cursor: int = 0) -> tuple:
         """One non-following poll: ``(next_cursor, lines)`` of every

@@ -76,9 +76,14 @@ def _labels(**kv) -> str:
     return "{%s}" % inner if inner else ""
 
 
-def render_prometheus(snap: dict) -> str:
+def render_prometheus(snap: dict, slo_status: dict | None = None) -> str:
     """One snapshot -> exposition-format text (trailing newline
-    included, as the format requires)."""
+    included, as the format requires).  ``slo_status`` is the armed
+    SLO engine's :func:`adam_tpu.utils.slo.status` document; when
+    given, per-objective burn/compliance/budget gauges render with an
+    ``objective=`` label (the service-wide worst-burn and
+    budget-remaining gauges already flow through the plain gauges
+    section — they are registered telemetry names)."""
     out: list = []
 
     def head(name: str, kind: str, help_text: str) -> None:
@@ -178,6 +183,30 @@ def render_prometheus(snap: dict) -> str:
                 "adam_tpu_device_health_transitions%s %s"
                 % (_labels(device=dev),
                    _fmt(health[dev].get("transitions", 0)))
+            )
+
+    for row_name, key, help_text in (
+        ("adam_tpu_slo_burn_short", "burn_short",
+         "error-budget burn rate over the short window per objective"),
+        ("adam_tpu_slo_burn_long", "burn_long",
+         "error-budget burn rate over the long window per objective"),
+        ("adam_tpu_slo_compliance", "compliance",
+         "long-window compliance fraction per objective"),
+        ("adam_tpu_slo_objective_budget_remaining", "budget_remaining",
+         "error-budget fraction remaining per objective"),
+    ):
+        objectives = (slo_status or {}).get("objectives") or []
+        if not objectives:
+            break
+        head(row_name, "gauge", help_text)
+        for o in objectives:
+            out.append(
+                "%s%s %s" % (
+                    row_name,
+                    _labels(objective=o.get("key", ""),
+                            tenant=o.get("tenant", "")),
+                    _fmt(o.get(key, 0.0)),
+                )
             )
 
     head("adam_tpu_traces_active", "gauge",

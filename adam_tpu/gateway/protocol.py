@@ -37,15 +37,23 @@ ERROR_SCHEMA = "adam_tpu.gateway_error/1"
 #:   GET    /v1/jobs/<job>/parts/<part>   part bytes (Range-resumable)
 #:   GET    /metrics                      Prometheus text exposition
 #:   GET    /incidents                    incident-bundle summaries
+#:   GET    /slo                          SLO compliance + error-budget
+#:                                        burn (utils/slo.py)
 JOBS_PREFIX = "/v1/jobs"
 
 #: Top-level observability routes (docs/OBSERVABILITY.md).
 METRICS_PATH = "/metrics"
 INCIDENTS_PATH = "/incidents"
+SLO_PATH = "/slo"
 
 #: JSON body of ``GET /incidents`` (``incidents`` holds
 #: utils/incidents.summarize_bundle rows, oldest first).
 INCIDENTS_SCHEMA = "adam_tpu.incidents/1"
+
+#: JSON body of ``GET /slo``: ``enabled`` plus, when an engine is
+#: armed, the utils/slo.py status document (per-objective compliance,
+#: short/long burn rates, budget remaining).
+SLO_STATUS_SCHEMA = "adam_tpu.slo_status/1"
 
 #: Submission-manifest body cap: a JobSpec document is a few hundred
 #: bytes; anything past this is a client bug or an attack, refused

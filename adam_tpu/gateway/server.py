@@ -22,7 +22,7 @@ publish atomically.  What the gateway ADDS is the protocol surface
   grant cadence (gateway/protocol.retry_after_s), so clients back off
   at the pace the pool is actually draining windows.
 * **Resumable event streaming** — ``GET /v1/jobs/<job>/events`` tails
-  the job's ``adam_tpu.heartbeat/6`` NDJSON stream as a chunked
+  the job's ``adam_tpu.heartbeat/7`` NDJSON stream as a chunked
   response, resumable from a line ``cursor`` (a tailer that
   reconnects re-requests from its last count; a heartbeat-file
   rotation resets the cursor, exactly like ``adam-tpu top``'s
@@ -193,12 +193,17 @@ class _Handler(BaseHTTPRequestHandler):
                                  f"{method} on /incidents")
             self._incidents()
             return
+        if segs == ["slo"]:
+            if method != "GET":
+                raise _HTTPError(405, "method", f"{method} on /slo")
+            self._slo()
+            return
         if segs[:2] != ["v1", "jobs"]:
             raise _HTTPError(
                 404, "not_found",
                 f"unknown route {self.path!r} (the surface is "
                 f"{protocol.JOBS_PREFIX}[/<job>[/events|/trace|/parts"
-                "[/<part>]]], /metrics and /incidents; "
+                "[/<part>]]], /metrics, /incidents and /slo; "
                 "docs/SERVING.md)",
             )
         rest = segs[2:]
@@ -382,10 +387,11 @@ class _Handler(BaseHTTPRequestHandler):
         ``adam_tpu_gateway_metrics_scrapes`` (the smoke test's
         monotonicity probe)."""
         from adam_tpu.gateway import metrics as metrics_mod
+        from adam_tpu.utils import slo as slo_mod
 
         tele.TRACE.count(tele.C_GW_SCRAPES)
         body = metrics_mod.render_prometheus(
-            tele.TRACE.snapshot()
+            tele.TRACE.snapshot(), slo_status=slo_mod.status()
         ).encode("utf-8")
         self.send_response(200)
         self.send_header("Content-Type",
@@ -408,6 +414,23 @@ class _Handler(BaseHTTPRequestHandler):
             "schema": protocol.INCIDENTS_SCHEMA,
             "incidents": rows,
         })
+
+    def _slo(self) -> None:
+        """``GET /slo``: the armed SLO engine's compliance document —
+        per-objective compliance, short/long-window burn rates, and
+        error-budget remaining (utils/slo.py).  Always 200: a service
+        running without ``--slo`` answers ``enabled: false`` so a
+        fleet prober needs no per-service configuration to ask."""
+        from adam_tpu.utils import slo as slo_mod
+
+        status = slo_mod.status()
+        doc = {
+            "schema": protocol.SLO_STATUS_SCHEMA,
+            "enabled": status is not None,
+        }
+        if status is not None:
+            doc["slo"] = status
+        self._send_json(200, doc)
 
     def _job_trace(self, job: str) -> None:
         """``GET /v1/jobs/<job>/trace``: the job's trace as Chrome

@@ -375,7 +375,7 @@ def transform_streamed(
     # job-scoped counters only (see _start_heartbeat's include_global)
     hb = _start_heartbeat(tr, progress, include_global=pacer is None)
     try:
-        return _transform_streamed_impl(
+        stats = _transform_streamed_impl(
             path, out_path, tr, hb,
             mark_duplicates=mark_duplicates, recalibrate=recalibrate,
             realign=realign, known_snps=known_snps,
@@ -389,6 +389,24 @@ def transform_streamed(
             partitioner=partitioner, run_dir=run_dir, resume=resume,
             pacer=pacer, device_pool=device_pool, coalescer=coalescer,
         )
+        # perf-ledger booking (utils/perfledger.py): every completed
+        # run books its bench-diff keys — into the armed service root
+        # under the scheduler (one longitudinal history per service),
+        # else this run's own durable run_dir.  The sentinel judges
+        # the new entry against the rolling median baseline; a flagged
+        # regression emits a perf.regression bundle and charges the
+        # SLO budget.  Booking failures never fail the run.
+        from adam_tpu.utils import perfledger
+
+        ledger_root = perfledger.ledger_root() or run_dir
+        if ledger_root is not None and perfledger.booking_enabled():
+            try:
+                perfledger.sentinel(
+                    ledger_root, tr.snapshot(), run_id=trace,
+                )
+            except Exception:
+                log.warning("perf-ledger booking failed", exc_info=True)
+        return stats
     except BaseException:
         # crashed run: the final heartbeat line must carry ok=false —
         # a tailing consumer reading done=true as "completed" would

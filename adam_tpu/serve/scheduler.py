@@ -31,7 +31,7 @@ multi-job scheduling"):
   would crash-loop the pool).
 
 Every job runs in its own thread with its own run tracer and its own
-``adam_tpu.heartbeat/6`` stream at ``<run-root>/<job>/heartbeat.ndjson``
+``adam_tpu.heartbeat/7`` stream at ``<run-root>/<job>/heartbeat.ndjson``
 (``adam-tpu top <run-root>`` aggregates them).  The ``sched.*`` fault
 points (``sched.admit`` / ``sched.dispatch`` / ``sched.drain`` /
 ``sched.job_crash``, job id in the ``device`` selector slot) extend the
@@ -65,6 +65,7 @@ from adam_tpu.serve.job import (
 )
 from adam_tpu.utils import faults
 from adam_tpu.utils import incidents
+from adam_tpu.utils import slo as slo_mod
 from adam_tpu.utils import retry as retry_mod
 from adam_tpu.utils import telemetry as tele
 from adam_tpu.utils.durability import atomic_write_json
@@ -101,9 +102,11 @@ class JobScheduler:
                  job_retries: Optional[int] = None,
                  batching: Optional[bool] = None,
                  batch_wait_ms: Optional[float] = None,
-                 quota=None):
+                 quota=None,
+                 slo=None):
         from adam_tpu.serve.batching import batching_enabled
         from adam_tpu.serve.quota import QuotaManager, quota_from_env
+        from adam_tpu.utils import perfledger
 
         self.run_root = os.path.abspath(run_root)
         os.makedirs(self.run_root, exist_ok=True)
@@ -136,6 +139,18 @@ class JobScheduler:
             self._quota = QuotaManager(quota) if quota.strip() else None
         else:
             self._quota = quota
+        # declarative SLOs (utils/slo.py; `--slo` / ADAM_TPU_SLO,
+        # default none): accepts a ready SLOEngine, a grammar string,
+        # or None (then the environment decides).  The engine arms
+        # module-wide with its budget file under the service root, so
+        # restarts resume the error budget; the perf ledger arms on
+        # the same root so every completed job books its perf keys
+        # there (utils/perfledger.py).
+        if slo is None:
+            slo = slo_mod.slo_from_env()
+        self._slo = slo_mod.install(slo, self.run_root) \
+            if slo is not None else None
+        perfledger.install(self.run_root)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # serializes JOB.json rewrites: a submit/recover thread and the
@@ -497,6 +512,7 @@ class JobScheduler:
                         spec.job_id, spec.tenant, trace=spec.trace_id,
                     )
             known_snps = known_indels = None
+            t0 = time.monotonic()
             while True:
                 try:
                     faults.point("sched.job_crash", device=spec.job_id)
@@ -532,6 +548,14 @@ class JobScheduler:
                     with self._lock:
                         rec.stats = stats
                     self._set_state(rec, DONE, error="")
+                    # SLO observation: one completed job against the
+                    # armed objectives (no-op when --slo is off).
+                    # Interrupted jobs are excluded — a drain is an
+                    # operator action, not a service failure.
+                    slo_mod.observe_job(
+                        spec.tenant, time.monotonic() - t0, ok=True,
+                        trace_id=spec.trace_id,
+                    )
                     log.info("job %s done (%s reads, %s windows)",
                              spec.job_id, stats.get("n_reads"),
                              stats.get("windows_fresh"))
@@ -559,6 +583,12 @@ class JobScheduler:
                         # streaming — nothing here touches them.
                         tele.TRACE.count(tele.C_SCHED_QUARANTINED)
                         self._set_state(rec, QUARANTINED)
+                        # a quarantined job is an availability bad
+                        # event against the armed objectives
+                        slo_mod.observe_job(
+                            spec.tenant, time.monotonic() - t0,
+                            ok=False, trace_id=spec.trace_id,
+                        )
                         log.error(
                             "job %s QUARANTINED after %d failed "
                             "attempt(s) (last: %s); its journal stays "
@@ -711,6 +741,14 @@ class JobScheduler:
         if incidents.incidents_dir() == os.path.join(
                 self.run_root, incidents.INCIDENTS_DIRNAME):
             incidents.uninstall()
+        # same for the SLO engine and the perf ledger (both armed on
+        # our run-root by the constructor)
+        from adam_tpu.utils import perfledger
+
+        if self._slo is not None and slo_mod.engine() is self._slo:
+            slo_mod.uninstall()
+        if perfledger.ledger_root() == self.run_root:
+            perfledger.uninstall()
 
     # ---- whole-process crash recovery ----------------------------------
     def recover(self) -> list:
@@ -804,6 +842,9 @@ class JobScheduler:
             "batching": self.batching,
             "quota": (
                 self._quota.status() if self._quota is not None else None
+            ),
+            "slo": (
+                self._slo.evaluate() if self._slo is not None else None
             ),
             "active_leases": (
                 [lz.job for lz in pool.active_leases()]

@@ -595,6 +595,76 @@ def _health_report(doc: dict, counters: dict) -> dict:
     }
 
 
+def _slo_report(slo_doc) -> dict:
+    """SLO section (utils/slo.py): accepts either the live status
+    document (``adam_tpu.slo/1`` — per-objective burn rates included)
+    or the durable budget file (``adam_tpu.slo_budget/1`` — cumulative
+    good/bad per objective; compliance and budget remaining are
+    recomputed from it, burn rates are unknown post-hoc).  ``{}`` when
+    the run carried no SLO."""
+    if not isinstance(slo_doc, dict):
+        return {}
+    objectives = slo_doc.get("objectives")
+    rows = []
+    if isinstance(objectives, list):  # live status document
+        for o in objectives:
+            if isinstance(o, dict) and o.get("key"):
+                rows.append({
+                    "key": o["key"],
+                    "compliance": o.get("compliance"),
+                    "burn_short": o.get("burn_short"),
+                    "burn_long": o.get("burn_long"),
+                    "good": o.get("good_total"),
+                    "bad": o.get("bad_total"),
+                    "budget_remaining": o.get("budget_remaining"),
+                })
+    elif isinstance(objectives, dict):  # durable budget file
+        for key, row in sorted(objectives.items()):
+            if not isinstance(row, dict):
+                continue
+            good = int(row.get("good", 0))
+            bad = int(row.get("bad", 0))
+            total = good + bad
+            allowed = row.get("allowed") or max(
+                1.0 - float(row.get("target", 0.99)), 1e-6)
+            bad_frac = (bad / total) if total else 0.0
+            rows.append({
+                "key": key,
+                "compliance": round(1.0 - bad_frac, 6) if total else None,
+                "burn_short": None,
+                "burn_long": None,
+                "good": good,
+                "bad": bad,
+                "budget_remaining": round(
+                    max(0.0, 1.0 - bad_frac / allowed), 6),
+            })
+    if not rows:
+        return {}
+    return {
+        "objectives": rows,
+        "worst_burn": slo_doc.get("worst_burn"),
+        "budget_remaining": slo_doc.get("budget_remaining"),
+        "window_s": slo_doc.get("window_s"),
+    }
+
+
+def _perf_trend_report(entries) -> dict:
+    """Perf-trend section (utils/perfledger.py): the ledger's run
+    history judged entry-by-entry against the rolling median of the
+    runs before it.  ``{}`` when no ledger rode along."""
+    if not entries:
+        return {}
+    from adam_tpu.utils import perfledger
+
+    rows = perfledger.trend(list(entries))
+    flagged = sum(1 for r in rows if r["regressions"])
+    return {
+        "runs": rows,
+        "n_runs": len(rows),
+        "runs_flagged": flagged,
+    }
+
+
 def _hist_rows(hists: dict) -> dict:
     return {
         name: {
@@ -680,6 +750,11 @@ def analyze(doc: dict) -> dict:
         # (utils/incidents.py; analyze_path folds the sibling
         # incidents/ dir's summaries into the doc)
         "incidents": list(doc.get("incidents") or []),
+        # the judgment layer (utils/slo.py + utils/perfledger.py;
+        # analyze_path folds the sibling SLO_BUDGET.json and
+        # PERF_LEDGER.ndjson into the doc)
+        "slo": _slo_report(doc.get("slo")),
+        "perf_trend": _perf_trend_report(doc.get("perf_ledger")),
         "counters": {
             k: counters[k]
             for k in (
@@ -947,6 +1022,44 @@ def render_report(report: dict) -> str:
                 + (f" ({where_s})" if where_s else "")
                 + (f" — {inc['reason']}" if inc.get("reason") else "")
             )
+    slo = report.get("slo") or {}
+    if slo:
+        out += ["", "SLO"]
+        for o in slo.get("objectives") or []:
+            comp = o.get("compliance")
+            rem = o.get("budget_remaining")
+            burn = o.get("burn_short")
+            out.append(
+                f"  {o['key']}: "
+                + (f"compliance {comp:.4%}" if comp is not None
+                   else "compliance n/a")
+                + (f", budget remaining {rem:.1%}"
+                   if rem is not None else "")
+                + (f", burn {burn:.1f}x short"
+                   + (f" / {o['burn_long']:.1f}x long"
+                      if o.get("burn_long") is not None else "")
+                   if burn is not None else "")
+                + f"  ({o.get('good', 0)} good / {o.get('bad', 0)} bad)"
+            )
+        wb = slo.get("worst_burn")
+        if wb is not None:
+            out.append(f"  worst burn {wb:.1f}x, budget remaining "
+                       f"{(slo.get('budget_remaining') or 0):.1%}")
+    trend = report.get("perf_trend") or {}
+    if trend:
+        out += ["", f"Perf trend ({trend['n_runs']} run(s), "
+                    f"{trend['runs_flagged']} flagged)"]
+        for r in (trend.get("runs") or [])[-8:]:
+            total = (f"{r['total_s']:.3f}s" if r.get("total_s")
+                     is not None else "-")
+            mark = (", ".join(
+                f"{x['key']} {x['delta_pct']:+.1f}%"
+                for x in r["regressions"])
+                or "ok")
+            out.append(
+                f"  run {r['index']} ({r.get('run_id') or '-'}): "
+                f"total {total} — {mark}"
+            )
     hbm = report.get("hbm") or {}
     if hbm:
         out += ["", "HBM footprint"]
@@ -1025,18 +1138,43 @@ def analyze_path(path: str) -> dict:
     artifact sits in (or beside) a run dir with an ``incidents/``
     subdirectory, the bundles' summaries fold into the report's
     "Incidents" section — the post-hoc view of what the anomaly
-    triggers captured while the run was live."""
+    triggers captured while the run was live.  A sibling
+    ``SLO_BUDGET.json`` (utils/slo.py) and ``PERF_LEDGER.ndjson``
+    (utils/perfledger.py) fold into the "SLO" and "Perf trend"
+    sections the same way."""
+    import json as json_mod
+
     from adam_tpu.utils import incidents as incidents_mod
+    from adam_tpu.utils import perfledger
+    from adam_tpu.utils import slo as slo_mod
 
     doc = load_document(path)
     found = []
+    slo_doc = None
+    ledger = []
     probe = os.path.dirname(os.path.abspath(path))
     for _ in range(2):  # the artifact's dir, then its parent
-        found = incidents_mod.list_bundles(probe)
-        if found:
-            break
+        if not found:
+            found = incidents_mod.list_bundles(probe)
+        if slo_doc is None:
+            budget_path = os.path.join(probe, slo_mod.BUDGET_FILENAME)
+            if os.path.isfile(budget_path):
+                try:
+                    with open(budget_path, encoding="utf-8") as fh:
+                        slo_doc = json_mod.load(fh)
+                except (OSError, ValueError):
+                    slo_doc = None
+        if not ledger:
+            ledger = perfledger.read_ledger(probe)
         probe = os.path.dirname(probe)
+    extra = {}
     if found and not doc.get("incidents"):
+        extra["incidents"] = found
+    if slo_doc is not None and not doc.get("slo"):
+        extra["slo"] = slo_doc
+    if ledger and not doc.get("perf_ledger"):
+        extra["perf_ledger"] = ledger
+    if extra:
         doc = dict(doc)
-        doc["incidents"] = found
+        doc.update(extra)
     return analyze(doc)

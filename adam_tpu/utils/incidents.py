@@ -73,6 +73,8 @@ TRIGGERS = (
     "audit.mismatch",
     "retry.exhausted",
     "quota.burst",
+    "slo.burn",
+    "perf.regression",
 )
 
 #: Subdirectory of the installed run dir bundles land in.

@@ -300,6 +300,20 @@ SPAN_AUDIT_CHECK = _span("device.audit.check")
 C_INCIDENT_RECORDED = _metric("incident.recorded")
 C_GW_SCRAPES = _metric("gateway.metrics.scrapes")
 
+# ---- SLO engine + perf sentinel (utils/slo.py, utils/perfledger.py;
+# docs/OBSERVABILITY.md "SLOs and error budgets" / "The perf ledger"):
+# the judgment layer.  ``slo.worst_burn`` is the worst short-window
+# error-budget burn rate across armed objectives (1.0 = spending
+# exactly on objective), ``slo.budget_remaining`` the smallest
+# remaining budget fraction; ``slo.breaches`` counts corroborated
+# fast-burn crossings (each also fires the ``slo.burn`` incident
+# trigger), and ``perf.regressions`` counts direction-aware perf keys
+# the ledger sentinel flagged vs its rolling median baseline. ----
+C_SLO_BREACHES = _metric("slo.breaches")
+C_PERF_REGRESSIONS = _metric("perf.regressions")
+G_SLO_WORST_BURN = _metric("slo.worst_burn")
+G_SLO_BUDGET_REMAINING = _metric("slo.budget_remaining")
+
 # ---- gauges ----
 G_POOL_DEPTH = _metric("parquet.pool.queue_depth")
 # the writer pool's LIVE admission bound (parts allowed in flight):
@@ -1683,10 +1697,23 @@ def merge_snapshots(snaps: list) -> dict:
     per-trace aggregates merge the same way (plain event/second sums
     per trace_id — a job whose windows executed on several hosts reads
     as one combined row), associatively, so gathering host snapshots
-    in any grouping yields the same ``traces`` section."""
+    in any grouping yields the same ``traces`` section.  The health
+    and quota sections merge the same missing-side-tolerant way (a
+    host that never tracked a device or admitted a tenant simply
+    contributes nothing): health keeps per-device the WORST state
+    across hosts (max transitions, min score — pessimism is the right
+    default for a fleet view), quota sums per-tenant spend and keeps
+    the first host's budgets (budgets are configuration, identical
+    across hosts by construction).  Both keys are always present in
+    the merged doc (empty dicts when no host carried the section), so
+    consumers stay key-stable."""
     skew = {}
     hists: dict = {}
     traces: dict = {}
+    health: dict = {}
+    quota: dict = {}
+    _HEALTH_RANK = {"healthy": 0, "suspect": 1, "probation": 2,
+                    "evicted": 3}
     for snap in snaps:
         for name, e in snap.get("spans", {}).items():
             sk = skew.setdefault(
@@ -1700,12 +1727,43 @@ def merge_snapshots(snaps: list) -> dict:
             agg = traces.setdefault(tid, {"events": 0, "total_s": 0.0})
             agg["events"] += t.get("events", 0)
             agg["total_s"] += t.get("total_s", 0.0)
+        for dev, row in (snap.get("health") or {}).items():
+            if not isinstance(row, dict):
+                continue
+            cur = health.get(dev)
+            if cur is None:
+                health[dev] = dict(row)
+                continue
+            if (_HEALTH_RANK.get(row.get("state"), 0)
+                    > _HEALTH_RANK.get(cur.get("state"), 0)):
+                cur["state"] = row.get("state")
+                if row.get("reason"):
+                    cur["reason"] = row["reason"]
+            if isinstance(row.get("score"), (int, float)):
+                cur["score"] = min(cur.get("score", row["score"]),
+                                   row["score"])
+            cur["transitions"] = (cur.get("transitions", 0)
+                                  + row.get("transitions", 0))
+        for tenant, row in (snap.get("quota") or {}).items():
+            if not isinstance(row, dict):
+                continue
+            cur = quota.get(tenant)
+            if cur is None:
+                quota[tenant] = dict(row)
+                continue
+            for k in ("charges", "bytes", "compute_s"):
+                cur[k] = (cur.get(k) or 0) + (row.get(k) or 0)
+            for bk in ("budget_bytes", "budget_compute_s"):
+                if cur.get(bk) is None and row.get(bk) is not None:
+                    cur[bk] = row[bk]
     return {
         "n_hosts": len(snaps),
         "hosts": snaps,
         "span_skew": skew,
         "histograms": hists,
         "traces": traces,
+        "health": health,
+        "quota": quota,
     }
 
 
@@ -1719,11 +1777,12 @@ def merge_snapshots(snaps: list) -> dict:
 #: ``device_health`` (the per-device scoreboard states,
 #: utils/health.py); /6 appended the trace/incident activity fields
 #: (``active_traces``, ``metrics_scrapes``, ``last_incident``,
-#: ``last_incident_age_s`` — utils/incidents.py) — each older
-#: version's fields are a strict prefix of the next, so a consumer
-#: keying on field NAMES keeps working; ``adam-tpu top`` accepts all
-#: six.
-HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/6"
+#: ``last_incident_age_s`` — utils/incidents.py); /7 appended the
+#: judgment fields (``slo_worst_burn``, ``perf_regressions`` —
+#: utils/slo.py + utils/perfledger.py) — each older version's fields
+#: are a strict prefix of the next, so a consumer keying on field
+#: NAMES keeps working; ``adam-tpu top`` accepts all seven.
+HEARTBEAT_SCHEMA = "adam_tpu.heartbeat/7"
 
 #: THE heartbeat line field set — a stable contract (documented in
 #: docs/OBSERVABILITY.md, lint-enforced by scripts/check-telemetry-names):
@@ -1777,6 +1836,13 @@ HEARTBEAT_FIELDS = (
     "metrics_scrapes",
     "last_incident",
     "last_incident_age_s",
+    # /7: the judgment layer (utils/slo.py + utils/perfledger.py) —
+    # the worst short-window error-budget burn rate across armed SLO
+    # objectives (null while no SLO engine is armed) and the running
+    # count of perf keys the ledger sentinel flagged as regressed.
+    # Appended LAST so the /6 fields stay a strict prefix.
+    "slo_worst_burn",
+    "perf_regressions",
 )
 
 def _health_states_for_heartbeat():
@@ -1788,6 +1854,19 @@ def _health_states_for_heartbeat():
 
         states = health_mod.BOARD.states()
         return states or None
+    except Exception:
+        return None
+
+
+def _slo_for_heartbeat():
+    """The /7 ``slo_worst_burn`` field: the armed SLO engine's worst
+    short-window burn rate, or None while no engine is armed (lazy
+    import — slo.py imports this module at its top)."""
+    try:
+        from adam_tpu.utils import slo as slo_mod
+
+        burn = slo_mod.worst_burn()
+        return round(burn, 3) if burn is not None else None
     except Exception:
         return None
 
@@ -2180,6 +2259,11 @@ class Heartbeat:
         line["metrics_scrapes"] = counters.get(C_GW_SCRAPES, 0)
         line["last_incident"] = inc_id
         line["last_incident_age_s"] = inc_age
+        # judgment layer (/7): worst burn across armed SLO objectives
+        # (process-wide, like the incident recorder) + flagged perf
+        # regressions
+        line["slo_worst_burn"] = _slo_for_heartbeat()
+        line["perf_regressions"] = counters.get(C_PERF_REGRESSIONS, 0)
         if self._provider is not None:
             try:
                 for k, v in (self._provider() or {}).items():

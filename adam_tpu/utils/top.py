@@ -44,6 +44,7 @@ from adam_tpu.utils.telemetry import format_bytes as _fmt_bytes
 ACCEPTED_SCHEMAS = (
     "adam_tpu.heartbeat/1", "adam_tpu.heartbeat/2", "adam_tpu.heartbeat/3",
     "adam_tpu.heartbeat/4", "adam_tpu.heartbeat/5", "adam_tpu.heartbeat/6",
+    "adam_tpu.heartbeat/7",
 )
 
 _CLEAR = "\x1b[H\x1b[2J"
@@ -172,6 +173,15 @@ def render_frame(line: dict, source: str = "") -> str:
                 f" ({_fmt_s(line.get('last_incident_age_s'))} ago)"
                 if li else "   incidents none"
             )
+        )
+    burn = line.get("slo_worst_burn")
+    if burn is not None or line.get("perf_regressions"):
+        # judgment cell (/7): worst error-budget burn across armed SLO
+        # objectives + perf keys the ledger sentinel flagged
+        out.append(
+            "slo      "
+            + (f"burn {burn:.1f}x" if burn is not None else "no slo")
+            + f"   perf regressions {line.get('perf_regressions', 0)}"
         )
     out.append(
         f"events   retries {line.get('retries', 0)}"
@@ -351,6 +361,14 @@ def render_multi_frame(jobs: dict, root: str = "",
                     f" ({_fmt_s(pool.get('last_incident_age_s'))} ago)"
                     if li else "   incidents none"
                 )
+            )
+        burn = pool.get("slo_worst_burn")
+        if burn is not None or pool.get("perf_regressions"):
+            rows.append(
+                "slo      "
+                + (f"burn {burn:.1f}x" if burn is not None
+                   else "no slo")
+                + f"   perf regressions {pool.get('perf_regressions', 0)}"
             )
     if jobs and all(j.get("done") for j in jobs.values()):
         rows.append(

@@ -317,3 +317,68 @@ def test_trace_mode_carries_ledger_sections_too():
     assert len(report["compiles"]["in_window"]) == 1
     assert report["hbm"]["0"]["bytes_in_use"] == 123456
     assert report["counters"][tele.C_H2D_BYTES] == 1_000_000
+
+
+def test_fused_megakernel_run_composes_incident_slo_perf_sections(
+        tmp_path):
+    """The satellite contract: a fused-megakernel run's artifact
+    sitting next to incident bundles, an SLO budget, and a perf
+    ledger analyzes into ONE report where every section composes —
+    and per-device busy/idle attribution still sums exactly to the
+    wall (new sections must not perturb the accounting)."""
+    from adam_tpu.utils import incidents
+    from adam_tpu.utils import perfledger
+    from adam_tpu.utils import slo
+
+    tr = _synthetic_two_device_tracer()
+    # the megakernel tier's marks: fused B->C spans + tier decision
+    tr.add_span(tele.SPAN_FUSED_BC, 1 * S, S, device=0, window=0)
+    tr.gauge(tele.G_FUSED_BC, 1)
+    tr.count(tele.C_FUSED_DISPATCHED, 2)
+    snap = tr.snapshot()
+    art = tmp_path / "m.json"
+    art.write_text(json.dumps(snap))
+
+    # sibling incident bundle
+    incidents._reset_for_tests()
+    incidents.install(str(tmp_path))
+    try:
+        incidents.maybe_record("slo.burn", trace_id="ab" * 8,
+                               reason="budget burning at 25.0x")
+    finally:
+        incidents._reset_for_tests()
+    # sibling SLO budget (self-contained: targets + cumulative counts)
+    eng = slo.SLOEngine(
+        slo.parse_slo_spec("t:p99(sched.job.run)<30s"), str(tmp_path))
+    eng.observe_job("t", 1.0, ok=True)
+    eng.observe_job("t", 99.0, ok=True)  # over the bound
+    # sibling perf ledger, newest run regressed
+    for i in range(4):
+        perfledger.book(str(tmp_path),
+                        {"spans.streamed.total.total_s": (10.0, "lower")},
+                        run_id=f"r{i}")
+    perfledger.book(str(tmp_path),
+                    {"spans.streamed.total.total_s": (20.0, "lower")},
+                    run_id="slow")
+
+    report = analyzer.analyze_path(str(art))
+    # every folded section present
+    assert report["incidents"][0]["trigger"] == "slo.burn"
+    slo_rep = report["slo"]
+    assert slo_rep["objectives"][0]["compliance"] == pytest.approx(0.5)
+    trend = report["perf_trend"]
+    assert trend["n_runs"] == 5 and trend["runs_flagged"] == 1
+    # and the accounting they ride along with is untouched:
+    # busy + idle == wall per device, fused span counted as busy
+    assert report["wall_s"] == pytest.approx(10.0)
+    for dev in ("0", "1"):
+        d = report["devices"][dev]
+        assert d["busy_s"] + d["idle_s"] == \
+            pytest.approx(report["wall_s"])
+    assert report["devices"]["0"]["busy_s"] == pytest.approx(4.0)
+
+    text = analyzer.render_report(report)
+    for heading in ("Incidents (1 bundle(s))", "SLO", "Perf trend"):
+        assert heading in text
+    assert "slo.burn" in text
+    assert "t:p99(sched.job.run)<30s" in text

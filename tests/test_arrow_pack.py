@@ -468,3 +468,39 @@ def test_bench_diff_stage_keys(tmp_path):
         "--require-factor", "stages.apply_split_plus_write_wait_s=5",
     ])
     assert rc == 0
+
+
+def test_bench_diff_baseline_dir(tmp_path, capsys):
+    """--baseline-dir picks the newest BENCH_r*.json (round number
+    wins, mtime breaks ties) so CI never hardcodes the old filename;
+    naming the baseline both ways (or neither) is a usage error."""
+    import json
+
+    bd = _load_bench_diff()
+    snap = {
+        "spans": {"streamed.pass_c": {"total_s": 5.0}},
+        "counters": {},
+        "device_spans": {},
+    }
+    rounds = tmp_path / "rounds"
+    rounds.mkdir()
+    (rounds / "BENCH_r1.json").write_text(json.dumps(snap))
+    (rounds / "BENCH_r10_gpu.json").write_text(json.dumps(snap))
+    (rounds / "notes.json").write_text("{}")  # never a candidate
+    assert bd.newest_bench_artifact(str(rounds)).endswith(
+        "BENCH_r10_gpu.json")
+
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps(snap))
+    assert bd.main([str(new), "--baseline-dir", str(rounds)]) == 0
+    capsys.readouterr()
+
+    # both spellings at once, or neither: usage error, not a diff
+    assert bd.main([str(rounds / "BENCH_r1.json"), str(new),
+                    "--baseline-dir", str(rounds)]) == 2
+    assert bd.main([str(new)]) == 2
+    assert "exactly one way" in capsys.readouterr().err
+    # an empty dir is a clean error, not a traceback
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert bd.main([str(new), "--baseline-dir", str(empty)]) != 0

@@ -503,7 +503,7 @@ def test_heartbeat_batch_fields():
     hb = tele.Heartbeat([tr], sink="stderr", interval_s=60.0)
     line = hb.sample()
     assert tuple(line.keys()) == tele.HEARTBEAT_FIELDS
-    assert line["schema"] == "adam_tpu.heartbeat/6"
+    assert line["schema"] == "adam_tpu.heartbeat/7"
     assert line["batch_fill"] == 0.75
     assert line["batched_jobs"] == 3
     # no batching counters -> explicit nulls, never fabricated zeros

@@ -18,7 +18,8 @@ def test_registry_matches_reference():
     """Same command names as ADAMMain.scala:30-72, plus this repo's
     observability extensions (``analyze`` — the post-hoc run report —
     and ``top`` — the live heartbeat dashboard), the contract
-    tooling (``check`` — the static analyzer, docs/STATIC_ANALYSIS.md)
+    tooling (``check`` — the static analyzer, docs/STATIC_ANALYSIS.md —
+    and ``perf`` — the perf-ledger regression gate, utils/perfledger)
     the multi-job service front (``serve`` — adam_tpu/serve), the
     HTTP gateway's client verbs (``submit``/``status``/``fetch``/
     ``cancel`` — adam_tpu/gateway, docs/SERVING.md) and the incident
@@ -33,7 +34,7 @@ def test_registry_matches_reference():
         "features2adam", "wigfix2bed",
         "print", "print_genes", "flagstat", "print_tags", "listdict",
         "allelecount", "buildinfo", "view",
-        "analyze", "top", "check", "incidents",
+        "analyze", "top", "check", "incidents", "perf",
     }
 
 

@@ -38,7 +38,7 @@ from adam_tpu.utils import faults
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-HB = "adam_tpu.heartbeat/6"
+HB = "adam_tpu.heartbeat/7"
 
 
 def _parts_hash(d):

@@ -730,7 +730,7 @@ def test_heartbeat_carries_device_health_field():
     hb = tele.Heartbeat([tr], sink="stderr", interval_s=60.0)
     line = hb.sample()
     assert tuple(line.keys()) == tele.HEARTBEAT_FIELDS
-    assert line["schema"] == "adam_tpu.heartbeat/6"
+    assert line["schema"] == "adam_tpu.heartbeat/7"
     assert line["device_health"] is None  # nothing tracked yet
     health_mod.BOARD.quarantine("cpu:3")
     line2 = hb.sample()

@@ -1,0 +1,241 @@
+"""Perf ledger + regression sentinel (utils/perfledger.py,
+docs/OBSERVABILITY.md "The perf ledger").
+
+The contract under test: every run appends one durable NDJSON line of
+direction-aware perf keys; the sentinel judges the newest run against
+the rolling MEDIAN of its predecessors (silent until
+MIN_BASELINE_RUNS of history exist), and a flagged run counts
+``perf.regressions``, lands a ``perf.regression`` incident bundle,
+and charges the armed SLO engine's error budget; ``adam-tpu perf``
+turns the ledger into a CI gate (exit 1 on a newest-run regression).
+"""
+
+import json
+import os
+
+import pytest
+
+from adam_tpu.utils import incidents
+from adam_tpu.utils import perfledger as pl
+from adam_tpu.utils import slo
+from adam_tpu.utils import telemetry as tele
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    pl._reset_for_tests()
+    slo._reset_for_tests()
+    incidents._reset_for_tests()
+    monkeypatch.setenv("ADAM_TPU_INCIDENT_COOLDOWN_S", "0")
+    yield
+    pl._reset_for_tests()
+    slo._reset_for_tests()
+    incidents._reset_for_tests()
+
+
+def _snap(total_s=10.0, apply_s=2.0):
+    """A minimal telemetry-snapshot shape carrying the sentinel's
+    marquee keys."""
+    return {
+        "spans": {
+            "streamed.total": {"count": 1, "total_s": total_s},
+            "streamed.pass_c": {"count": 1, "total_s": apply_s + 1.0},
+            "streamed.apply.dispatch": {"count": 4, "total_s": 0.5},
+            "streamed.apply.fetch": {"count": 4, "total_s": 0.5},
+            "streamed.write_wait": {"count": 1, "total_s": 1.0},
+        },
+        "counters": {"reads.ingested": 1000},
+        "transfers": {
+            "h2d": {"0": {"pass_c": {"bytes": 1 << 20, "n": 4},
+                          "prewarm": {"bytes": 1 << 30, "n": 1}}},
+            "d2h": {},
+        },
+        "compiles": {"entries": [
+            {"kernel": "bqsr", "in_window": False},
+            {"kernel": "bqsr", "in_window": True},
+        ], "dropped": 0},
+    }
+
+
+def _seed(root, n, total_s=10.0):
+    for i in range(n):
+        pl.book(str(root), _snap(total_s=total_s), run_id=f"seed{i}")
+
+
+# ---------------------------------------------------------------------------
+# key extraction / booking / reading
+# ---------------------------------------------------------------------------
+def test_snapshot_keys_directions_and_identities():
+    keys = pl.snapshot_keys(_snap())
+    assert keys["spans.streamed.total.total_s"] == (10.0, "lower")
+    assert keys["counters.reads.ingested"] == (1000.0, None)
+    # pass_c - dispatch - fetch - prewarm.pass_c
+    assert keys["stages.apply_split_s"] == (2.0, "lower")
+    assert keys["stages.apply_split_plus_write_wait_s"] == (3.0, "lower")
+    # prewarm bytes excluded from the transfer total
+    assert keys["transfers.h2d.total.bytes"] == (float(1 << 20), None)
+    # only the in-window cold compile counts
+    assert keys["compiles.in_window"] == (1.0, "lower")
+
+
+def test_book_and_read_roundtrip(tmp_path):
+    entry = pl.book(str(tmp_path), _snap(), run_id="r1")
+    assert entry["schema"] == pl.LEDGER_SCHEMA
+    got = pl.read_ledger(str(tmp_path))
+    assert len(got) == 1 and got[0]["run_id"] == "r1"
+    # the ledger file itself is an accepted root spelling
+    path = os.path.join(str(tmp_path), pl.LEDGER_FILENAME)
+    assert pl.read_ledger(path) == got
+
+
+def test_read_skips_torn_and_foreign_lines(tmp_path):
+    pl.book(str(tmp_path), _snap(), run_id="good")
+    path = os.path.join(str(tmp_path), pl.LEDGER_FILENAME)
+    with open(path, "a") as fh:
+        fh.write(json.dumps({"schema": "someone.else/9"}) + "\n")
+        fh.write('{"schema": "adam_tpu.perf_ledger/1", "torn')  # no \n
+    entries = pl.read_ledger(str(tmp_path))
+    assert [e.get("run_id") for e in entries] == ["good"]
+    assert pl.read_ledger(str(tmp_path / "missing")) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline + compare
+# ---------------------------------------------------------------------------
+def test_rolling_baseline_is_median_with_quorum(tmp_path):
+    _seed(tmp_path, 4, total_s=10.0)
+    pl.book(str(tmp_path), _snap(total_s=100.0), run_id="outlier")
+    base = pl.rolling_baseline(pl.read_ledger(str(tmp_path)), 5)
+    # median absorbs the single outlier
+    assert base["spans.streamed.total.total_s"][0] == pytest.approx(10.0)
+    # a key present in only 1 of 5 entries misses the quorum
+    pl.book(str(tmp_path), {"rare.key": (1.0, "lower")}, run_id="rare")
+    base = pl.rolling_baseline(pl.read_ledger(str(tmp_path)), 5)
+    assert "rare.key" not in base
+
+
+def test_compare_is_direction_aware():
+    baseline = {
+        "a.lower": (10.0, "lower", 5),
+        "b.info": (10.0, None, 5),
+        "c.tiny": (1e-6, "lower", 5),
+    }
+    entry = {"schema": pl.LEDGER_SCHEMA, "keys": {
+        "a.lower": [20.0, "lower"],   # +100% on lower-is-better: flags
+        "b.info": [99.0, None],       # informational: never flags
+        "c.tiny": [1.0, "lower"],     # sub-noise-floor baseline: never
+    }}
+    regs = pl.compare(entry, baseline, 25.0)
+    assert [r["key"] for r in regs] == ["a.lower"]
+    assert regs[0]["delta_pct"] == pytest.approx(100.0)
+    # an improvement never flags
+    faster = {"schema": pl.LEDGER_SCHEMA,
+              "keys": {"a.lower": [1.0, "lower"]}}
+    assert pl.compare(faster, baseline, 25.0) == []
+
+
+# ---------------------------------------------------------------------------
+# sentinel
+# ---------------------------------------------------------------------------
+def test_sentinel_silent_until_min_history(tmp_path):
+    incidents.install(str(tmp_path))
+    for i in range(pl.MIN_BASELINE_RUNS):
+        # 10x slower every run, but history is too shallow to judge
+        assert pl.sentinel(str(tmp_path), _snap(total_s=10.0 ** (i + 1)),
+                           run_id=f"r{i}") == []
+    assert incidents.list_bundles(str(tmp_path)) == []
+
+
+def test_sentinel_flags_counts_and_fires(tmp_path):
+    incidents.install(str(tmp_path))
+    slo.install("t:avail>=0.99", str(tmp_path))
+    was = tele.TRACE.recording
+    tele.TRACE.recording = True
+    try:
+        _seed(tmp_path, 4)
+        regs = pl.sentinel(str(tmp_path), _snap(total_s=20.0),
+                           run_id="slowrun")
+        assert any(r["key"] == "spans.streamed.total.total_s"
+                   for r in regs)
+        counters = tele.TRACE.snapshot()["counters"]
+        assert counters[tele.C_PERF_REGRESSIONS] == len(regs)
+        bundles = incidents.list_bundles(str(tmp_path))
+        assert any(b["trigger"] == "perf.regression" for b in bundles)
+        # the regression charged the SLO budget
+        row = slo.status()["objectives"][0]
+        assert row["bad_total"] == len(regs)
+    finally:
+        tele.TRACE.recording = was
+        tele.TRACE.reset()
+
+
+def test_sentinel_clean_run_stays_quiet(tmp_path):
+    incidents.install(str(tmp_path))
+    _seed(tmp_path, 4)
+    assert pl.sentinel(str(tmp_path), _snap(total_s=10.1),
+                       run_id="steady") == []
+    assert incidents.list_bundles(str(tmp_path)) == []
+
+
+def test_env_knobs_validated(monkeypatch):
+    monkeypatch.setenv("ADAM_TPU_PERF_THRESHOLD", "bogus")
+    assert pl.perf_threshold_pct() == pl.DEFAULT_THRESHOLD_PCT
+    monkeypatch.setenv("ADAM_TPU_PERF_BASELINE_N", "7")
+    assert pl.baseline_n() == 7
+    monkeypatch.setenv("ADAM_TPU_PERF_LEDGER", "0")
+    assert not pl.booking_enabled()
+    monkeypatch.delenv("ADAM_TPU_PERF_LEDGER")
+    assert pl.booking_enabled()
+
+
+def test_install_seam(tmp_path):
+    assert not pl.installed() and pl.ledger_root() is None
+    pl.install(str(tmp_path))
+    assert pl.installed()
+    assert pl.ledger_root() == os.path.abspath(str(tmp_path))
+    pl.uninstall()
+    assert pl.ledger_root() is None
+
+
+# ---------------------------------------------------------------------------
+# trend + CLI
+# ---------------------------------------------------------------------------
+def test_trend_rows_flag_only_past_baseline_phase(tmp_path):
+    _seed(tmp_path, 4)
+    pl.book(str(tmp_path), _snap(total_s=20.0), run_id="slow")
+    rows = pl.trend(pl.read_ledger(str(tmp_path)))
+    assert [r["index"] for r in rows] == [0, 1, 2, 3, 4]
+    for r in rows[:pl.MIN_BASELINE_RUNS]:
+        assert r["regressions"] == []
+    assert rows[-1]["total_s"] == pytest.approx(20.0)
+    assert any(r["key"] == "spans.streamed.total.total_s"
+               for r in rows[-1]["regressions"])
+
+
+def _run_cli(argv):
+    from adam_tpu.cli.main import main
+
+    return main(argv)
+
+
+def test_cli_perf_exit_codes_and_json(tmp_path, capsys):
+    assert _run_cli(["perf", str(tmp_path / "empty")]) == 2
+    capsys.readouterr()
+
+    _seed(tmp_path, 4)
+    pl.book(str(tmp_path), _snap(total_s=10.0), run_id="steady")
+    assert _run_cli(["perf", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "regressions" in out and "steady"[-6:] in out
+
+    pl.book(str(tmp_path), _snap(total_s=20.0), run_id="slowrun")
+    assert _run_cli(["perf", str(tmp_path)]) == 1
+    capsys.readouterr()
+
+    assert _run_cli(["perf", str(tmp_path), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "adam_tpu.perf_trend/1"
+    assert not doc["ok"] and doc["regressions"]
+
+    # a generous threshold clears the same ledger
+    assert _run_cli(["perf", str(tmp_path), "--threshold", "200"]) == 0

@@ -1,0 +1,265 @@
+"""SLO engine: spec grammar, error-budget burn, durable budget,
+slo.burn firing (utils/slo.py, docs/OBSERVABILITY.md "SLOs and error
+budgets").
+
+The contract under test: a declarative ``--slo`` spec parses
+forgivingly (malformed clauses warn and skip — the tuning-var
+contract), completed jobs book good/bad events per matching
+objective, the multi-window burn rate fires ``slo.burn`` only when
+BOTH rolling windows corroborate, and the cumulative budget survives
+an engine restart through ``SLO_BUDGET.json``.
+"""
+
+import json
+import os
+
+import pytest
+
+from adam_tpu.utils import incidents
+from adam_tpu.utils import slo
+from adam_tpu.utils import telemetry as tele
+
+TID = "cd" * 8
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh engine + recorder per test; incident cooldown off."""
+    slo._reset_for_tests()
+    incidents._reset_for_tests()
+    monkeypatch.setenv("ADAM_TPU_INCIDENT_COOLDOWN_S", "0")
+    yield
+    slo._reset_for_tests()
+    incidents._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# grammar
+# ---------------------------------------------------------------------------
+def test_parse_grammar_all_forms():
+    objs = slo.parse_slo_spec(
+        "tenantA:p99(sched.job.run)<30s;"
+        "tenantB:avail>=0.999,p50(sched.job.run)<500ms;"
+        "*:tput(reads.ingested)>=1000/s")
+    kinds = [(o.tenant, o.kind) for o in objs]
+    assert kinds == [("tenantA", "latency"), ("tenantB", "avail"),
+                     ("tenantB", "latency"), ("*", "tput")]
+    lat = objs[0]
+    assert lat.name == "sched.job.run"
+    assert lat.target == pytest.approx(0.99)
+    assert lat.bound_s == pytest.approx(30.0)
+    assert lat.allowed == pytest.approx(0.01)
+    assert objs[2].bound_s == pytest.approx(0.5)  # ms suffix
+    assert objs[3].target == pytest.approx(1000.0)
+
+
+def test_parse_duration_suffixes():
+    objs = slo.parse_slo_spec("t:p90(x.y)<2m;t:p90(x.y)<2;t:p90(x.y)<2s")
+    assert [o.bound_s for o in objs] == [120.0, 2.0, 2.0]
+
+
+def test_parse_malformed_clauses_warn_and_skip(caplog):
+    with caplog.at_level("WARNING"):
+        objs = slo.parse_slo_spec(
+            "good:avail>=0.99;"
+            "nocolon;"            # missing tenant separator
+            "t:p200(x)<1s;"       # quantile out of range
+            "t:avail>=1.5;"       # fraction out of range
+            "t:garbage(x)")
+    assert len(objs) == 1 and objs[0].tenant == "good"
+    assert any("ignoring" in r.message for r in caplog.records)
+
+
+def test_parse_empty_spec_is_empty():
+    assert slo.parse_slo_spec("") == []
+    assert slo.parse_slo_spec(";;") == []
+
+
+def test_objective_key_roundtrips_through_parse():
+    objs = slo.parse_slo_spec("t:p99(sched.job.run)<30s;*:avail>=0.99")
+    reparsed = slo.parse_slo_spec(";".join(o.key for o in objs))
+    assert [o.key for o in reparsed] == [o.key for o in objs]
+
+
+def test_objective_matches_tenant_scope():
+    wide, narrow = slo.parse_slo_spec("*:avail>=0.9;t1:avail>=0.9")
+    assert wide.matches("anyone") and wide.matches(None)
+    assert narrow.matches("t1") and not narrow.matches("t2")
+
+
+# ---------------------------------------------------------------------------
+# engine evaluation
+# ---------------------------------------------------------------------------
+def test_engine_books_and_burns(tmp_path):
+    eng = slo.SLOEngine(
+        slo.parse_slo_spec("t:p99(sched.job.run)<1s"),
+        str(tmp_path), window_s=60.0)
+    for _ in range(3):
+        eng.observe_job("t", 0.1, ok=True)
+    status = eng.evaluate()
+    row = status["objectives"][0]
+    assert row["compliance"] == pytest.approx(1.0)
+    assert row["burn_short"] == 0.0 and not row["fast_burn"]
+    assert status["worst_burn"] == 0.0
+
+    eng.observe_job("t", 5.0, ok=True)  # over the 1s bound = bad
+    row = eng.evaluate()["objectives"][0]
+    assert row["bad_total"] == 1 and row["good_total"] == 3
+    # 1 bad / 4 events = 25% bad over a 1% budget -> 25x burn
+    assert row["burn_short"] == pytest.approx(25.0)
+    assert row["fast_burn"]  # both windows hold the same events here
+
+
+def test_engine_ignores_other_tenants_and_spans(tmp_path):
+    eng = slo.SLOEngine(
+        slo.parse_slo_spec("t1:p99(sched.job.run)<1s"), str(tmp_path))
+    eng.observe_job("t2", 99.0, ok=False)        # other tenant
+    eng.observe_job("t1", 99.0, span="other.span")  # other span
+    row = eng.evaluate()["objectives"][0]
+    assert row["good_total"] == 0 and row["bad_total"] == 0
+
+
+def test_avail_objective_judges_ok_flag(tmp_path):
+    eng = slo.SLOEngine(
+        slo.parse_slo_spec("*:avail>=0.99"), str(tmp_path))
+    eng.observe_job("t", 0.1, ok=True)
+    eng.observe_job("t", 0.1, ok=False)  # quarantined
+    row = eng.evaluate()["objectives"][0]
+    assert row["good_total"] == 1 and row["bad_total"] == 1
+    assert row["burn_short"] == pytest.approx(50.0)
+
+
+def test_budget_persists_and_resumes(tmp_path):
+    spec = "t:avail>=0.99"
+    eng = slo.SLOEngine(slo.parse_slo_spec(spec), str(tmp_path))
+    eng.observe_job("t", 0.1, ok=True)
+    eng.observe_job("t", 0.1, ok=False)
+    path = os.path.join(str(tmp_path), slo.BUDGET_FILENAME)
+    doc = json.load(open(path))
+    assert doc["schema"] == slo.BUDGET_SCHEMA
+    key = "t:avail>=0.99"
+    assert doc["objectives"][key] == pytest.approx(
+        {"tenant": "t", "kind": "avail", "target": 0.99,
+         "allowed": 0.01, "good": 1, "bad": 1}, abs=1e-9)
+
+    # a restart resumes the cumulative budget (not the rolling window)
+    eng2 = slo.SLOEngine(slo.parse_slo_spec(spec), str(tmp_path))
+    row = eng2.evaluate()["objectives"][0]
+    assert row["good_total"] == 1 and row["bad_total"] == 1
+    assert row["budget_remaining"] == 0.0  # 50% bad over a 1% budget
+    assert row["burn_short"] == 0.0  # but the live window starts empty
+
+
+def test_corrupt_budget_file_starts_fresh(tmp_path, caplog):
+    (tmp_path / slo.BUDGET_FILENAME).write_text("{not json")
+    with caplog.at_level("WARNING"):
+        eng = slo.SLOEngine(
+            slo.parse_slo_spec("t:avail>=0.9"), str(tmp_path))
+    row = eng.evaluate()["objectives"][0]
+    assert row["good_total"] == 0 and row["bad_total"] == 0
+
+
+def test_fast_burn_fires_slo_burn_incident(tmp_path):
+    incidents.install(str(tmp_path))
+    eng = slo.SLOEngine(
+        slo.parse_slo_spec("t:p99(sched.job.run)<0.01s"),
+        str(tmp_path), window_s=60.0)
+    slo.install(eng)
+    for _ in range(3):
+        slo.observe_job("t", 5.0, ok=True, trace_id=TID)  # all miss
+    found = incidents.list_bundles(str(tmp_path))
+    assert any(b["trigger"] == "slo.burn" for b in found)
+    burn = [b for b in found if b["trigger"] == "slo.burn"][0]
+    assert burn["trace_id"] == TID
+    assert "burning error budget" in burn["reason"]
+
+
+def test_note_bad_event_charges_budget(tmp_path):
+    eng = slo.SLOEngine(
+        slo.parse_slo_spec("t:avail>=0.99;*:tput(reads.ingested)>=1"),
+        str(tmp_path))
+    eng.note_bad_event(2, reason="perf regression")
+    rows = {r["kind"]: r for r in eng.evaluate()["objectives"]}
+    assert rows["avail"]["bad_total"] == 2
+    # the charge itself never touches tput (sampled, not event-driven):
+    # its only bookings come from its own rate samples
+    assert rows["tput"]["good_total"] + rows["tput"]["bad_total"] <= 1
+
+
+def test_tput_floor_flags_stalled_counter(tmp_path):
+    eng = slo.SLOEngine(
+        slo.parse_slo_spec("*:tput(reads.ingested)>=1000"), str(tmp_path))
+    eng.evaluate()  # first sample establishes the baseline, books nothing
+    row = eng.evaluate()["objectives"][0]  # counter never advanced
+    assert row["bad_total"] >= 1
+    assert row.get("rate") == pytest.approx(0.0)
+
+
+def test_gauges_published_on_evaluation(tmp_path):
+    was = tele.TRACE.recording
+    tele.TRACE.recording = True
+    try:
+        eng = slo.SLOEngine(
+            slo.parse_slo_spec("t:avail>=0.99"), str(tmp_path),
+            window_s=60.0)
+        slo.install(eng)
+        slo.observe_job("t", 0.1, ok=False)
+        gauges = tele.TRACE.snapshot()["gauges"]
+        assert gauges[tele.G_SLO_WORST_BURN]["last"] == \
+            pytest.approx(100.0)
+        assert gauges[tele.G_SLO_BUDGET_REMAINING]["last"] == 0.0
+    finally:
+        tele.TRACE.recording = was
+        tele.TRACE.reset()
+
+
+# ---------------------------------------------------------------------------
+# module arm/disarm seam
+# ---------------------------------------------------------------------------
+def test_disarmed_module_functions_noop(tmp_path):
+    assert not slo.installed()
+    slo.observe_job("t", 1.0)  # must not raise
+    slo.note_perf_regression(1, reason="x")
+    assert slo.status() is None
+    assert slo.worst_burn() is None
+
+
+def test_install_empty_spec_stays_disarmed(caplog):
+    with caplog.at_level("WARNING"):
+        assert slo.install("nonsense-spec") is None
+    assert not slo.installed()
+    assert slo.install("") is None  # silent: no spec at all
+    assert slo.install(None) is None
+
+
+def test_install_from_spec_string_and_env(tmp_path, monkeypatch):
+    eng = slo.install("t:avail>=0.9", str(tmp_path))
+    assert eng is not None and slo.installed()
+    assert slo.engine() is eng
+    slo.uninstall()
+    monkeypatch.setenv("ADAM_TPU_SLO", "t:avail>=0.9")
+    assert slo.slo_from_env() == "t:avail>=0.9"
+    monkeypatch.delenv("ADAM_TPU_SLO")
+    assert slo.slo_from_env() is None
+
+
+def test_status_document_shape(tmp_path):
+    slo.install("t:p99(sched.job.run)<30s", str(tmp_path))
+    slo.observe_job("t", 1.0, ok=True)
+    doc = slo.status()
+    assert doc["schema"] == slo.SLO_SCHEMA
+    assert doc["long_window_s"] == pytest.approx(
+        doc["window_s"] * slo.LONG_WINDOW_FACTOR)
+    row = doc["objectives"][0]
+    for field in ("key", "tenant", "kind", "compliance", "burn_short",
+                  "burn_long", "budget_remaining", "fast_burn",
+                  "bound_s"):
+        assert field in row
+
+
+def test_window_knob_validation(monkeypatch, caplog):
+    monkeypatch.setenv("ADAM_TPU_SLO_WINDOW_S", "-5")
+    with caplog.at_level("WARNING"):
+        assert slo.slo_window_s() == slo.DEFAULT_WINDOW_S
+    monkeypatch.setenv("ADAM_TPU_SLO_FAST_BURN", "bogus")
+    assert slo.fast_burn_threshold() == slo.DEFAULT_FAST_BURN

@@ -768,7 +768,7 @@ def test_heartbeat_v2_carries_tunnel_and_hbm_fields(tmp_path):
     hb.start()
     hb.stop()
     lines = [json.loads(l) for l in open(str(tmp_path / "hb.ndjson"))]
-    assert lines[-1]["schema"] == "adam_tpu.heartbeat/6"
+    assert lines[-1]["schema"] == "adam_tpu.heartbeat/7"
     assert lines[-1]["h2d_bytes"] == 12345
     assert lines[-1]["d2h_bytes"] == 54321
     assert lines[-1]["hbm_bytes_in_use"] == {}
@@ -1044,9 +1044,68 @@ def test_heartbeat_v6_trace_and_incident_fields(tmp_path):
         tele.deactivate_trace(tid)
         incidents.uninstall()
     line = lines[-1]
-    assert line["schema"] == "adam_tpu.heartbeat/6"
+    assert line["schema"] == "adam_tpu.heartbeat/7"
     assert list(line) == list(tele.HEARTBEAT_FIELDS)
     assert line["active_traces"] >= 1
     assert line["metrics_scrapes"] == 4
     assert line["last_incident"].startswith("inc-")
     assert line["last_incident_age_s"] >= 0.0
+
+
+def test_merge_snapshots_health_missing_side_key_stable():
+    """A host snapshot without a health section (host-only worker, or
+    an older artifact) merges key-stably: the present side passes
+    through and the merged doc still carries the key."""
+    row = {"state": "suspect", "score": 0.4,
+           "reason": "slow fetch", "transitions": 2}
+    with_health = {"spans": {}, "health": {"0": dict(row)}}
+    without = {"spans": {}}  # no health key at all
+    merged = tele.merge_snapshots([with_health, without])
+    assert merged["health"] == {"0": row}
+    # both orders, and an all-missing merge still carries the key
+    assert tele.merge_snapshots([without, with_health])["health"] == \
+        {"0": row}
+    assert tele.merge_snapshots([without, without])["health"] == {}
+
+
+def test_merge_snapshots_health_worst_state_wins():
+    a = {"health": {"0": {"state": "healthy", "score": 0.9,
+                          "reason": "", "transitions": 1}}}
+    b = {"health": {"0": {"state": "evicted", "score": 0.1,
+                          "reason": "SDC mismatch", "transitions": 3}}}
+    for order in ([a, b], [b, a]):
+        got = tele.merge_snapshots(order)["health"]["0"]
+        assert got["state"] == "evicted"
+        assert got["reason"] == "SDC mismatch"
+        assert got["score"] == pytest.approx(0.1)
+        assert got["transitions"] == 4
+
+
+def test_merge_snapshots_quota_missing_side_key_stable():
+    row = {"charges": 3, "bytes": 100, "compute_s": 1.5,
+           "budget_bytes": 1000, "budget_compute_s": None}
+    with_quota = {"quota": {"t1": dict(row)}}
+    without = {"spans": {}}
+    for order in ([with_quota, without], [without, with_quota]):
+        assert tele.merge_snapshots(order)["quota"] == {"t1": row}
+    assert tele.merge_snapshots([without])["quota"] == {}
+
+
+def test_merge_snapshots_quota_sums_spend_keeps_budgets():
+    a = {"quota": {"t1": {"charges": 2, "bytes": 10, "compute_s": 1.0,
+                          "budget_bytes": None,
+                          "budget_compute_s": None}}}
+    b = {"quota": {"t1": {"charges": 1, "bytes": 5, "compute_s": 0.5,
+                          "budget_bytes": 1 << 20,
+                          "budget_compute_s": 60.0},
+                   "t2": {"charges": 9, "bytes": 0, "compute_s": 0.0,
+                          "budget_bytes": None,
+                          "budget_compute_s": None}}}
+    got = tele.merge_snapshots([a, b])["quota"]
+    assert got["t1"]["charges"] == 3
+    assert got["t1"]["bytes"] == 15
+    assert got["t1"]["compute_s"] == pytest.approx(1.5)
+    # budgets are configuration: first non-None wins, never summed
+    assert got["t1"]["budget_bytes"] == 1 << 20
+    assert got["t1"]["budget_compute_s"] == 60.0
+    assert got["t2"]["charges"] == 9

@@ -223,3 +223,33 @@ def test_top_cli_multi_job_directory(tmp_path, capsys):
     _job_stream(root, "j1", _line(done=True))
     assert main(["top", str(root), "-once"]) == 0
     assert "multi-job" in capsys.readouterr().out
+
+
+def test_render_frame_slo_cell():
+    # /7 producer with an armed SLO engine: worst burn + regressions
+    text = top_mod.render_frame(
+        _line(slo_worst_burn=14.4, perf_regressions=2),
+        source="hb.ndjson")
+    assert "burn 14.4x" in text
+    assert "perf regressions 2" in text
+    # regressions without an SLO engine still renders the cell
+    text = top_mod.render_frame(
+        _line(slo_worst_burn=None, perf_regressions=1),
+        source="hb.ndjson")
+    assert "no slo" in text and "perf regressions 1" in text
+    # a pre-/7 producer (no fields at all) renders no slo cell
+    text = top_mod.render_frame(_line(), source="hb.ndjson")
+    assert "slo  " not in text
+
+
+def test_cli_once_long_flag_and_exit_codes(tmp_path, capsys):
+    p = str(tmp_path / "hb.ndjson")
+    with open(p, "w") as fh:
+        fh.write(json.dumps(_line(slo_worst_burn=2.0)) + "\n")
+    assert main(["top", p, "--once"]) == 0  # long spelling
+    assert "burn 2.0x" in capsys.readouterr().out
+    with open(p, "a") as fh:
+        fh.write(json.dumps(_line(seq=1, done=True, ok=False)) + "\n")
+    assert main(["top", p, "--once"]) == 1
+    capsys.readouterr()
+    assert main(["top", str(tmp_path / "absent.ndjson"), "--once"]) == 2
